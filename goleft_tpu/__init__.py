@@ -1,0 +1,18 @@
+"""goleft_tpu: a TPU-native genomics coverage framework.
+
+A from-scratch rebuild of the capabilities of brentp/goleft (reference:
+/root/reference, v0.2.6) designed TPU-first: host-side BAM/BAI/CRAI decoding
+feeds columnar read tuples to JAX programs (scatter-add + segmented cumsum
+coverage, batched EM copy-number, index-coverage normalization/PCA) that are
+jit/shard_map-compiled over a device mesh.
+
+Subpackages:
+  io        host-side file-format codecs (BGZF, BAM, BAI, CRAI, FAI)
+  ops       JAX compute kernels (coverage, normalization, stats, PCA)
+  models    statistical models (emdepth EM, cn.mops, dcnv debias, cnveval)
+  parallel  mesh/sharding utilities, sharded segmented cumsum, scheduler
+  commands  CLI subcommands mirroring the reference dispatcher
+  utils     transparent IO, BED/ped writers, HTML reports
+"""
+
+__version__ = "0.1.0"

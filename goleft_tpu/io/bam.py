@@ -1,0 +1,418 @@
+"""BAM container codec, clean-room from the SAM/BAM specification (section 4).
+
+Replaces what the reference vendors from biogo/hts/bam (SURVEY.md §2.4):
+header + reference dictionary parsing and alignment-record decode. Unlike the
+reference (which never decodes records itself — it pipes BAM through
+``samtools depth`` and parses text, depth/depth.go:45), this decoder emits
+**columnar numpy arrays** of read tuples and ref-aligned segments, the exact
+feed format for the device coverage kernel (ops/coverage.py).
+
+CIGAR op semantics (spec table): M/=/X consume query+ref, D/N consume ref
+only, I/S consume query only, H/P consume neither. Depth counts only
+query+ref-consuming ops (the ``samtools depth`` default the reference
+inherits), so a record's coverage contribution is its list of M/=/X blocks.
+
+A record writer is included for building hermetic test fixtures (the
+reference ships tiny BAMs; we fabricate our own instead of copying them).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .bgzf import BgzfReader, BgzfWriter
+
+BAM_MAGIC = b"BAM\x01"
+
+CIGAR_OPS = "MIDNSHP=X"
+# ops that consume the reference
+_CONSUMES_REF = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1], dtype=np.int64)
+# ops that consume the query
+_CONSUMES_QUERY = np.array([1, 1, 0, 0, 1, 0, 0, 1, 1], dtype=np.int64)
+# ops that count toward depth (query+ref aligned): M, =, X
+_IS_ALIGNED = np.array([1, 0, 0, 0, 0, 0, 0, 1, 1], dtype=np.bool_)
+
+SEQ_NT16 = "=ACMGRSVTWYHKDBN"
+_NT16_CODE = {c: i for i, c in enumerate(SEQ_NT16)}
+
+# flag bits
+FLAG_PAIRED = 0x1
+FLAG_PROPER_PAIR = 0x2
+FLAG_UNMAPPED = 0x4
+FLAG_MATE_UNMAPPED = 0x8
+FLAG_REVERSE = 0x10
+FLAG_MATE_REVERSE = 0x20
+FLAG_READ1 = 0x40
+FLAG_READ2 = 0x80
+FLAG_SECONDARY = 0x100
+FLAG_QCFAIL = 0x200
+FLAG_DUP = 0x400
+FLAG_SUPPLEMENTARY = 0x800
+
+# samtools depth default skip mask: UNMAP | SECONDARY | QCFAIL | DUP
+DEPTH_SKIP_FLAGS = FLAG_UNMAPPED | FLAG_SECONDARY | FLAG_QCFAIL | FLAG_DUP
+
+
+@dataclass
+class BamHeader:
+    text: str
+    ref_names: list[str]
+    ref_lens: list[int]
+    _name_to_tid: dict = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        self._name_to_tid = {n: i for i, n in enumerate(self.ref_names)}
+
+    def tid(self, name: str) -> int:
+        return self._name_to_tid[name]
+
+    def sample_names(self) -> list[str]:
+        """Unique SM tags from @RG lines, in first-seen order.
+
+        Mirrors samplename.Names (reference samplename/samplename.go:14-37).
+        """
+        seen: list[str] = []
+        for line in self.text.splitlines():
+            if not line.startswith("@RG"):
+                continue
+            for tok in line.split("\t")[1:]:
+                if tok.startswith("SM:"):
+                    sm = tok[3:]
+                    if sm and sm not in seen:
+                        seen.append(sm)
+        return seen
+
+
+@dataclass
+class BamRecord:
+    """One decoded alignment (used by tests and covstats sampling)."""
+
+    tid: int
+    pos: int
+    mapq: int
+    flag: int
+    mate_tid: int
+    mate_pos: int
+    tlen: int
+    name: str
+    cigar: list[tuple[int, int]]  # (oplen, opcode)
+    seq: str
+    qual: bytes
+
+    @property
+    def ref_end(self) -> int:
+        n = self.pos
+        for oplen, op in self.cigar:
+            n += oplen * int(_CONSUMES_REF[op])
+        return n
+
+    @property
+    def read_len(self) -> int:
+        return len(self.seq)
+
+    def aligned_blocks(self) -> list[tuple[int, int]]:
+        out = []
+        p = self.pos
+        for oplen, op in self.cigar:
+            if _IS_ALIGNED[op]:
+                out.append((p, p + oplen))
+            if _CONSUMES_REF[op]:
+                p += oplen
+        return out
+
+
+@dataclass
+class ReadColumns:
+    """Columnar read tuples: the host→device wire format.
+
+    ``seg_*`` arrays have one row per M/=/X CIGAR block; ``seg_read`` maps
+    each segment back to its read row. Filtering by flag/mapq happens on
+    device so changing thresholds costs no re-decode.
+    """
+
+    tid: np.ndarray  # int32  (n_reads,)
+    pos: np.ndarray  # int32
+    end: np.ndarray  # int32  ref end (pos + ref-consumed length)
+    mapq: np.ndarray  # uint8
+    flag: np.ndarray  # uint16
+    tlen: np.ndarray  # int32
+    read_len: np.ndarray  # int32
+    seg_tid: np.ndarray  # int32 (n_segs,)
+    seg_start: np.ndarray  # int32
+    seg_end: np.ndarray  # int32
+    seg_read: np.ndarray  # int32 index into read rows
+
+    @property
+    def n_reads(self) -> int:
+        return len(self.pos)
+
+    @staticmethod
+    def empty() -> "ReadColumns":
+        z32 = np.zeros(0, dtype=np.int32)
+        return ReadColumns(
+            z32, z32, z32,
+            np.zeros(0, dtype=np.uint8), np.zeros(0, dtype=np.uint16),
+            z32, z32, z32.copy(), z32.copy(), z32.copy(), z32.copy(),
+        )
+
+    @staticmethod
+    def concat(parts: list["ReadColumns"]) -> "ReadColumns":
+        parts = [p for p in parts if p.n_reads]
+        if not parts:
+            return ReadColumns.empty()
+        offs = np.cumsum([0] + [p.n_reads for p in parts[:-1]])
+        return ReadColumns(
+            *[np.concatenate([getattr(p, f) for p in parts])
+              for f in ("tid", "pos", "end", "mapq", "flag", "tlen",
+                        "read_len", "seg_tid", "seg_start", "seg_end")],
+            np.concatenate(
+                [p.seg_read + o for p, o in zip(parts, offs)]
+            ).astype(np.int32),
+        )
+
+
+def _decode_record(buf: bytes, want_seq: bool = False) -> BamRecord:
+    (tid, pos, l_rn, mapq, _bin, n_cig, flag, l_seq, mtid, mpos, tlen
+     ) = struct.unpack_from("<iiBBHHHiiii", buf, 0)
+    off = 32
+    name = buf[off : off + l_rn - 1].decode()
+    off += l_rn
+    cigar = []
+    for _ in range(n_cig):
+        (v,) = struct.unpack_from("<I", buf, off)
+        cigar.append((v >> 4, v & 0xF))
+        off += 4
+    seq = ""
+    qual = b""
+    if want_seq:
+        nb = (l_seq + 1) // 2
+        sq = buf[off : off + nb]
+        chars = []
+        for i in range(l_seq):
+            b = sq[i // 2]
+            code = (b >> 4) if i % 2 == 0 else (b & 0xF)
+            chars.append(SEQ_NT16[code])
+        seq = "".join(chars)
+        qual = buf[off + nb : off + nb + l_seq]
+    return BamRecord(tid, pos, mapq, flag, mtid, mpos, tlen, name, cigar,
+                     seq, qual)
+
+
+class BamReader:
+    """Sequential + random-access BAM reader over an in-memory file."""
+
+    def __init__(self, data: bytes):
+        self._r = BgzfReader(data)
+        magic = self._r.read(4)
+        if magic != BAM_MAGIC:
+            raise ValueError("not a BAM file (bad magic)")
+        (l_text,) = struct.unpack("<i", self._r.read(4))
+        text = self._r.read(l_text).rstrip(b"\x00").decode()
+        (n_ref,) = struct.unpack("<i", self._r.read(4))
+        names, lens = [], []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", self._r.read(4))
+            names.append(self._r.read(l_name)[:-1].decode())
+            (l_ref,) = struct.unpack("<i", self._r.read(4))
+            lens.append(l_ref)
+        self.header = BamHeader(text, names, lens)
+        self._body_voffset = self._r.tell_virtual()
+
+    @classmethod
+    def from_file(cls, path: str) -> "BamReader":
+        with open(path, "rb") as fh:
+            return cls(fh.read())
+
+    def rewind(self) -> None:
+        self._r.seek_virtual(self._body_voffset)
+
+    def seek_virtual(self, voffset: int) -> None:
+        self._r.seek_virtual(voffset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> BamRecord:
+        rec = self.next_record(want_seq=True)
+        if rec is None:
+            raise StopIteration
+        return rec
+
+    def next_record(self, want_seq: bool = False) -> BamRecord | None:
+        szb = self._r.read(4)
+        if len(szb) < 4:
+            return None
+        (block_size,) = struct.unpack("<i", szb)
+        buf = self._r.read(block_size)
+        if len(buf) < block_size:
+            raise ValueError("bam: truncated record")
+        return _decode_record(buf, want_seq=want_seq)
+
+    def read_columns(
+        self,
+        tid: int | None = None,
+        start: int = 0,
+        end: int | None = None,
+        max_records: int | None = None,
+    ) -> ReadColumns:
+        """Decode records into columnar arrays.
+
+        When ``tid`` is given, only records on that reference overlapping
+        [start, end) are kept (the stream is still scanned sequentially from
+        the current position; pair with a BAI region seek for random access).
+        """
+        tids, poss, ends, mapqs, flags, tlens, rlens = \
+            [], [], [], [], [], [], []
+        seg_t, seg_s, seg_e, seg_r = [], [], [], []
+        n = 0
+        while True:
+            szb = self._r.read(4)
+            if len(szb) < 4:
+                break
+            (block_size,) = struct.unpack("<i", szb)
+            buf = self._r.read(block_size)
+            (rtid, pos, l_rn, mapq, _bin, n_cig, flag, l_seq
+             ) = struct.unpack_from("<iiBBHHHi", buf, 0)
+            if tid is not None:
+                if rtid > tid or rtid < 0:
+                    break  # sorted BAM: past the target chromosome
+                if rtid < tid:
+                    continue
+                if end is not None and pos >= end:
+                    break
+            tlen = struct.unpack_from("<i", buf, 28)[0]
+            off = 32 + l_rn
+            cig = np.frombuffer(buf, dtype=np.uint32, count=n_cig, offset=off)
+            oplen = (cig >> 4).astype(np.int64)
+            opc = (cig & 0xF).astype(np.int64)
+            ref_len = int(np.sum(oplen * _CONSUMES_REF[opc]))
+            rend = pos + ref_len
+            if tid is not None and rend <= start:
+                continue
+            row = n
+            n += 1
+            tids.append(rtid)
+            poss.append(pos)
+            ends.append(rend)
+            mapqs.append(mapq)
+            flags.append(flag)
+            tlens.append(tlen)
+            rlens.append(l_seq)
+            # aligned blocks
+            ref_steps = oplen * _CONSUMES_REF[opc]
+            block_starts = pos + np.concatenate(
+                ([0], np.cumsum(ref_steps[:-1]))
+            )
+            al = _IS_ALIGNED[opc]
+            for bs, ln in zip(block_starts[al], oplen[al]):
+                seg_t.append(rtid)
+                seg_s.append(int(bs))
+                seg_e.append(int(bs + ln))
+                seg_r.append(row)
+            if max_records is not None and n >= max_records:
+                break
+        return ReadColumns(
+            np.asarray(tids, dtype=np.int32),
+            np.asarray(poss, dtype=np.int32),
+            np.asarray(ends, dtype=np.int32),
+            np.asarray(mapqs, dtype=np.uint8),
+            np.asarray(flags, dtype=np.uint16),
+            np.asarray(tlens, dtype=np.int32),
+            np.asarray(rlens, dtype=np.int32),
+            np.asarray(seg_t, dtype=np.int32),
+            np.asarray(seg_s, dtype=np.int32),
+            np.asarray(seg_e, dtype=np.int32),
+            np.asarray(seg_r, dtype=np.int32),
+        )
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """SAM spec section 5.3 bin number for [beg, end)."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+class BamWriter:
+    """Minimal BAM writer for fabricating hermetic test fixtures."""
+
+    def __init__(self, fh, header_text: str, ref_names: list[str],
+                 ref_lens: list[int]):
+        self._w = BgzfWriter(fh)
+        self.ref_names = ref_names
+        text = header_text.encode()
+        self._w.write(BAM_MAGIC + struct.pack("<i", len(text)) + text)
+        self._w.write(struct.pack("<i", len(ref_names)))
+        for nm, ln in zip(ref_names, ref_lens):
+            nb = nm.encode() + b"\x00"
+            self._w.write(struct.pack("<i", len(nb)) + nb +
+                          struct.pack("<i", ln))
+
+    def write_record(
+        self,
+        tid: int,
+        pos: int,
+        cigar: list[tuple[int, int]],
+        mapq: int = 60,
+        flag: int = 0,
+        name: str = "r",
+        seq: str | None = None,
+        mate_tid: int = -1,
+        mate_pos: int = -1,
+        tlen: int = 0,
+    ) -> None:
+        if seq is None:
+            qlen = sum(ln for ln, op in cigar if _CONSUMES_QUERY[op])
+            seq = "A" * qlen
+        l_seq = len(seq)
+        nb = name.encode() + b"\x00"
+        end = pos + sum(ln for ln, op in cigar if _CONSUMES_REF[op])
+        body = struct.pack(
+            "<iiBBHHHiiii", tid, pos, len(nb), mapq,
+            reg2bin(pos, max(end, pos + 1)), len(cigar), flag, l_seq,
+            mate_tid, mate_pos, tlen,
+        )
+        body += nb
+        for ln, op in cigar:
+            body += struct.pack("<I", (ln << 4) | op)
+        packed = bytearray()
+        for i in range(0, l_seq, 2):
+            hi = _NT16_CODE.get(seq[i], 15) << 4
+            lo = _NT16_CODE.get(seq[i + 1], 15) if i + 1 < l_seq else 0
+            packed.append(hi | lo)
+        body += bytes(packed) + b"\xff" * l_seq
+        self._w.write(struct.pack("<i", len(body)) + body)
+
+    def close(self) -> None:
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def parse_cigar(s: str) -> list[tuple[int, int]]:
+    """'100M' → [(100, 0)]; convenience for tests."""
+    out = []
+    num = ""
+    for ch in s:
+        if ch.isdigit():
+            num += ch
+        else:
+            out.append((int(num), CIGAR_OPS.index(ch)))
+            num = ""
+    return out

@@ -1,0 +1,125 @@
+"""CRAI (CRAM index) reader with 16KB tile interpolation.
+
+The .crai format is gzipped TSV with six fields per line
+(seqID, alnStart, alnSpan, containerStart, sliceStart, sliceLen) — CRAM spec
+appendix. CRAM slices are irregularly sized, so to share indexcov's
+16,384bp-tile math the slices are interpolated into synthetic tiles.
+
+Behavioral contract reproduced from the reference
+(indexcov/crai/crai.go:45-127):
+  - lines with seqID == -1 (unmapped) are skipped; a negative alnSpan stops
+    parsing early (crai.go:163-166)
+  - the final slice's span is zeroed when negative or > 1e6 (":63-69")
+  - gaps before a slice back-fill one tile of the previous per-base value
+    then zeros (":76-85")
+  - slices starting > one tile *before* the current tile cursor (long reads
+    overlapping) are trimmed forward by whole tiles (":91-99")
+  - per-base value = 100000 * sliceBytes / span (":105-106"), emitted
+    span/16384 times; slices shorter than a tile carry their value into
+    ``lastVal`` (":108-115")
+"""
+
+from __future__ import annotations
+
+import gzip
+from dataclasses import dataclass
+
+import numpy as np
+
+TILE_WIDTH = 16384
+PER_BASE_SCALE = 100000
+
+
+@dataclass
+class CraiSlice:
+    aln_start: int
+    aln_span: int
+    container_start: int
+    slice_start: int
+    slice_len: int
+
+
+@dataclass
+class CraiIndex:
+    slices: list[list[CraiSlice]]  # per seqID
+
+    def sizes(self) -> list[np.ndarray]:
+        return [_make_sizes(s) for s in self.slices]
+
+
+def _make_sizes(slices: list[CraiSlice]) -> np.ndarray:
+    if not slices:
+        return np.zeros(0, dtype=np.int64)
+    # defensive fix-ups on the final slice
+    last = slices[-1]
+    last_span = last.aln_span
+    if last_span < 0 or last_span > 1_000_000:
+        last = CraiSlice(last.aln_start, 0, last.container_start,
+                         last.slice_start, last.slice_len)
+        slices = slices[:-1] + [last]
+
+    sizes: list[int] = []
+    last_start = 0
+    last_val = 0
+    for sl in slices:
+        start, span = sl.aln_start, sl.aln_span
+        # back-fill gap tiles: first gets the carried value, rest zero
+        k = 0
+        while last_start < start - TILE_WIDTH:
+            sizes.append(last_val if k == 0 else 0)
+            if k == 0:
+                last_val = 0
+            k += 1
+            last_start += TILE_WIDTH
+        overhang = start - last_start
+        if overhang > TILE_WIDTH:
+            raise ValueError("crai: tile cursor logic error")
+        while overhang < -TILE_WIDTH:
+            # long reads from the prior slice spilled more than a tile in
+            start += TILE_WIDTH
+            span -= TILE_WIDTH
+            overhang = start - last_start
+        if span <= 0:
+            continue
+        per_base = int(PER_BASE_SCALE * float(sl.slice_len) / float(sl.aln_span))
+        n_tiles = int(float(sl.aln_span) / TILE_WIDTH)
+        if n_tiles == 0 and start - last_start < TILE_WIDTH:
+            last_val = per_base
+            continue
+        sizes.extend([per_base] * n_tiles)
+        last_start += TILE_WIDTH * n_tiles
+        last_val = per_base
+    return np.asarray(sizes, dtype=np.int64)
+
+
+def read_crai(path_or_bytes) -> CraiIndex:
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            data = fh.read()
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+    slices: list[list[CraiSlice]] = []
+    for lineno, line in enumerate(data.decode().splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        parts = line.split("\t")
+        if len(parts) != 6:
+            raise ValueError(
+                f"crai: expected 6 fields, got {len(parts)} at line {lineno}"
+            )
+        si = int(parts[0])
+        if si == -1:
+            continue  # unmapped
+        aln_span = int(parts[2])
+        if aln_span < 0:
+            break  # matches reference early-break on negative span
+        while len(slices) <= si:
+            slices.append([])
+        slices[si].append(
+            CraiSlice(int(parts[1]), aln_span, int(parts[3]),
+                      int(parts[4]), int(parts[5]))
+        )
+    return CraiIndex(slices)

@@ -1,0 +1,1 @@
+from .xopen import xopen  # noqa: F401

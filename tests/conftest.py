@@ -1,0 +1,21 @@
+"""Test environment: force JAX onto a virtual 8-device CPU platform.
+
+Real-TPU execution is exercised by bench.py and the driver's dryrun; tests
+must be hermetic and validate sharding semantics on virtual devices
+(one real chip is all we have, and CI may have none).
+
+This must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

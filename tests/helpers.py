@@ -1,0 +1,60 @@
+"""Fixture fabrication: tiny deterministic BAM/BAI/FASTA files.
+
+The reference ships small real BAMs (depth/test/t.bam etc.); we fabricate
+equivalents with our own writer so the suite is hermetic and nothing is
+copied from the reference checkout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from goleft_tpu.io.bam import BamWriter, parse_cigar
+from goleft_tpu.io.bai import build_bai, write_bai
+
+HEADER_TEXT = (
+    "@HD\tVN:1.6\tSO:coordinate\n"
+    "@SQ\tSN:chr1\tLN:100000\n"
+    "@SQ\tSN:chr2\tLN:50000\n"
+    "@RG\tID:rg1\tSM:sampleA\n"
+)
+
+
+def write_bam(path: str, reads, ref_names=("chr1", "chr2"),
+              ref_lens=(100000, 50000), header_text: str = HEADER_TEXT):
+    """reads: list of (tid, pos, cigar_str, mapq, flag) tuples,
+    must be coordinate-sorted."""
+    with open(path, "wb") as fh:
+        with BamWriter(fh, header_text, list(ref_names),
+                       list(ref_lens)) as w:
+            for i, (tid, pos, cig, mapq, flag) in enumerate(reads):
+                w.write_record(tid, pos, parse_cigar(cig), mapq=mapq,
+                               flag=flag, name=f"r{i:05d}")
+    return path
+
+
+def write_bam_and_bai(path: str, reads, **kw):
+    write_bam(path, reads, **kw)
+    idx = build_bai(path)
+    write_bai(idx, path + ".bai")
+    return path
+
+
+def random_reads(rng: np.random.Generator, n: int, tid: int, ref_len: int,
+                 read_len: int = 100, mapq_lo: int = 0):
+    """Coordinate-sorted simple reads spread over a reference."""
+    starts = np.sort(rng.integers(0, max(1, ref_len - read_len), size=n))
+    out = []
+    for s in starts:
+        mapq = int(rng.integers(mapq_lo, 61))
+        out.append((tid, int(s), f"{read_len}M", mapq, 0))
+    return out
+
+
+def write_fasta(path: str, seqs: dict[str, str], line_width: int = 60):
+    with open(path, "w") as fh:
+        for name, seq in seqs.items():
+            fh.write(f">{name}\n")
+            for i in range(0, len(seq), line_width):
+                fh.write(seq[i : i + line_width] + "\n")
+    return path

@@ -1,0 +1,193 @@
+"""io-core tests: BGZF roundtrip, BAM codec, BAI build/parse/sizes, CRAI,
+FAI/Faidx."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io.bgzf import BgzfReader, BgzfWriter, bgzf_decompress
+from goleft_tpu.io.bam import (
+    BamReader, parse_cigar, DEPTH_SKIP_FLAGS, FLAG_DUP,
+)
+from goleft_tpu.io.bai import read_bai, build_bai, write_bai, TILE_WIDTH
+from goleft_tpu.io.crai import read_crai, CraiIndex, CraiSlice
+from goleft_tpu.io.fai import read_fai, write_fai, Faidx
+
+from helpers import write_bam, write_bam_and_bai, write_fasta, random_reads
+
+
+def test_bgzf_roundtrip(tmp_path):
+    payload = os.urandom(300_000) + b"tail"
+    p = tmp_path / "x.bgz"
+    with open(p, "wb") as fh:
+        with BgzfWriter(fh) as w:
+            w.write(payload)
+    raw = p.read_bytes()
+    assert bgzf_decompress(raw) == payload
+    # bgzf is valid gzip
+    assert gzip.decompress(raw) == payload
+    # streaming reader
+    r = BgzfReader(raw)
+    assert r.read(10) == payload[:10]
+    assert r.read(len(payload)) == payload[10:]
+
+
+def test_bgzf_virtual_seek(tmp_path):
+    payload = bytes(range(256)) * 2000
+    p = tmp_path / "x.bgz"
+    with open(p, "wb") as fh:
+        with BgzfWriter(fh) as w:
+            w.write(payload)
+    r = BgzfReader(p.read_bytes())
+    r.read(100)
+    v = r.tell_virtual()
+    rest1 = r.read(500)
+    r.seek_virtual(v)
+    assert r.read(500) == rest1
+
+
+def test_bam_roundtrip(tmp_path):
+    reads = [
+        (0, 100, "100M", 60, 0),
+        (0, 150, "50M10D50M", 30, 0),
+        (0, 200, "10S90M", 20, 0),
+        (1, 5, "100M", 60, FLAG_DUP),
+    ]
+    p = str(tmp_path / "t.bam")
+    write_bam(p, reads)
+    rdr = BamReader.from_file(p)
+    assert rdr.header.ref_names == ["chr1", "chr2"]
+    assert rdr.header.ref_lens == [100000, 50000]
+    assert rdr.header.sample_names() == ["sampleA"]
+    recs = list(rdr)
+    assert len(recs) == 4
+    assert recs[0].pos == 100 and recs[0].ref_end == 200
+    assert recs[1].ref_end == 150 + 110  # D consumes ref
+    assert recs[2].ref_end == 200 + 90  # S does not consume ref
+    assert recs[1].aligned_blocks() == [(150, 200), (210, 260)]
+    assert recs[3].flag & DEPTH_SKIP_FLAGS
+
+
+def test_bam_read_columns(tmp_path):
+    reads = [
+        (0, 100, "100M", 60, 0),
+        (0, 150, "50M10D50M", 30, 0),
+        (1, 5, "100M", 60, 0),
+    ]
+    p = str(tmp_path / "t.bam")
+    write_bam(p, reads)
+    cols = BamReader.from_file(p).read_columns()
+    assert cols.n_reads == 3
+    np.testing.assert_array_equal(cols.pos, [100, 150, 5])
+    np.testing.assert_array_equal(cols.end, [200, 260, 105])
+    # read 1 contributes two segments around its deletion
+    np.testing.assert_array_equal(cols.seg_start, [100, 150, 210, 5])
+    np.testing.assert_array_equal(cols.seg_end, [200, 200, 260, 105])
+    np.testing.assert_array_equal(cols.seg_read, [0, 1, 1, 2])
+
+
+def test_bam_read_columns_region(tmp_path):
+    reads = [(0, i * 1000, "100M", 60, 0) for i in range(50)] + [
+        (1, 10, "100M", 60, 0)
+    ]
+    p = str(tmp_path / "t.bam")
+    write_bam(p, reads)
+    rdr = BamReader.from_file(p)
+    cols = rdr.read_columns(tid=0, start=10_000, end=20_000)
+    # reads starting at 10k..19k overlap; read at 9_900+100=10_000 ends at
+    # exactly start → excluded (half-open)
+    assert cols.pos.min() >= 10_000 - 100
+    assert all(cols.end > 10_000) and all(cols.pos < 20_000)
+
+
+def test_bai_build_and_sizes(tmp_path):
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 500, 0, 100_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads)
+    idx = read_bai(p + ".bai")
+    assert idx.refs[0].mapped == 500
+    assert idx.refs[0].unmapped == 0
+    sizes = idx.sizes()
+    # chr1 is 100kb → ~6 tiles with reads; deltas non-negative, some positive
+    assert len(sizes[0]) >= 4
+    assert np.all(sizes[0] >= 0) and sizes[0].sum() > 0
+    # total compressed span roughly matches file body size (compressed file
+    # positions dominate the voffset high bits)
+    assert idx.reference_stats(0) == (500, 0)
+    assert idx.mapped_total == 500
+
+
+def test_bai_writer_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    reads = random_reads(rng, 200, 0, 100_000)
+    p = str(tmp_path / "t.bam")
+    write_bam(p, reads)
+    idx = build_bai(p)
+    write_bai(idx, p + ".bai")
+    idx2 = read_bai(p + ".bai")
+    for a, b in zip(idx.sizes(), idx2.sizes()):
+        np.testing.assert_array_equal(a, b)
+    assert idx2.refs[0].mapped == idx.refs[0].mapped
+
+
+def test_crai_parse_and_sizes(tmp_path):
+    lines = [
+        "0\t0\t16384\t100\t0\t800",
+        "0\t16384\t16384\t900\t0\t400",
+        # a gap then another slice
+        "0\t65536\t32768\t1300\t0\t1000",
+        "-1\t0\t0\t0\t0\t50",  # unmapped, skipped
+    ]
+    raw = ("\n".join(lines) + "\n").encode()
+    p = tmp_path / "x.crai"
+    p.write_bytes(gzip.compress(raw))
+    idx = read_crai(str(p))
+    assert len(idx.slices) == 1
+    sizes = idx.sizes()[0]
+    # slice1: perBase = 100000*800/16384 = 4882, 1 tile
+    assert sizes[0] == int(100000 * 800 / 16384)
+    assert sizes[1] == int(100000 * 400 / 16384)
+    # gap backfill carries the previous per-base value into the first gap
+    # tile (crai.go:78-85 semantics), then two tiles of slice3
+    assert list(sizes[2:]) == [int(100000 * 400 / 16384)] + [
+        int(100000 * 1000 / 32768)
+    ] * 2
+
+
+def test_crai_gap_carry():
+    # one sub-tile slice then a far slice: carried value lands on first gap
+    sl = [
+        CraiSlice(0, 1000, 0, 0, 500),
+        CraiSlice(16384 * 4, 16384, 0, 0, 300),
+    ]
+    sizes = CraiIndex([sl]).sizes()[0]
+    per1 = int(100000 * 500 / 1000)
+    per2 = int(100000 * 300 / 16384)
+    # backfill stops one tile short of the slice start (crai.go:78), so the
+    # gap contributes carry + two zeros before the far slice's tile
+    assert list(sizes) == [per1, 0, 0, per2]
+
+
+def test_fai_and_faidx(tmp_path):
+    seq1 = "ACGT" * 250  # 1000bp, 50% GC
+    seq2 = "acgt" * 25 + "CGCG" * 25  # masked + CpG rich
+    p = write_fasta(str(tmp_path / "g.fa"), {"chr1": seq1, "chrM": seq2})
+    recs = write_fai(p)
+    assert [r.name for r in recs] == ["chr1", "chrM"]
+    assert [r.length for r in recs] == [1000, 200]
+    recs2 = read_fai(p + ".fai")
+    assert recs2[0].length == 1000
+    fa = Faidx(p)
+    assert fa.fetch("chr1", 0, 8) == b"ACGTACGT"
+    assert fa.fetch("chr1", 998, 1002) == b"GT"  # clamped
+    # spans line boundaries
+    assert fa.fetch("chr1", 58, 62) == b"GTAC"
+    st = fa.window_stats("chr1", 0, 1000)
+    assert st["gc"] == pytest.approx(0.5)
+    assert st["masked"] == 0.0
+    st2 = fa.window_stats("chrM", 0, 200)
+    assert st2["masked"] == pytest.approx(0.5)
+    assert st2["gc"] == pytest.approx((50 + 100) / 200)
