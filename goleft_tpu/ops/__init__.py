@@ -1,0 +1,4 @@
+from .coverage import (  # noqa: F401
+    depth_from_segments, windowed_sums, callable_classes, run_length_encode,
+    bucket_size,
+)

@@ -1,0 +1,247 @@
+"""indexcov numerics: normalization, ROC, bin counters, copy number, PCA.
+
+Device (JAX, float32 — matching the reference's float32 math) kernels for
+the per-bin work that dominates a cohort run, vmapped over the sample axis;
+the tiny integer-exact per-sample median init stays on host in int64 numpy
+(bit-exact vs the reference's int64 sort/cumsum at indexcov/indexcov.go:
+104-124, where ragged chromosome lists make device layout pointless).
+
+Reference semantics reproduced (citations into /root/reference):
+  - median size per tile: sort sizes, cap at the 98th percentile, take the
+    value where the capped cumsum first exceeds total/2
+    (indexcov/indexcov.go:104-124)
+  - NormalizedDepth: float32 size/median, capped at 50000 (":129-151")
+  - CountsAtDepth: slot = trunc(d * (70 * float32(2/3)) + 0.5) clipped to
+    [0, 70) (":153-177")
+  - CountsROC: reverse cumulative counts / total (":181-193")
+  - counter: in = depth in (0.85, 1.15); low < 0.15; hi > 1.15; bins missing
+    past a sample's end count as out+low (":1050-1078")
+  - GetCN: drop zero bins; if >30% of all bins are (nonzero) < 0.02 also
+    drop those; CN = Ploidy * sorted[0.4*len] (":957-991")
+  - cross-sample normalization + 7-tap smoothing, sequentially dependent on
+    previously-normalized columns → lax.scan (":549-597")
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SLOTS = 70
+SLOTS_MID = 2.0 / 3.0
+MAX_CN = 8.0
+PLOIDY = 2
+DEPTH_CAP = 50000.0
+
+
+def median_size_per_tile(sizes: list[np.ndarray]) -> float:
+    """Host, int64-exact (indexcov/indexcov.go:96-124)."""
+    flat = np.concatenate([np.asarray(s, dtype=np.int64) for s in sizes]) \
+        if sizes else np.zeros(0, dtype=np.int64)
+    if flat.size < 1:
+        raise ValueError("indexcov: no usable chromosomes in index")
+    flat = np.sort(flat)
+    n98 = flat[int(0.98 * len(flat))]
+    capped = np.minimum(flat, n98)
+    cumsum = np.cumsum(capped)
+    total = int(cumsum[-1])
+    idx = int(np.searchsorted(cumsum, total // 2, side="right"))
+    idx = min(idx, len(flat) - 1)
+    return float(flat[idx])
+
+
+def normalized_depth(sizes: np.ndarray, median: float) -> np.ndarray:
+    """float32 scaled depth, capped at 50000 (indexcov.go:129-151)."""
+    if median == 0:
+        return np.zeros(0, dtype=np.float32)
+    d = (np.asarray(sizes, dtype=np.float64) / median).astype(np.float32)
+    return np.minimum(d, np.float32(DEPTH_CAP))
+
+
+_SCALE = np.float32(SLOTS * np.float32(SLOTS_MID))  # 46.666668 in f32
+
+
+@jax.jit
+def counts_at_depth(depths: jax.Array, valid: jax.Array) -> jax.Array:
+    """(n_samples, n_bins) → (n_samples, SLOTS) int32 histogram."""
+    idx = jnp.clip(
+        (depths * _SCALE + jnp.float32(0.5)).astype(jnp.int32), 0, SLOTS - 1
+    )
+    idx = jnp.where(valid, idx, SLOTS)  # dropped slot for padding
+    one = jnp.ones_like(idx, dtype=jnp.int32)
+
+    def hist(i, o):
+        return jnp.zeros(SLOTS, jnp.int32).at[i].add(o, mode="drop")
+
+    return jax.vmap(hist)(idx, one)
+
+
+@jax.jit
+def counts_roc(counts: jax.Array) -> jax.Array:
+    """Reverse-cumulative proportion (indexcov.go:181-193). counts:
+    (..., SLOTS)."""
+    totals = jnp.cumsum(counts[..., ::-1], axis=-1)[..., ::-1]
+    return totals.astype(jnp.float32) / totals[..., :1].astype(jnp.float32)
+
+
+@jax.jit
+def bin_counters(
+    depths: jax.Array, valid: jax.Array, longest: jax.Array
+) -> dict:
+    """Per-sample in/out/low/hi counts (indexcov.go:1050-1078).
+
+    ``longest`` is the bin count of the longest sample for this chromosome;
+    missing tail bins count as out+low.
+    """
+    d = depths
+    inside = valid & (d >= 0.85) & (d <= 1.15)
+    out = valid & ((d < 0.85) | (d > 1.15))
+    hi = valid & (d > 1.15)
+    low = valid & (d < 0.15)
+    n_valid = valid.sum(axis=-1)
+    tail = jnp.maximum(longest - n_valid, 0)
+    return {
+        "in": inside.sum(axis=-1).astype(jnp.int32),
+        "out": (out.sum(axis=-1) + tail).astype(jnp.int32),
+        "hi": hi.sum(axis=-1).astype(jnp.int32),
+        "low": (low.sum(axis=-1) + tail).astype(jnp.int32),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("ploidy",))
+def get_cn(depths: jax.Array, valid: jax.Array, ploidy: int = PLOIDY
+           ) -> jax.Array:
+    """Per-sample copy number of one chromosome (indexcov.go:957-991).
+
+    depths: (n_samples, n_bins) padded; valid masks real bins.
+    """
+
+    def one(d, v):
+        nz = v & (d != 0)
+        k = nz.sum()
+        lows = (nz & (d < 0.02)).sum()
+        n_total = v.sum()
+        p_lo = lows.astype(jnp.float32) / jnp.maximum(
+            n_total, 1
+        ).astype(jnp.float32)
+        # ascending sort of nonzero values; invalid/zero → +inf tail
+        vals = jnp.sort(jnp.where(nz, d, jnp.inf))
+        base = jnp.where(p_lo > 0.3, lows, 0)
+        m = k - base
+        idx = base + (m.astype(jnp.float32) * 0.4).astype(jnp.int32)
+        med = jnp.where(
+            m > 0,
+            jnp.float32(ploidy) * vals[jnp.clip(idx, 0, d.shape[0] - 1)],
+            0.0,
+        )
+        return jnp.where(k > 0, med, jnp.float32(-0.1))
+
+    return jax.vmap(one)(depths, valid)
+
+
+@jax.jit
+def normalize_across_samples(
+    depths: jax.Array, lengths: jax.Array
+) -> jax.Array:
+    """Cross-sample normalization + 7-tap smoothing (indexcov.go:549-597).
+
+    Column j is divided by the cohort mean of its 3-bin neighborhood —
+    where columns < j were already normalized+smoothed — then smoothed with
+    a 7-tap window mixing processed (j-3..j) and still-raw (j+1..j+3)/m
+    values. The feedback makes this a scan over the bin axis with a carry
+    of the last three processed columns.
+
+    depths: (n_samples, n_bins) zero-padded; lengths: per-sample bin counts.
+    Returns processed depths (same shape).
+    """
+    n_samples, n_bins = depths.shape
+    if n_samples < 5:
+        return depths
+    lengths = lengths.astype(jnp.int32)
+
+    raw = depths
+    # raw columns at j+1, j+2, j+3 (zero-padded past the end)
+    pad = jnp.zeros((n_samples, 3), raw.dtype)
+    raw_p = jnp.concatenate([raw, pad], axis=1)
+
+    def step(carry, j):
+        prev3 = carry  # (n_samples, 3): processed j-3, j-2, j-1
+        col = raw[:, j]
+        valid_j = lengths > j
+        valid_jm1 = (j > 0) & valid_j  # len > j implies len > j-1
+        valid_jp1 = lengths - 1 > j
+        m_sum = (
+            jnp.where(valid_j, col, 0.0).sum()
+            + jnp.where(valid_jm1, prev3[:, 2], 0.0).sum()
+            + jnp.where(valid_jp1, raw_p[:, j + 1], 0.0).sum()
+        )
+        n = (
+            valid_j.sum() + valid_jm1.sum() + valid_jp1.sum()
+        ).astype(jnp.float32)
+        m = m_sum / jnp.maximum(n, 1.0)
+        skip = (n.astype(jnp.int32) < 3 * n_samples - 4) | (m < 0.1)
+
+        scaled = jnp.where(valid_j, col / m, col)
+        do_smooth = valid_j & (j > 2) & (j < lengths - 3)
+        smoothed = (
+            prev3[:, 0] + prev3[:, 1] + prev3[:, 2] + scaled
+            + raw_p[:, j + 1] / m + raw_p[:, j + 2] / m + raw_p[:, j + 3] / m
+        ) / 7.0
+        out = jnp.where(do_smooth, smoothed, scaled)
+        out = jnp.where(skip, col, out)
+        new_carry = jnp.concatenate(
+            [prev3[:, 1:], out[:, None]], axis=1
+        )
+        return new_carry, out
+
+    init = jnp.zeros((n_samples, 3), raw.dtype)
+    _, cols = jax.lax.scan(step, init, jnp.arange(n_bins))
+    return cols.T  # (n_samples, n_bins)
+
+
+def quantize_depths(
+    depths: np.ndarray, bug_compat_u8: bool = False
+) -> np.ndarray:
+    """PCA input quantization.
+
+    The reference computes ``uint8(65535/MaxCN*dp+0.5)`` (indexcov.go:698)
+    — a uint16-scale value truncated into a uint8, which wraps mod 256 for
+    nearly all depths. We default to a non-wrapping uint16 quantization
+    (documented divergence: same intent, no wraparound); set
+    ``bug_compat_u8`` to reproduce the wrapped values exactly.
+    """
+    d = np.minimum(np.asarray(depths, dtype=np.float32), np.float32(MAX_CN))
+    q = (np.float32(65535.0 / MAX_CN) * d + np.float32(0.5))
+    if bug_compat_u8:
+        return q.astype(np.uint16).astype(np.uint8)
+    return q.astype(np.uint16)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def pca_project(mat: jax.Array, k: int = 5) -> tuple[jax.Array, jax.Array]:
+    """Principal-component projection (indexcov.go:773-807).
+
+    gonum's stat.PC column-centers the matrix for the SVD; the reference
+    then projects the *raw* matrix onto the top-k right singular vectors.
+    Returns (proj (n, k), variance fractions (k,)).
+    """
+    x = mat.astype(jnp.float32)
+    centered = x - x.mean(axis=0, keepdims=True)
+    _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+    n = x.shape[0]
+    vars_ = (s * s) / jnp.float32(max(n - 1, 1))
+    frac = vars_ / vars_.sum()
+    proj = x @ vt[:k].T
+    return proj, frac[:k]
+
+
+def update_slopes(rocs: np.ndarray, scalar: float) -> np.ndarray:
+    """Per-sample ROC drop between 1±0.15 scaled depth, chromosome-length
+    weighted (indexcov.go:739-750). rocs: (n_samples, SLOTS)."""
+    n = 0.1
+    ilo = int(0.5 + (SLOTS_MID - n) * SLOTS)
+    ihi = int(0.5 + (SLOTS_MID + n) * SLOTS)
+    return (rocs[:, ilo] - rocs[:, ihi]) * np.float32(scalar)

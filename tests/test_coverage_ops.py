@@ -1,0 +1,96 @@
+"""Coverage kernel tests vs brute-force numpy oracles."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.ops.coverage import (
+    depth_from_segments, windowed_sums, window_bounds, callable_classes,
+    run_length_encode, segment_filter, bucket_size,
+)
+
+
+def brute_depth(segs, L, region_start=0, cap=None):
+    d = np.zeros(L, dtype=np.int64)
+    for s, e in segs:
+        s = max(s - region_start, 0)
+        e = min(e - region_start, L)
+        if e > s:
+            d[s:e] += 1
+    if cap is not None:
+        d = np.minimum(d, cap)
+    return d
+
+
+def test_depth_from_segments_random():
+    rng = np.random.default_rng(42)
+    L = 10_000
+    n = 500
+    s = rng.integers(-100, L + 100, size=n)
+    e = s + rng.integers(1, 300, size=n)
+    keep = np.ones(n, dtype=bool)
+    out = np.asarray(
+        depth_from_segments(s.astype(np.int32), e.astype(np.int32), keep, L)
+    )
+    np.testing.assert_array_equal(out, brute_depth(zip(s, e), L))
+
+
+def test_depth_region_offset_and_cap():
+    s = np.array([100, 150, 150, 150], dtype=np.int32)
+    e = np.array([200, 250, 250, 250], dtype=np.int32)
+    keep = np.ones(4, dtype=bool)
+    out = np.asarray(
+        depth_from_segments(s, e, keep, 100, region_start=120, depth_cap=2)
+    )
+    expect = brute_depth(zip(s, e), 100, region_start=120, cap=2)
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_depth_padding_cancels():
+    # padded (keep=False) segments contribute nothing
+    s = np.array([10, 0], dtype=np.int32)
+    e = np.array([20, 0], dtype=np.int32)
+    keep = np.array([True, False])
+    out = np.asarray(depth_from_segments(s, e, keep, 30))
+    assert out[:10].sum() == 0 and all(out[10:20] == 1)
+
+
+def test_segment_filter():
+    mapq = np.array([0, 10, 60], dtype=np.uint8)
+    flag = np.array([0, 0x400, 0], dtype=np.uint16)
+    seg_read = np.array([0, 1, 2, 2], dtype=np.int32)
+    keep = np.asarray(segment_filter(mapq, flag, seg_read, min_mapq=1))
+    # read0 mapq<1, read1 dup → only read2's two segments survive
+    np.testing.assert_array_equal(keep, [False, False, True, True])
+
+
+def test_windowed_sums_alignment():
+    # region [130, 1020), window 250 → windows absolute-aligned at 0,250,...
+    region_start, region_end, W = 130, 1020, 250
+    starts, ends, lpad, rpad = window_bounds(region_start, region_end, W)
+    np.testing.assert_array_equal(starts, [130, 250, 500, 750, 1000])
+    np.testing.assert_array_equal(ends, [250, 500, 750, 1000, 1020])
+    depth = np.arange(region_end - region_start, dtype=np.int32)
+    sums = np.asarray(
+        windowed_sums(depth, len(depth), W, lpad, rpad)
+    )
+    for i, (s0, e0) in enumerate(zip(starts, ends)):
+        assert sums[i] == depth[s0 - region_start : e0 - region_start].sum()
+
+
+def test_callable_classes_and_rle():
+    depth = np.array([0, 0, 2, 2, 5, 5, 5, 0, 100, 100], dtype=np.int32)
+    cls = np.asarray(callable_classes(depth, 4, 50))
+    np.testing.assert_array_equal(cls, [0, 0, 1, 1, 2, 2, 2, 0, 3, 3])
+    s, e, v = run_length_encode(cls)
+    np.testing.assert_array_equal(s, [0, 2, 4, 7, 8])
+    np.testing.assert_array_equal(e, [2, 4, 7, 8, 10])
+    np.testing.assert_array_equal(v, [0, 1, 2, 0, 3])
+    # max_mean_depth=0 disables EXCESSIVE
+    cls2 = np.asarray(callable_classes(depth, 4, 0))
+    assert cls2[8] == 2
+
+
+def test_bucket_size():
+    assert bucket_size(0) == 1024
+    assert bucket_size(1024) == 1024
+    assert bucket_size(1025) == 2048

@@ -1,0 +1,233 @@
+"""indexcov numerics tests vs independent numpy oracles implementing the
+reference semantics (indexcov/indexcov.go citations in each oracle)."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.ops import indexcov_ops as ic
+
+
+def oracle_median(sizes_flat):
+    # indexcov.go:104-124
+    s = np.sort(np.asarray(sizes_flat, dtype=np.int64))
+    n98 = s[int(0.98 * len(s))]
+    total = 0
+    cumsum = []
+    for v in s:
+        total += min(v, n98)
+        cumsum.append(total)
+    # sort.Search: smallest i with cumsum[i] > total/2 (integer division)
+    half = total // 2
+    idx = next((i for i, c in enumerate(cumsum) if c > half), len(s) - 1)
+    return float(s[min(idx, len(s) - 1)])
+
+
+def test_median_size_per_tile():
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(0, 100000, size=997).astype(np.int64)
+    # plant extreme outliers the 98pct cap must tame
+    sizes[:5] = 10**9
+    got = ic.median_size_per_tile([sizes[:500], sizes[500:]])
+    assert got == oracle_median(sizes)
+
+
+def test_median_skewed_halves():
+    sizes = np.array([1] * 90 + [1000] * 10, dtype=np.int64)
+    assert ic.median_size_per_tile([sizes]) == oracle_median(sizes)
+
+
+def test_normalized_depth_cap():
+    d = ic.normalized_depth(np.array([100, 200, 10**12]), 100.0)
+    assert d.dtype == np.float32
+    np.testing.assert_allclose(d[:2], [1.0, 2.0])
+    assert d[2] == 50000.0
+
+
+def oracle_counts(depths):
+    # indexcov.go:169-177
+    counts = np.zeros(ic.SLOTS, dtype=np.int64)
+    scale = np.float32(ic.SLOTS * np.float32(2.0 / 3.0))
+    for d in depths:
+        v = int(np.float32(d) * scale + np.float32(0.5))
+        counts[min(max(v, 0), ic.SLOTS - 1)] += 1
+    return counts
+
+
+def test_counts_at_depth():
+    rng = np.random.default_rng(1)
+    depths = rng.gamma(4, 0.25, size=(3, 1000)).astype(np.float32)
+    valid = np.ones_like(depths, dtype=bool)
+    valid[2, 800:] = False
+    got = np.asarray(ic.counts_at_depth(depths, valid))
+    for k in range(3):
+        np.testing.assert_array_equal(
+            got[k], oracle_counts(depths[k][valid[k]])
+        )
+    assert got[2].sum() == 800
+
+
+def test_counts_roc():
+    counts = np.zeros((1, ic.SLOTS), dtype=np.int32)
+    counts[0, 10] = 30
+    counts[0, 50] = 70
+    roc = np.asarray(ic.counts_roc(counts))[0]
+    assert roc[0] == 1.0
+    np.testing.assert_allclose(roc[11:51], 0.7)
+    assert roc[51] == 0.0
+
+
+def test_bin_counters():
+    depths = np.array([[1.0, 0.9, 1.2, 0.1, 0.5, 2.0]], dtype=np.float32)
+    valid = np.ones_like(depths, dtype=bool)
+    got = {k: int(v[0]) for k, v in
+           ic.bin_counters(depths, valid, np.int32(8)).items()}
+    # in: 1.0,0.9 → 2; out: 1.2,0.1,0.5,2.0 → 4 (+2 tail) = 6
+    # hi: 1.2,2.0 → 2; low: 0.1 → 1 (+2 tail) = 3
+    assert got == {"in": 2, "out": 6, "hi": 2, "low": 3}
+
+
+def oracle_cn(d, ploidy=2):
+    # indexcov.go:957-991
+    tmp = sorted(x for x in d if x != 0)
+    lows = sum(1 for x in d if x != 0 and x < 0.02)
+    if not tmp:
+        return -0.1
+    if lows / len(d) > 0.3:
+        tmp = tmp[lows:]
+    if not tmp:
+        return 0.0
+    return float(np.float32(ploidy) * np.float32(tmp[int(len(tmp) * 0.4)]))
+
+
+def test_get_cn():
+    rng = np.random.default_rng(2)
+    rows = [
+        rng.gamma(4, 0.25, size=200).astype(np.float32),  # ~1.0 diploid
+        np.concatenate([np.zeros(50), rng.gamma(2, 0.25, 150)]).astype(
+            np.float32
+        ),
+        np.full(200, 0.001, dtype=np.float32),  # all-low (Y in female)
+        np.zeros(200, dtype=np.float32),  # empty
+    ]
+    depths = np.stack(rows)
+    valid = np.ones_like(depths, dtype=bool)
+    got = np.asarray(ic.get_cn(depths, valid))
+    for k, row in enumerate(rows):
+        assert got[k] == pytest.approx(oracle_cn(row), abs=1e-6), k
+
+
+def test_get_cn_ragged():
+    depths = np.zeros((2, 10), dtype=np.float32)
+    depths[0, :5] = [1.0, 1.1, 0.9, 1.05, 0.95]
+    valid = np.zeros_like(depths, dtype=bool)
+    valid[0, :5] = True
+    valid[1, :3] = True
+    got = np.asarray(ic.get_cn(depths, valid))
+    assert got[0] == pytest.approx(oracle_cn(depths[0, :5]))
+    assert got[1] == pytest.approx(-0.1)
+
+
+def oracle_normalize_across(depths_list):
+    # direct transcription of the semantics at indexcov.go:549-597
+    depths = [d.astype(np.float64).copy() for d in depths_list]
+    if len(depths) < 5:
+        return depths
+    max_len = max(len(d) for d in depths)
+    for j in range(max_len):
+        m = 0.0
+        n = 0.0
+        for d in depths:
+            if len(d) > j:
+                m += d[j]
+                n += 1
+                if j > 0:
+                    m += d[j - 1]
+                    n += 1
+                if j < len(d) - 1:
+                    m += d[j + 1]
+                    n += 1
+        if int(n) < 3 * len(depths) - 4:
+            continue
+        m /= n
+        if m < 0.1:
+            continue
+        for d in depths:
+            if len(d) > j:
+                d[j] /= m
+                if 2 < j < len(d) - 3:
+                    d[j] = (
+                        d[j - 3] + d[j - 2] + d[j - 1] + d[j]
+                        + d[j + 1] / m + d[j + 2] / m + d[j + 3] / m
+                    ) / 7.0
+    return depths
+
+
+def test_normalize_across_samples():
+    rng = np.random.default_rng(3)
+    n_samples, n_bins = 6, 40
+    depths = rng.gamma(4, 0.25, size=(n_samples, n_bins)).astype(np.float32)
+    lengths = np.full(n_samples, n_bins, dtype=np.int32)
+    lengths[5] = 35  # one ragged sample
+    masked = depths.copy()
+    masked[5, 35:] = 0
+    got = np.asarray(ic.normalize_across_samples(masked, lengths))
+    want = oracle_normalize_across(
+        [depths[i, : lengths[i]] for i in range(n_samples)]
+    )
+    for i in range(n_samples):
+        np.testing.assert_allclose(
+            got[i, : lengths[i]], want[i], rtol=2e-4, atol=2e-5
+        )
+
+
+def test_normalize_across_samples_few_samples_noop():
+    depths = np.ones((3, 10), dtype=np.float32)
+    out = np.asarray(
+        ic.normalize_across_samples(depths, np.full(3, 10, np.int32))
+    )
+    np.testing.assert_array_equal(out, depths)
+
+
+def test_pca_project():
+    rng = np.random.default_rng(4)
+    # low-rank structure + noise
+    base = rng.normal(size=(2, 50))
+    weights = rng.normal(size=(20, 2))
+    mat = (weights @ base + 0.01 * rng.normal(size=(20, 50))).astype(
+        np.float32
+    )
+    proj, frac = ic.pca_project(mat, k=5)
+    proj, frac = np.asarray(proj), np.asarray(frac)
+    assert proj.shape == (20, 5)
+    # two dominant components explain nearly everything
+    assert frac[0] + frac[1] > 0.98
+    # projection must match raw @ top right-singular-vectors of centered mat
+    centered = mat - mat.mean(axis=0)
+    _, _, vt = np.linalg.svd(centered, full_matrices=False)
+    want = mat @ vt[:5].T
+    # signs are arbitrary per component
+    for j in range(5):
+        assert np.allclose(proj[:, j], want[:, j], atol=2e-2) or np.allclose(
+            proj[:, j], -want[:, j], atol=2e-2
+        )
+
+
+def test_update_slopes():
+    rocs = np.zeros((2, ic.SLOTS), dtype=np.float32)
+    ilo = int(0.5 + (ic.SLOTS_MID - 0.1) * ic.SLOTS)
+    ihi = int(0.5 + (ic.SLOTS_MID + 0.1) * ic.SLOTS)
+    rocs[0, ilo], rocs[0, ihi] = 0.9, 0.4
+    got = ic.update_slopes(rocs, 2.0)
+    assert got[0] == pytest.approx(1.0)
+    assert got[1] == 0.0
+
+
+def test_quantize_depths():
+    d = np.array([0.0, 1.0, 8.0, 9.0], dtype=np.float32)
+    q = ic.quantize_depths(d)
+    assert q.dtype == np.uint16
+    assert q[0] == 0 and q[2] == 65535 and q[3] == 65535
+    q8 = ic.quantize_depths(d, bug_compat_u8=True)
+    assert q8.dtype == np.uint8
+    # wrapped mod-256 values as the reference computes (indexcov.go:698)
+    assert q8[1] == int(65535 / 8 * 1.0 + 0.5) % 256
