@@ -1,0 +1,77 @@
+"""goleft-tpu: subcommand dispatcher.
+
+Mirrors the reference's command-plugin table (cmd/goleft/goleft.go:24-31):
+a name → (help, main) registry; unknown or missing subcommands print the
+sorted table. New tools register by adding one entry.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import __version__
+
+
+def _lazy(module: str):
+    def runner(argv):
+        import importlib
+
+        mod = importlib.import_module(module, package=__package__)
+        return mod.main(argv)
+
+    return runner
+
+
+PROGS = {
+    "depth": ("parallelize calls to the TPU depth engine",
+              _lazy(".commands.depth")),
+    "depthwed": ("matricize depth bed files to n-sites * n-samples",
+                 _lazy(".commands.depthwed")),
+    "covstats": ("coverage and insert-size statistics by sampling",
+                 _lazy(".commands.covstats")),
+    "indexcov": ("quick coverage estimate using only the bam/cram index",
+                 _lazy(".commands.indexcov")),
+    "indexsplit": ("create regions of even data size across bams/crams",
+                   _lazy(".commands.indexsplit")),
+    "samplename": ("report samples in a bam file", _lazy(".commands.samplename")),
+    "emdepth": ("EM copy-number calls from a depth matrix",
+                _lazy(".commands.emdepth_cmd")),
+    "multidepth": ("joint depth over many bams with min-coverage blocks",
+                   _lazy(".commands.multidepth")),
+    "dcnv": ("GC-debias + normalize a depth matrix", _lazy(".commands.dcnv_cmd")),
+    "cnveval": ("evaluate CNV calls against a truth set",
+                _lazy(".commands.cnveval_cmd")),
+    "bench": ("run the TPU benchmark suite", _lazy(".commands.bench_cmd")),
+}
+
+
+def usage() -> str:
+    lines = [
+        f"goleft-tpu Version: {__version__}",
+        "",
+    ]
+    for name in sorted(PROGS):
+        lines.append(f"{name:<11}: {PROGS[name][0]}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(usage(), file=sys.stderr)
+        return 0
+    if argv[0] in ("-v", "--version", "version"):
+        print(__version__)
+        return 0
+    prog = argv[0]
+    if prog not in PROGS:
+        print(f"unknown subcommand: {prog}\n", file=sys.stderr)
+        print(usage(), file=sys.stderr)
+        return 1
+    sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
+    ret = PROGS[prog][1](argv[1:])
+    return int(ret or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,490 @@
+"""indexcov: whole-cohort coverage QC from .bai/.crai indexes only.
+
+TPU-native rebuild of the reference flagship (indexcov/indexcov.go, 1078
+LoC). Host work is index parsing (io.bai/io.crai) and report writing; the
+per-bin numerics — histogram/ROC, bin counters, copy number, cross-sample
+normalization, PCA — run as batched JAX kernels over a padded
+(samples × bins) matrix per chromosome (ops/indexcov_ops.py), instead of
+the reference's per-sample Go loops (indexcov.go:599-734).
+
+Output surface matches the reference: <dir>/<name>-indexcov.bed.gz (per-
+16KB-bin scaled depths), .roc, .ped (sex/CN/bin-QC/slope/PCA columns,
+indexcov.go:815-953), per-chromosome -depth-<chrom>.html/png and
+-roc-<chrom>.html/png, and index.html.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import gzip
+import logging
+import os
+import re
+import sys
+
+import numpy as np
+
+from ..io.bai import read_bai
+from ..io.bam import BamReader
+from ..io.bgzf import BgzfWriter
+from ..io.crai import read_crai
+from ..io.fai import read_fai
+from ..ops import indexcov_ops as ops
+from ..utils import report
+
+log = logging.getLogger("goleft-tpu.indexcov")
+
+DEFAULT_EXCLUDE = r"^chrEBV$|^NC|_random$|Un_|^HLA\-|_alt$|hap\d$"
+MAX_SAMPLES = 100  # above this, interactive depth plots are skipped
+TILE = 16384
+
+
+class SampleIndex:
+    """Parsed index: per-chromosome tile sizes + scaling median.
+
+    Mirrors the reference's Index wrapper (indexcov.go:57-67,83-125).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        if path.endswith(".crai"):
+            self.sizes = read_crai(path).sizes()
+            self.mapped = 0
+            self.unmapped = 0
+        else:
+            bai_path = path
+            if not path.endswith(".bai"):
+                bai_path = path + ".bai"
+                if not os.path.exists(bai_path):
+                    bai_path = path[:-4] + ".bai"
+            idx = read_bai(bai_path)
+            self.sizes = idx.sizes()
+            self.mapped = idx.mapped_total
+            self.unmapped = idx.unmapped_total
+        self.median = ops.median_size_per_tile(self.sizes)
+
+    def normalized_depth(self, ref_id: int) -> np.ndarray:
+        if ref_id >= len(self.sizes):
+            return np.zeros(0, dtype=np.float32)
+        return ops.normalized_depth(self.sizes[ref_id], self.median)
+
+
+def get_short_name(path: str) -> str:
+    """Sample name: unique SM tag from the BAM header when available,
+    else derived from the filename (indexcov.go:213-246)."""
+    if not path.endswith((".crai", ".bai")):
+        try:
+            names = BamReader.from_file(path).header.sample_names()
+            if len(names) > 1:
+                raise ValueError(f"more than one RG SM for {path}")
+            if names:
+                return names[0]
+        except (OSError, ValueError):
+            pass
+    base = path.rsplit("/", 1)[-1]
+    parts = base.split(".")
+    if len(parts) <= 2:
+        return parts[0]
+    return "-".join(parts[:-1])
+
+
+def references(
+    bams: list[str], fai: str | None, chrom: str = ""
+) -> list[tuple[int, str, int]]:
+    """(ref_id, name, length) list from an .fai (required for crai inputs)
+    or the first BAM's header (indexcov.go:276-342). ref_id is the position
+    in the full reference dictionary — the key into per-sample size arrays
+    — even when ``chrom`` restricts the output."""
+    if fai:
+        recs = read_fai(fai)
+        refs = [(i, r.name, r.length) for i, r in enumerate(recs)]
+    else:
+        path = next((b for b in bams if not b.endswith((".crai", ".bai"))),
+                    None)
+        if path is None:
+            raise SystemExit(
+                "indexcov: --fai is required when only index files are given"
+            )
+        h = BamReader.from_file(path).header
+        refs = [(i, n, l)
+                for i, (n, l) in enumerate(zip(h.ref_names, h.ref_lens))]
+    if chrom:
+        want = chrom[3:] if chrom.startswith("chr") else chrom
+        refs = [
+            (i, n, l) for i, n, l in refs
+            if n == chrom or (n[3:] if n.startswith("chr") else n) == want
+        ]
+        if not refs:
+            raise SystemExit(f"indexcov: chromosome {chrom} not found")
+    return refs
+
+
+def expand_globs(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def _pad_rows(rows: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray,
+                                               np.ndarray]:
+    """ragged float32 rows → (matrix, valid mask, lengths)."""
+    n = len(rows)
+    longest = max((len(r) for r in rows), default=0)
+    mat = np.zeros((n, max(longest, 1)), dtype=np.float32)
+    valid = np.zeros_like(mat, dtype=bool)
+    lengths = np.zeros(n, dtype=np.int32)
+    for i, r in enumerate(rows):
+        mat[i, : len(r)] = r
+        valid[i, : len(r)] = True
+        lengths[i] = len(r)
+    return mat, valid, lengths
+
+
+def run_indexcov(
+    bams: list[str],
+    directory: str,
+    sex: str = "X,Y",
+    exclude_patt: str = DEFAULT_EXCLUDE,
+    chrom: str = "",
+    fai: str | None = None,
+    extra_normalize: bool = False,
+    include_gl: bool = False,
+    write_html: bool = True,
+    write_png: bool = True,
+) -> dict:
+    os.makedirs(directory, exist_ok=True)
+    sex_chroms = [s for s in sex.split(",") if s] if sex else []
+    exclude = re.compile(exclude_patt) if exclude_patt else None
+
+    bams = expand_globs(bams)
+    refs = references(bams, fai, chrom)
+    log.info("running on %d indexes", len(bams))
+    idxs = [SampleIndex(b) for b in bams]
+    names = [get_short_name(b) for b in bams]
+    n_samples = len(idxs)
+
+    name = os.path.basename(os.path.abspath(directory))
+    base = os.path.join(directory, name + "-indexcov")
+
+    bed_fh = open(base + ".bed.gz", "wb")
+    bed = BgzfWriter(bed_fh, level=1)
+    bed.write(("#chrom\tstart\tend\t" + "\t".join(names) + "\n").encode())
+    roc_fh = open(base + ".roc", "w")
+    roc_fh.write("#chrom\tcov\t" + "\t".join(names) + "\n")
+
+    sexes: dict[str, np.ndarray] = {}
+    pca_blocks: list[np.ndarray] = []
+    totals = {"in": 0, "out": 0, "hi": 0, "low": 0}
+    counters = {
+        k: np.zeros(n_samples, dtype=np.int64) for k in totals
+    }
+    slopes = np.zeros(n_samples, dtype=np.float32)
+    n_slopes = 0
+    chrom_names: list[str] = []
+    ir = -1
+
+    for ref_id, ref_name, ref_len in refs:
+        if exclude is not None and exclude.search(ref_name):
+            continue
+        ir += 1
+        rows = [idx.normalized_depth(ref_id) for idx in idxs]
+        mat, valid, lengths = _pad_rows(rows)
+        longest = int(lengths.max())
+        is_sex = _same_chrom(sex_chroms, ref_name)
+
+        if extra_normalize and not is_sex and n_samples >= 5:
+            mat = np.asarray(ops.normalize_across_samples(mat, lengths))
+            mat = np.where(valid, mat, 0.0)
+
+        counts = np.asarray(ops.counts_at_depth(mat, valid))
+
+        # bed.gz rows: longest sample defines row count; shorter samples
+        # print 0 (indexcov.go:678-680, depthsFor :1038-1048)
+        for i in range(longest):
+            vals = "\t".join(
+                "%.3g" % mat[k, i] if lengths[k] > i else "0"
+                for k in range(n_samples)
+            )
+            bed.write(
+                f"{ref_name}\t{i * TILE}\t{(i + 1) * TILE}\t{vals}\n".encode()
+            )
+
+        if is_sex:
+            if longest > 0:
+                sexes[ref_name] = np.asarray(ops.get_cn(mat, valid))
+        else:
+            # cap at MaxCN before quantization (indexcov.go:694-698);
+            # missing tail bins quantize to 0
+            capped = np.where(valid, np.minimum(mat, ops.MAX_CN), 0.0)
+            q = ops.quantize_depths(capped)
+            q[~valid] = 0
+            pca_blocks.append(q[:, :max(longest, 0)])
+            c = ops.bin_counters(mat, valid, np.int32(longest))
+            for k in counters:
+                counters[k] += np.asarray(c[k], dtype=np.int64)
+
+        if longest > 0:
+            rocs = np.asarray(ops.counts_roc(counts))
+            for i in range(ops.SLOTS):
+                cov = i / (ops.SLOTS * ops.SLOTS_MID)
+                roc_fh.write(
+                    f"{ref_name}\t{cov:.2f}\t"
+                    + "\t".join("%.2f" % rocs[k, i]
+                                for k in range(n_samples))
+                    + "\n"
+                )
+            if (include_gl or not ref_name.startswith("GL")) and longest > 2:
+                if not is_sex and longest > 100:
+                    slopes += ops.update_slopes(rocs, ref_len / 1e6)
+                    n_slopes += 1
+                chrom_names.append(ref_name)
+                if write_html:
+                    _plot_depth_chrom(
+                        base, ref_name, mat, lengths, names,
+                        interactive=n_samples <= MAX_SAMPLES,
+                        write_png=write_png,
+                    )
+                    _plot_roc_chrom(base, ref_name, rocs, names,
+                                    write_png=write_png)
+
+    bed.close()
+    bed_fh.close()
+    roc_fh.close()
+    if n_slopes > 0:
+        slopes = slopes / np.float32(n_slopes)
+    _check_sexes(sexes, sex_chroms)
+
+    # PCA over autosome bins (indexcov.go:773-807)
+    pcs = None
+    var_frac = None
+    if pca_blocks:
+        pca_mat = np.concatenate(pca_blocks, axis=1).astype(np.float32)
+        if pca_mat.shape[1] >= 3 and n_samples >= 3:
+            proj, frac = ops.pca_project(pca_mat, k=5)
+            pcs, var_frac = np.asarray(proj), np.asarray(frac)
+
+    ped_path = _write_ped(
+        base, directory, sexes, counters, names, slopes, pcs,
+        [i.mapped for i in idxs], [i.unmapped for i in idxs],
+    )
+    if write_html:
+        _write_index_html(
+            directory, base, name, sexes, counters, names, pcs, var_frac,
+            [i.mapped for i in idxs], [i.unmapped for i in idxs],
+            chrom_names, write_png=write_png,
+        )
+        log.info("indexcov finished: see %s/index.html", directory)
+    return {
+        "sexes": sexes,
+        "counters": counters,
+        "slopes": slopes,
+        "pcs": pcs,
+        "ped": ped_path,
+        "bed": base + ".bed.gz",
+        "roc": base + ".roc",
+        "chrom_names": chrom_names,
+    }
+
+
+def _same_chrom(sex_chroms: list[str], chrom: str) -> bool:
+    # tolerate chr-prefix mismatches (indexcov.go:526-547)
+    for a in sex_chroms:
+        if a == chrom:
+            return True
+        na = "chr" + a if not a.startswith("chr") else a[3:]
+        if na == chrom:
+            return True
+    return False
+
+
+def _check_sexes(obs: dict, exp: list[str]) -> None:
+    if len(obs) != len(exp):
+        msg = (
+            f"indexcov: expected {len(exp)} sex chromosomes, found: "
+            f"{len(obs)}. you can set the expected with --sex "
+            f"'{','.join(obs)}'"
+        )
+        if len(obs) == 0 and exp != ["X", "Y"]:
+            raise SystemExit("(FATAL) " + msg)
+        print("(WARNING) " + msg, file=sys.stderr)
+
+
+def _write_ped(base, directory, sexes, counters, samples, slopes, pcs,
+               mapped, unmapped) -> str:
+    """.ped columns per indexcov.go:815-894."""
+    keys = sorted(sexes)
+    hdr = ["CN" + k for k in keys]
+    hdr += ["bins.out", "bins.lo", "bins.hi", "bins.in", "slope", "p.out"]
+    n_pc = 0
+    if pcs is not None:
+        n_pc = min(5, pcs.shape[1])
+        hdr += [f"PC{i + 1}" for i in range(n_pc)]
+    has_map = any(m > 0 for m in mapped) or any(u > 0 for u in unmapped)
+    if has_map:
+        hdr += ["mapped", "unmapped"]
+    path = base + ".ped"
+    with open(path, "w") as f:
+        f.write(
+            "#family_id\tsample_id\tpaternal_id\tmaternal_id\tsex\t"
+            "phenotype\t" + "\t".join(hdr) + "\n"
+        )
+        for i, s in enumerate(samples):
+            inferred = (
+                int(0.5 + sexes[keys[0]][i]) if keys else -9
+            )
+            row = ["unknown", s, "-9", "-9", str(inferred), "-9"]
+            row += ["%.2f" % sexes[k][i] for k in keys]
+            out, lo = counters["out"][i], counters["low"][i]
+            hi, inn = counters["hi"][i], counters["in"][i]
+            row += [str(out), str(lo), str(hi), str(inn),
+                    "%.3f" % slopes[i],
+                    "%.2f" % (out / inn if inn else float("inf"))]
+            if pcs is not None:
+                row += ["%.2f" % pcs[i, j] for j in range(n_pc)]
+            if has_map:
+                row += [str(mapped[i]), str(unmapped[i])]
+            f.write("\t".join(row) + "\n")
+    return path
+
+
+def _plot_depth_chrom(base, chrom, mat, lengths, names, interactive,
+                      write_png):
+    x = [i * TILE for i in range(mat.shape[1])]
+    width = 0.4 if len(names) <= 30 else (0.3 if len(names) <= 50 else 0.2)
+    series = [
+        {"label": names[k], "x": x[: lengths[k]],
+         "y": mat[k, : lengths[k]].tolist(), "width": width}
+        for k in range(len(names))
+    ]
+    if interactive:
+        div, js = report.line_chart(
+            "depth", series, f"position on {chrom}", "scaled coverage",
+            y_max=2.5,
+        )
+        report.write_page(
+            f"{base}-depth-{chrom}.html", f"depth {chrom}", [(div, js)],
+            nav_html='<nav><a href="index.html">back to index</a></nav>',
+        )
+    if write_png:
+        sub = 1 + len(x) // 2000
+        report.save_png(f"{base}-depth-{chrom}.png", series,
+                        f"position on {chrom}", "scaled coverage",
+                        y_max=2.5, subsample=sub)
+
+
+def _plot_roc_chrom(base, chrom, rocs, names, write_png):
+    x = [i / (ops.SLOTS * ops.SLOTS_MID) for i in range(ops.SLOTS)]
+    series = [
+        {"label": names[k], "x": x, "y": rocs[k].tolist()}
+        for k in range(len(names))
+    ]
+    div, js = report.line_chart(
+        "roc", series, "scaled coverage", "proportion of regions covered",
+        legend=False, stepped=False,
+    )
+    report.write_page(
+        f"{base}-roc-{chrom}.html", f"ROC {chrom}", [(div, js)],
+        nav_html='<nav><a href="index.html">back to index</a></nav>',
+    )
+    if write_png:
+        report.save_png(f"{base}-roc-{chrom}.png", series,
+                        "scaled coverage", "proportion of regions covered")
+
+
+def _write_index_html(directory, base, name, sexes, counters, samples, pcs,
+                      var_frac, mapped, unmapped, chrom_names, write_png):
+    charts = []
+    keys = sorted(sexes)
+    if len(keys) >= 2:
+        pts = [{
+            "label": "samples",
+            "x": sexes[keys[0]].tolist(),
+            "y": sexes[keys[1]].tolist(),
+            "names": samples,
+        }]
+        charts.append(report.scatter_chart(
+            "sex", pts, f"inferred copy number for {keys[0]}",
+            f"inferred copy number for {keys[1]}"))
+        if write_png:
+            report.save_png(f"{base}-sex.png", pts,
+                            f"CN {keys[0]}", f"CN {keys[1]}", kind="scatter")
+    inn = np.maximum(counters["in"], 1)
+    pts_bins = [{
+        "label": "samples",
+        "x": counters["in"].tolist(),
+        "y": counters["out"].tolist(),
+        "names": samples,
+    }]
+    charts.append(report.scatter_chart(
+        "bins", pts_bins, "bins with depth in (0.85, 1.15)",
+        "bins with depth outside (0.85, 1.15)"))
+    if pcs is not None and var_frac is not None:
+        charts.append(report.scatter_chart(
+            "pca12",
+            [{"label": "samples", "x": pcs[:, 0].tolist(),
+              "y": pcs[:, 1].tolist(), "names": samples}],
+            f"PC1 ({100 * var_frac[0]:.1f}%% variance)",
+            f"PC2 ({100 * var_frac[1]:.1f}%% variance)"))
+        if pcs.shape[1] > 2:
+            charts.append(report.scatter_chart(
+                "pca13",
+                [{"label": "samples", "x": pcs[:, 0].tolist(),
+                  "y": pcs[:, 2].tolist(), "names": samples}],
+                "PC1", f"PC3 ({100 * var_frac[2]:.1f}%% variance)"))
+    if any(mapped) or any(unmapped):
+        charts.append(report.scatter_chart(
+            "mapped",
+            [{"label": "samples", "x": [float(m) for m in mapped],
+              "y": [float(u) for u in unmapped], "names": samples}],
+            "mapped reads", "unmapped reads"))
+    links = "".join(
+        f'<li><a href="{os.path.basename(base)}-depth-{c}.html">depth {c}'
+        f'</a> / <a href="{os.path.basename(base)}-roc-{c}.html">ROC {c}'
+        f"</a></li>"
+        for c in chrom_names
+    )
+    extra = f"<h2>chromosomes</h2><ul>{links}</ul>"
+    report.write_page(
+        os.path.join(directory, "index.html"),
+        f"indexcov: {name}", charts, extra_html=extra,
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu indexcov",
+        description="cohort coverage QC from BAM/CRAM indexes only",
+    )
+    p.add_argument("-d", "--directory", required=True,
+                   help="directory for output files")
+    p.add_argument("-e", "--includegl", action="store_true",
+                   help="plot GL chromosomes")
+    p.add_argument("--excludepatt", default=DEFAULT_EXCLUDE,
+                   help="regex of chromosomes to exclude")
+    p.add_argument("-X", "--sex", default="X,Y",
+                   help="comma-delimited sex chromosomes ('' for none)")
+    p.add_argument("-c", "--chrom", default="",
+                   help="optional chromosome to restrict")
+    p.add_argument("-f", "--fai", default=None,
+                   help="fasta index; required for crais")
+    p.add_argument("-n", "--extranormalize", action="store_true",
+                   help="normalize across samples (recommended for CRAI)")
+    p.add_argument("--no-html", action="store_true",
+                   help="skip html/png reports")
+    p.add_argument("bam", nargs="+", help="bam(s)/bai(s)/crai(s)")
+    a = p.parse_args(argv)
+    run_indexcov(
+        a.bam, a.directory, sex=a.sex, exclude_patt=a.excludepatt,
+        chrom=a.chrom, fai=a.fai, extra_normalize=a.extranormalize,
+        include_gl=a.includegl, write_html=not a.no_html,
+        write_png=not a.no_html,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -349,8 +349,9 @@ class BamWriter:
     """Minimal BAM writer for fabricating hermetic test fixtures."""
 
     def __init__(self, fh, header_text: str, ref_names: list[str],
-                 ref_lens: list[int]):
-        self._w = BgzfWriter(fh)
+                 ref_lens: list[int], level: int = 6,
+                 block_size: int = 0xFF00):
+        self._w = BgzfWriter(fh, level=level, block_size=block_size)
         self.ref_names = ref_names
         text = header_text.encode()
         self._w.write(BAM_MAGIC + struct.pack("<i", len(text)) + text)

@@ -147,17 +147,23 @@ class BgzfReader:
 
 
 class BgzfWriter:
-    """Streaming BGZF writer (used for .bam fixtures and bed.gz outputs)."""
+    """Streaming BGZF writer (used for .bam fixtures and bed.gz outputs).
 
-    def __init__(self, fh: BinaryIO, level: int = 6):
+    ``block_size`` caps uncompressed bytes per block — small blocks give
+    test fixtures realistic multi-block-per-tile BAI linear indexes.
+    """
+
+    def __init__(self, fh: BinaryIO, level: int = 6,
+                 block_size: int = WRITE_CHUNK):
         self._fh = fh
         self._level = level
+        self._chunk = min(block_size, WRITE_CHUNK)
         self._buf = bytearray()
 
     def write(self, data: bytes) -> None:
         self._buf += data
-        while len(self._buf) >= WRITE_CHUNK:
-            self._flush_block(WRITE_CHUNK)
+        while len(self._buf) >= self._chunk:
+            self._flush_block(self._chunk)
 
     def _flush_block(self, n: int) -> None:
         chunk = bytes(self._buf[:n])
@@ -178,7 +184,7 @@ class BgzfWriter:
 
     def close(self) -> None:
         while self._buf:
-            self._flush_block(min(len(self._buf), WRITE_CHUNK))
+            self._flush_block(min(len(self._buf), self._chunk))
         self._fh.write(BGZF_EOF)
 
     def __enter__(self):

@@ -1,0 +1,213 @@
+"""Interactive HTML (chart.js) + static PNG (matplotlib) report writers.
+
+Covers the reference's reporting layer (indexcov/plot.go, 577 LoC +
+indexcov/template.go) with our own template: each page is a self-contained
+HTML document loading chart.js from a CDN, mirroring the reference's output
+surface (<base>-depth-<chrom>.html, <base>-roc-<chrom>.html, index.html,
+and .png twins). Honors the same environment knobs: INDEXCOV_FMT (extra
+static formats, plot.go:528-536).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<script src="https://cdn.jsdelivr.net/npm/chart.js@2.9.4/dist/Chart.min.js"></script>
+<style>
+body {{ font-family: sans-serif; margin: 20px; }}
+.chartbox {{ display: inline-block; margin: 10px; }}
+h2 {{ font-weight: normal; }}
+nav a {{ margin-right: 12px; }}
+</style></head>
+<body>
+{nav}
+{body}
+<script>
+{scripts}
+</script>
+</body></html>
+"""
+
+
+def _color(i: int, background: bool = False) -> str:
+    if background:
+        return "rgba(180,180,180,0.94)"
+    rng = random.Random(i)
+    return f"rgba({rng.randrange(256)},{rng.randrange(256)},{rng.randrange(256)},0.94)"
+
+
+def line_chart(
+    chart_id: str,
+    series: list[dict],
+    xlabel: str,
+    ylabel: str,
+    y_max: float | None = None,
+    stepped: bool = True,
+    legend: bool = True,
+) -> tuple[str, str]:
+    """Return (div html, js) for a multi-series line chart.
+
+    series entries: {"label", "x": list, "y": list, optional "color"}.
+    """
+    datasets = []
+    for i, s in enumerate(series):
+        data = [
+            {"x": round(float(x), 4), "y": round(float(y), 4)}
+            for x, y in zip(s["x"], s["y"])
+        ]
+        datasets.append(
+            {
+                "label": s["label"],
+                "data": data,
+                "fill": False,
+                "pointRadius": 0,
+                "borderWidth": s.get("width", 0.75),
+                "borderColor": s.get("color", _color(i)),
+                "backgroundColor": s.get("color", _color(i)),
+                "steppedLine": stepped,
+                "pointHitRadius": 6,
+            }
+        )
+    opts = {
+        "responsive": False,
+        "animation": False,
+        "legend": {"display": legend},
+        "tooltips": {"mode": "nearest"},
+        "scales": {
+            "xAxes": [
+                {
+                    "type": "linear",
+                    "position": "bottom",
+                    "scaleLabel": {"display": True, "labelString": xlabel,
+                                   "fontSize": 16},
+                }
+            ],
+            "yAxes": [
+                {
+                    "type": "linear",
+                    "position": "left",
+                    "ticks": ({"min": 0, "max": y_max} if y_max else {}),
+                    "scaleLabel": {"display": True, "labelString": ylabel,
+                                   "fontSize": 16},
+                }
+            ],
+        },
+    }
+    div = (
+        f'<div class="chartbox"><canvas id="{chart_id}" width="850" '
+        f'height="550"></canvas></div>'
+    )
+    js = (
+        f'new Chart(document.getElementById("{chart_id}").getContext("2d"),'
+        f'{{"type":"line","data":{{"datasets":{json.dumps(datasets)}}},'
+        f'"options":{json.dumps(opts)}}});'
+    )
+    return div, js
+
+
+def scatter_chart(
+    chart_id: str,
+    points: list[dict],
+    xlabel: str,
+    ylabel: str,
+    labels: list[str] | None = None,
+) -> tuple[str, str]:
+    """points: [{"label", "x": [..], "y": [..], "names": [..]}] groups."""
+    datasets = []
+    for i, g in enumerate(points):
+        data = [
+            {"x": round(float(x), 4), "y": round(float(y), 4)}
+            for x, y in zip(g["x"], g["y"])
+        ]
+        datasets.append(
+            {
+                "label": g["label"],
+                "data": data,
+                "pointRadius": 4,
+                "pointHitRadius": 6,
+                "showLine": False,
+                "fill": False,
+                "backgroundColor": g.get("color", _color(i + 7)),
+                "borderColor": g.get("color", _color(i + 7)),
+            }
+        )
+    names = json.dumps([g.get("names", []) for g in points])
+    opts = {
+        "responsive": False,
+        "animation": False,
+        "tooltips": {"mode": "nearest"},
+        "scales": {
+            "xAxes": [{"type": "linear", "position": "bottom",
+                       "scaleLabel": {"display": True,
+                                      "labelString": xlabel}}],
+            "yAxes": [{"type": "linear", "position": "left",
+                       "scaleLabel": {"display": True,
+                                      "labelString": ylabel}}],
+        },
+    }
+    div = (
+        f'<div class="chartbox"><canvas id="{chart_id}" width="650" '
+        f'height="550"></canvas></div>'
+    )
+    js = (
+        f'(function(){{var names={names};'
+        f'var cfg={{"type":"scatter","data":{{"datasets":'
+        f'{json.dumps(datasets)}}},"options":{json.dumps(opts)}}};'
+        f'cfg.options.tooltips.callbacks={{label:function(t,d){{'
+        f'return (names[t.datasetIndex][t.index]||"")+" ("+t.xLabel+", "+'
+        f't.yLabel+")";}}}};'
+        f'new Chart(document.getElementById("{chart_id}").getContext("2d"),'
+        f"cfg);}})();"
+    )
+    return div, js
+
+
+def write_page(path: str, title: str, charts: list[tuple[str, str]],
+               nav_html: str = "", extra_html: str = "") -> None:
+    body = "\n".join(div for div, _ in charts) + extra_html
+    scripts = "\n".join(js for _, js in charts)
+    with open(path, "w") as fh:
+        fh.write(
+            _PAGE.format(title=title, nav=nav_html, body=body,
+                         scripts=scripts)
+        )
+
+
+def save_png(path: str, series: list[dict], xlabel: str, ylabel: str,
+             y_max: float | None = None, kind: str = "line",
+             subsample: int = 1) -> None:
+    """Static twin of the html charts via matplotlib (replaces the
+    reference's gonum/plot PNGs with 1/5-1/10 subsampling, plot.go:484-487).
+    """
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:  # pragma: no cover - matplotlib always in image
+        return
+    fig, ax = plt.subplots(figsize=(4, 3), dpi=120)
+    for i, s in enumerate(series):
+        x = s["x"][::subsample]
+        y = s["y"][::subsample]
+        if kind == "line":
+            ax.step(x, y, lw=0.5, where="post")
+        else:
+            ax.plot(x, y, "o", ms=3)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    if y_max is not None:
+        ax.set_ylim(0, y_max)
+    fig.tight_layout()
+    fmts = [path]
+    extra = os.environ.get("INDEXCOV_FMT", "")
+    if extra:
+        base = path.rsplit(".", 1)[0]
+        fmts += [f"{base}.{e}" for e in extra.split(",") if e]
+    for p in fmts:
+        fig.savefig(p)
+    plt.close(fig)

@@ -21,12 +21,17 @@ HEADER_TEXT = (
 
 
 def write_bam(path: str, reads, ref_names=("chr1", "chr2"),
-              ref_lens=(100000, 50000), header_text: str = HEADER_TEXT):
+              ref_lens=(100000, 50000), header_text: str = HEADER_TEXT,
+              level: int = 0, block_size: int = 2048):
     """reads: list of (tid, pos, cigar_str, mapq, flag) tuples,
-    must be coordinate-sorted."""
+    must be coordinate-sorted.
+
+    Defaults to stored (level-0) small BGZF blocks so tiny fixtures still
+    exercise multi-block-per-tile BAI linear indexes the way real BAMs do.
+    """
     with open(path, "wb") as fh:
-        with BamWriter(fh, header_text, list(ref_names),
-                       list(ref_lens)) as w:
+        with BamWriter(fh, header_text, list(ref_names), list(ref_lens),
+                       level=level, block_size=block_size) as w:
             for i, (tid, pos, cig, mapq, flag) in enumerate(reads):
                 w.write_record(tid, pos, parse_cigar(cig), mapq=mapq,
                                flag=flag, name=f"r{i:05d}")

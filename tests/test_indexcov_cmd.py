@@ -1,0 +1,134 @@
+"""End-to-end indexcov on a fabricated 6-sample cohort (3 'male' with half-
+coverage X+Y, 3 'female' with full X, empty Y)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from goleft_tpu.commands.indexcov import run_indexcov, get_short_name
+from helpers import write_bam_and_bai, random_reads
+
+REFS = ("chr1", "X", "Y")
+LENS = (1_000_000, 400_000, 200_000)
+
+
+def _header(sample):
+    sq = "".join(
+        f"@SQ\tSN:{n}\tLN:{l}\n" for n, l in zip(REFS, LENS)
+    )
+    return f"@HD\tVN:1.6\tSO:coordinate\n{sq}@RG\tID:rg\tSM:{sample}\n"
+
+
+def make_cohort(tmp_path, n_m=3, n_f=3, depth_reads=4000):
+    paths = []
+    rng = np.random.default_rng(7)
+    for i in range(n_m + n_f):
+        male = i < n_m
+        sample = f"s{'M' if male else 'F'}{i}"
+        reads = random_reads(rng, depth_reads, 0, LENS[0])
+        x_n = depth_reads * LENS[1] // LENS[0]
+        reads += random_reads(rng, x_n // 2 if male else x_n, 1, LENS[1])
+        if male:
+            reads += random_reads(
+                rng, depth_reads * LENS[2] // LENS[0] // 2, 2, LENS[2]
+            )
+        p = str(tmp_path / f"{sample}.bam")
+        write_bam_and_bai(p, reads, ref_names=REFS, ref_lens=LENS,
+                          header_text=_header(sample))
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def cohort_result(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cohort")
+    paths = make_cohort(tmp)
+    outdir = str(tmp / "out")
+    res = run_indexcov(paths, outdir, write_png=False)
+    return paths, outdir, res
+
+
+def test_outputs_exist(cohort_result):
+    _, outdir, res = cohort_result
+    name = os.path.basename(outdir)
+    for suffix in (".bed.gz", ".ped", ".roc"):
+        assert os.path.exists(
+            os.path.join(outdir, f"{name}-indexcov{suffix}")
+        )
+    assert os.path.exists(os.path.join(outdir, "index.html"))
+    assert os.path.exists(
+        os.path.join(outdir, f"{name}-indexcov-depth-chr1.html")
+    )
+
+
+def test_bed_matrix(cohort_result):
+    paths, outdir, res = cohort_result
+    with gzip.open(res["bed"], "rt") as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        rows = [line.rstrip("\n").split("\t") for line in fh]
+    assert header[:3] == ["#chrom", "start", "end"]
+    assert header[3:] == [f"sM{i}" for i in range(3)] + [
+        f"sF{i}" for i in range(3, 6)
+    ]
+    chroms = {r[0] for r in rows}
+    assert "chr1" in chroms and "X" in chroms
+    # bins are 16384-aligned and depth values ~1 on chr1
+    chr1 = np.array(
+        [[float(v) for v in r[3:]] for r in rows if r[0] == "chr1"]
+    )
+    assert abs(np.median(chr1) - 1.0) < 0.35
+    x = np.array([[float(v) for v in r[3:]] for r in rows if r[0] == "X"])
+    # male X ~ half of female X
+    m_med, f_med = np.median(x[:, :3]), np.median(x[:, 3:])
+    assert m_med < 0.75 * f_med
+
+
+def test_ped_sex_inference(cohort_result):
+    _, outdir, res = cohort_result
+    with open(res["ped"]) as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        rows = [line.rstrip("\n").split("\t") for line in fh]
+    cols = {c: i for i, c in enumerate(header)}
+    assert "CNX" in cols and "CNY" in cols
+    cnx = np.array([float(r[cols["CNX"]]) for r in rows])
+    sex = np.array([int(r[cols["sex"]]) for r in rows])
+    assert list(sex) == [1, 1, 1, 2, 2, 2]
+    assert np.all(cnx[:3] < 1.5) and np.all(cnx[3:] > 1.5)
+    # mapped counts present and sane
+    mapped = np.array([int(r[cols["mapped"]]) for r in rows])
+    assert np.all(mapped > 3000)
+    # PCs written
+    assert "PC1" in cols and "slope" in cols
+
+
+def test_roc_file(cohort_result):
+    _, _, res = cohort_result
+    with open(res["roc"]) as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        rows = [line.split("\t") for line in fh]
+    assert len(header) == 2 + 6
+    chr1_rows = [r for r in rows if r[0] == "chr1"]
+    assert len(chr1_rows) == 70
+    # first row (cov 0) is proportion 1.0 for every sample
+    assert all(float(v) == 1.0 for v in chr1_rows[0][2:])
+
+
+def test_get_short_name(tmp_path):
+    assert get_short_name("/a/b/sample1.bam.bai") == "sample1-bam"
+    assert get_short_name("/a/b/s.crai") == "s"
+    p = make_cohort(tmp_path, n_m=1, n_f=0, depth_reads=200)[0]
+    assert get_short_name(p) == "sM0"
+
+
+def test_excluded_chrom(tmp_path):
+    paths = make_cohort(tmp_path, n_m=1, n_f=1, depth_reads=1000)
+    outdir = str(tmp_path / "out2")
+    res = run_indexcov(paths, outdir, exclude_patt="^X$",
+                       write_html=False, write_png=False)
+    with gzip.open(res["bed"], "rt") as fh:
+        fh.readline()
+        chroms = {line.split("\t")[0] for line in fh}
+    assert "X" not in chroms
+    assert "chr1" in chroms
