@@ -1,0 +1,259 @@
+"""depth: windowed depth + callable-region classification on the TPU.
+
+The reference shells out to ``samtools depth`` per 10Mb shard and parses
+per-base text (depth/depth.go:45,236-364). Here the BAM is decoded once on
+the host into columnar ref-aligned segments (BAI linear-index seek per
+shard) and depth is a scatter-add + cumsum device kernel
+(ops/depth_pipeline.py); window means and callable classes come back as
+arrays and are written as the same two BED files:
+
+  <prefix>.depth.bed     chrom  s  e  %.4g-mean [gc cpg masked with -s]
+  <prefix>.callable.bed  chrom  s  e  NO_/LOW_/CALLABLE/EXCESSIVE_COVERAGE
+
+Semantics preserved from the reference:
+  - windows aligned to absolute coordinates, clipped to the region, mean
+    denominator = clipped span (depth/depth.go:293-305, 329-341)
+  - per-base classes with NO_COVERAGE gap fill (":307-323, 343-359");
+    class thresholds at getCovClass (":223-234")
+  - shard step = 10Mb rounded to a window multiple (":48,130-132")
+  - samtools flags inherited: -Q mapq cutoff (keep mapq ≥ Q), skip
+    UNMAP/SECONDARY/QCFAIL/DUP, per-base cap -d = MaxMeanDepth+2500
+    (":45,116"); deletions/ref-skips don't count (M/=/X blocks only)
+  - -b BED restricts to listed regions; ``-s`` appends GC/CpG/masked
+    ("%.3g") per window (":191-200")
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import os
+import sys
+
+import numpy as np
+
+from ..io.bai import read_bai, query_voffset
+from ..io.bam import BamReader, ReadColumns
+from ..io.fai import Faidx, read_fai
+from ..ops.coverage import (
+    bucket_size, run_length_encode, window_bounds, CLASS_NAMES,
+)
+from ..ops.depth_pipeline import shard_depth_pipeline
+from ..utils.xopen import xopen
+
+STEP = 10_000_000  # shard size, depth/depth.go:48
+DEPTH_CAP_EXTRA = 2500  # -d = MaxMeanDepth + 2500, depth/depth.go:116
+
+
+def gen_regions(
+    fai_records, chrom: str, window: int, bed: str | None
+) -> list[tuple[str, int, int]]:
+    """(chrom, start, end) 0-based half-open shards (depth.go:103-159)."""
+    if bed:
+        out = []
+        with xopen(bed) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith(("#", "track")):
+                    continue
+                t = line.split("\t")
+                out.append((t[0], max(int(t[1]), 0), int(t[2])))
+        return out
+    step = max(1, STEP // window) * window
+    out = []
+    for rec in fai_records:
+        if chrom and rec.name != chrom:
+            continue
+        for i in range(0, rec.length, step):
+            out.append((rec.name, i, min(i + step, rec.length)))
+    return out
+
+
+def _decode_shard(
+    bam_bytes: bytes, bai, tid: int, start: int, end: int
+) -> ReadColumns:
+    """Host decode of records overlapping [start, end) on tid."""
+    voff = query_voffset(bai, tid, start)
+    if voff is None:
+        return ReadColumns.empty()
+    rdr = BamReader(bam_bytes)
+    rdr.seek_virtual(voff)
+    return rdr.read_columns(tid=tid, start=start, end=end)
+
+
+class DepthEngine:
+    """Reusable shard→(window sums, classes) runner (also used by
+    multidepth and the benchmark)."""
+
+    def __init__(self, window: int, min_cov: int, max_mean_depth: int,
+                 mapq: int, max_span: int = STEP):
+        """``max_span`` = max over regions of (end - aligned_origin) —
+        the longest per-base buffer any shard needs."""
+        self.window = window
+        self.min_cov = min_cov
+        self.max_mean = max_mean_depth
+        self.mapq = mapq
+        self.cap = max_mean_depth + DEPTH_CAP_EXTRA
+        # one static length (a multiple of the reshape window covering the
+        # longest region from its aligned origin) → one XLA compile per
+        # segment bucket for the whole genome. Windows larger than the
+        # span mean every region fits one absolute window, so the reshape
+        # uses the whole buffer as a single window.
+        if window >= max_span:
+            self.w_eff = ((max_span + 1023) // 1024) * 1024
+            self.length = self.w_eff
+        else:
+            self.w_eff = window
+            self.length = (max_span + window - 1) // window * window
+
+    def run_shard(self, cols: ReadColumns, start: int, end: int):
+        w0 = start // self.window * self.window
+        assert end - w0 <= self.length
+        n = len(cols.seg_start)
+        b = bucket_size(n)
+        seg_s = np.full(b, 0, dtype=np.int32)
+        seg_e = np.full(b, 0, dtype=np.int32)
+        keep = np.zeros(b, dtype=bool)
+        if n:
+            seg_s[:n] = cols.seg_start
+            seg_e[:n] = cols.seg_end
+            read_ok = (cols.mapq >= self.mapq) & (
+                (cols.flag & 0x704) == 0
+            )
+            keep[:n] = read_ok[cols.seg_read]
+        sums, cls, _ = shard_depth_pipeline(
+            seg_s, seg_e, keep,
+            np.int32(w0), np.int32(start), np.int32(end),
+            np.int32(self.cap), np.int32(self.min_cov),
+            np.int32(self.max_mean),
+            length=self.length, window=self.w_eff,
+        )
+        starts, ends, _, _ = window_bounds(start, end, self.window)
+        n_win = len(starts)
+        sums = np.asarray(sums)[:n_win]
+        cls = np.asarray(cls)[start - w0 : end - w0]
+        return starts, ends, sums, cls
+
+
+def write_shard_output(
+    chrom: str, starts, ends, sums, cls, region_start: int,
+    depth_out, call_out, fa: Faidx | None,
+) -> None:
+    spans = ends - starts
+    means = sums / spans
+    if fa is None:
+        for s, e, m in zip(starts, ends, means):
+            depth_out.write(f"{chrom}\t{s}\t{e}\t{m:.4g}\n")
+    else:
+        for s, e, m in zip(starts, ends, means):
+            st = fa.window_stats(chrom, int(s), int(e))
+            depth_out.write(
+                f"{chrom}\t{s}\t{e}\t{m:.4g}"
+                f"\t{st['gc']:.3g}\t{st['cpg']:.3g}\t{st['masked']:.3g}\n"
+            )
+    rs, re_, rv = run_length_encode(cls)
+    for s, e, v in zip(rs, re_, rv):
+        call_out.write(
+            f"{chrom}\t{s + region_start}\t{e + region_start}\t"
+            f"{CLASS_NAMES[v]}\n"
+        )
+
+
+def run_depth(
+    bam: str,
+    prefix: str,
+    reference: str | None = None,
+    fai: str | None = None,
+    window: int = 250,
+    min_cov: int = 4,
+    max_mean_depth: int = 0,
+    mapq: int = 1,
+    chrom: str = "",
+    bed: str | None = None,
+    stats: bool = False,
+    processes: int = 4,
+) -> tuple[str, str]:
+    with open(bam, "rb") as fh:
+        bam_bytes = fh.read()
+    hdr = BamReader(bam_bytes).header
+    bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
+                   else bam[:-4] + ".bai")
+    fai_path = fai or (reference + ".fai" if reference else None)
+    if bed is None:
+        if fai_path is None:
+            raise SystemExit(
+                "depth: need -r reference (with .fai) or -b bed regions"
+            )
+        if not os.path.exists(fai_path):
+            if reference and os.path.exists(reference):
+                from ..io.fai import write_fai
+
+                write_fai(reference)
+            else:
+                raise SystemExit(f"depth: fasta index not found: {fai_path}")
+        fai_records = read_fai(fai_path)
+    else:
+        fai_records = []
+    regions = gen_regions(fai_records, chrom, window, bed)
+
+    fa = Faidx(reference) if stats and reference else None
+    max_span = max(
+        (e - (s // window) * window for _, s, e in regions), default=1
+    )
+    engine = DepthEngine(window, min_cov, max_mean_depth, mapq,
+                         max_span=max_span)
+
+    suffix = f".{chrom}" if chrom else ""
+    depth_path = f"{prefix}{suffix}.depth.bed"
+    call_path = f"{prefix}{suffix}.callable.bed"
+    tid_of = {n: i for i, n in enumerate(hdr.ref_names)}
+
+    with open(depth_path, "w") as dout, open(call_path, "w") as cout:
+        with cf.ThreadPoolExecutor(max_workers=max(processes, 1)) as ex:
+            futs = [
+                ex.submit(_decode_shard, bam_bytes, bai,
+                          tid_of.get(c, -1), s, e)
+                if c in tid_of else None
+                for (c, s, e) in regions
+            ]
+            for (c, s, e), fut in zip(regions, futs):
+                cols = fut.result() if fut is not None \
+                    else ReadColumns.empty()
+                starts, ends, sums, cls = engine.run_shard(cols, s, e)
+                write_shard_output(c, starts, ends, sums, cls, s,
+                                   dout, cout, fa)
+    return depth_path, call_path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu depth",
+        description="windowed depth + callable regions via the TPU engine",
+    )
+    p.add_argument("-w", "--windowsize", type=int, default=250)
+    p.add_argument("-m", "--maxmeandepth", type=int, default=0,
+                   help="per-base depths >= this are EXCESSIVE_COVERAGE")
+    p.add_argument("-Q", "--mapq", type=int, default=1,
+                   help="mapping quality cutoff (keep >= Q)")
+    p.add_argument("-c", "--chrom", default="")
+    p.add_argument("--mincov", type=int, default=4,
+                   help="minimum depth considered callable")
+    p.add_argument("-s", "--stats", action="store_true",
+                   help="report GC CpG masked stats per window")
+    p.add_argument("-r", "--reference", default=None,
+                   help="reference fasta (with .fai)")
+    p.add_argument("-p", "--processes", type=int, default=4)
+    p.add_argument("-b", "--bed", default=None,
+                   help="restrict to regions in this bed")
+    p.add_argument("--prefix", required=True)
+    p.add_argument("bam")
+    a = p.parse_args(argv)
+    run_depth(
+        a.bam, a.prefix, reference=a.reference, window=a.windowsize,
+        min_cov=a.mincov, max_mean_depth=a.maxmeandepth, mapq=a.mapq,
+        chrom=a.chrom, bed=a.bed, stats=a.stats, processes=a.processes,
+    )
+
+
+if __name__ == "__main__":
+    main()

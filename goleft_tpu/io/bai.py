@@ -190,6 +190,18 @@ def build_bai(bam_path: str) -> BaiIndex:
     return BaiIndex(refs, n_no_coor)
 
 
+def query_voffset(idx: BaiIndex, tid: int, start: int) -> int | None:
+    """Virtual offset at which to begin scanning for records overlapping
+    positions ≥ start on tid, via the linear index (spec 5.1.3: entry w is
+    the smallest voffset of an alignment overlapping window w — so long
+    reads spanning into the region are caught). None → no data."""
+    r = idx.refs[tid]
+    if len(r.intervals) == 0:
+        return None
+    w = min(start >> TILE_SHIFT, len(r.intervals) - 1)
+    return int(r.intervals[w])
+
+
 def _merge_chunks(chunks: list[tuple[int, int]]) -> list[tuple[int, int]]:
     chunks = sorted(chunks)
     out = [list(chunks[0])]
