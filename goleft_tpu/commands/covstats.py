@@ -1,0 +1,209 @@
+"""covstats: per-BAM coverage/insert-size estimates by read sampling.
+
+Reference: covstats/covstats.go. The sequential sampling loop (":122-220")
+is emulated exactly with vectorized column math over the decoded read
+columns: skip the first 100k reads, then consume records until n insert
+sizes are collected (or EOF, or 2n read-lengths with zero inserts —
+single-end early stop). Insert sizes come only from proper pairs upstream
+of their mate with a single-M cigar (":169-172"); outliers are trimmed by
+the 10-MAD upper filter (":57-76" — including its quirk of dropping the
+final element when nothing exceeds the bound); coverage =
+(1 - propBad) * mapped * readLenMean / genomeBases (":277").
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from ..io.bai import read_bai
+from ..io.bam import BamReader, ReadColumns
+from ..utils.xopen import xopen
+
+N_MADS = 10
+SKIP_READS = 100_000
+
+FLAG_UNMAPPED = 0x4
+FLAG_PROPER = 0x2
+FLAG_DUP = 0x400
+FLAG_QCFAIL = 0x200
+
+
+def mad_filter(arr: np.ndarray, nmads: int = N_MADS) -> np.ndarray:
+    arr = np.sort(arr)
+    med = arr[len(arr) // 2]
+    upper_diffs = np.sort(arr[len(arr) // 2 + 1 :] - med)
+    if len(upper_diffs) == 0:
+        return arr[: max(len(arr) - 1, 0)]
+    umad = upper_diffs[len(upper_diffs) // 2]
+    upper = med + nmads * umad
+    over = np.flatnonzero(arr > upper)
+    # reference quirk: when nothing exceeds the bound the final element is
+    # still dropped (covstats.go:69-75 leaves i at len-1)
+    cut = int(over[0]) if len(over) else len(arr) - 1
+    return arr[:cut]
+
+
+def mean_std(arr: np.ndarray) -> tuple[float, float]:
+    if len(arr) == 0:
+        return 0.0, 0.0
+    m = float(np.mean(arr))
+    return m, float(np.sqrt(np.mean((arr - m) ** 2)))
+
+
+def bam_stats(cols: ReadColumns, n: int, skip: int = SKIP_READS) -> dict:
+    """Emulates BamStats over pre-decoded columns."""
+    flag = cols.flag.astype(np.int64)[skip:]
+    pos = cols.pos[skip:]
+    end = cols.end[skip:]
+    mate_pos = cols.mate_pos[skip:]
+    tlen = cols.tlen[skip:]
+    read_len = cols.read_len[skip:]
+    single_m = cols.single_m[skip:]
+
+    unmapped = (flag & FLAG_UNMAPPED) != 0
+    mapped = ~unmapped
+    bad = mapped & ((flag & (FLAG_DUP | FLAG_QCFAIL)) != 0)
+    dup = mapped & ((flag & FLAG_DUP) != 0)
+    good = mapped & ~bad
+    proper = good & ((flag & FLAG_PROPER) != 0)
+    ins_ok = good & (pos < mate_pos) & ((flag & FLAG_PROPER) != 0) & single_m
+
+    # stop index: the record that fills the n-th insert, or the single-end
+    # early break once 2n read lengths are banked with zero inserts, or EOF
+    cum_ins = np.cumsum(ins_ok)
+    stop = len(flag)
+    hit = np.flatnonzero(cum_ins >= n)
+    if len(hit):
+        stop = int(hit[0]) + 1
+    cum_sizes = np.cumsum(good)
+    full = np.flatnonzero(cum_sizes >= 2 * n + 1)
+    if len(full):
+        j = int(full[0])
+        if cum_ins[j] == 0:
+            stop = min(stop, j + 1)
+
+    sl = slice(0, stop)
+    k = int(np.sum(mapped[sl]))
+    n_unmapped = int(np.sum(unmapped[sl]))
+    denom = max(k + n_unmapped, 1)
+    st = {
+        "prop_bad": np.sum(bad[sl]) / denom,
+        "prop_dup": np.sum(dup[sl]) / denom,
+        "prop_proper": np.sum(proper[sl]) / denom,
+        "prop_unmapped": n_unmapped / denom,
+        "insert_mean": 0.0, "insert_sd": 0.0,
+        "insert_5": 0, "insert_95": 0,
+        "template_mean": 0.0, "template_sd": 0.0,
+        "read_len_mean": 0.0, "read_len_median": 0.0, "max_read_len": 0,
+        "histogram": np.zeros(0),
+    }
+    sizes = read_len[sl][good[sl]][: 2 * n]
+    if len(sizes):
+        sizes = np.sort(sizes)
+        st["read_len_median"] = float(sizes[(len(sizes) - 1) // 2]) - 1
+        st["read_len_mean"] = mean_std(sizes)[0]
+        st["max_read_len"] = int(sizes[-1])
+
+    ins_mask = ins_ok[sl]
+    inserts = (mate_pos[sl] - end[sl])[ins_mask][:n]
+    templates = tlen[sl][ins_mask][:n]
+    if len(inserts):
+        s_ins = np.sort(inserts)
+        l = float(len(s_ins) - 1)
+        st["insert_5"] = int(s_ins[int(0.05 * l + 0.5)])
+        st["insert_95"] = int(s_ins[int(0.95 * l + 0.5)])
+        filt = mad_filter(s_ins)
+        st["insert_mean"], st["insert_sd"] = mean_std(filt)
+        tfilt = mad_filter(np.sort(templates))
+        st["template_mean"], st["template_sd"] = mean_std(tfilt)
+        # lumpy-style normalized template histogram (covstats.go:201-217)
+        start = float(st["max_read_len"])
+        stop_h = st["template_mean"] + st["template_sd"] * 4
+        nbins = int(stop_h - start + 1)
+        if nbins > 0:
+            h = np.zeros(nbins)
+            tv = tfilt[(tfilt >= start) & (tfilt <= stop_h)]
+            idx = (tv - start).astype(np.int64)
+            np.add.at(h, idx, 1)
+            if len(tv):
+                h /= len(tv)
+            st["histogram"] = h
+    return st
+
+
+def region_bases(bed_path: str) -> int:
+    cov = 0
+    with xopen(bed_path) as fh:
+        for line in fh:
+            t = line.rstrip("\n").split("\t", 4)
+            cov += int(t[2]) - int(t[1])
+    return cov
+
+
+HEADER = ("coverage\tinsert_mean\tinsert_sd\tinsert_5th\tinsert_95th\t"
+          "template_mean\ttemplate_sd\tpct_unmapped\tpct_bad_reads\t"
+          "pct_duplicate\tpct_proper_pair\tread_length\tbam\tsample")
+
+
+def run_covstats(bams: list[str], n: int = 1_000_000,
+                 regions: str | None = None, skip: int = SKIP_READS,
+                 out=None) -> list[dict]:
+    import sys
+
+    out = out or sys.stdout
+    out.write(HEADER + "\n")
+    results = []
+    for path in bams:
+        rdr = BamReader.from_file(path)
+        names = ",".join(rdr.header.sample_names()) or "<no-read-groups>"
+        # decode enough records for the sampling emulation
+        cols = rdr.read_columns(max_records=skip + 4 * n)
+        st = bam_stats(cols, n, skip)
+
+        genome_bases = sum(rdr.header.ref_lens)
+        mapped = 0
+        try:
+            import os
+
+            bai_path = path + ".bai" if os.path.exists(path + ".bai") \
+                else path[:-4] + ".bai"
+            mapped = read_bai(bai_path).mapped_total
+        except (OSError, ValueError):
+            pass
+        if regions:
+            genome_bases = region_bases(regions)
+        coverage = ((1 - st["prop_bad"]) * mapped * st["read_len_mean"]
+                    / max(genome_bases, 1))
+        st.update(coverage=coverage, bam=path, sample=names)
+        results.append(st)
+        out.write(
+            f"{coverage:.2f}\t{st['insert_mean']:.2f}\t{st['insert_sd']:.2f}"
+            f"\t{st['insert_5']}\t{st['insert_95']}"
+            f"\t{st['template_mean']:.2f}\t{st['template_sd']:.2f}"
+            f"\t{100 * st['prop_unmapped']:.2f}\t{100 * st['prop_bad']:.1f}"
+            f"\t{100 * st['prop_dup']:.1f}\t{100 * st['prop_proper']:.1f}"
+            f"\t{st['max_read_len']}\t{path}\t{names}\n"
+        )
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu covstats",
+        description="coverage and insert-size stats from sampled reads",
+    )
+    p.add_argument("-n", type=int, default=1_000_000,
+                   help="number of reads to sample for length")
+    p.add_argument("-r", "--regions", default=None,
+                   help="optional bed of target regions")
+    p.add_argument("-f", "--fasta", default=None,
+                   help="fasta (reserved for cram support)")
+    p.add_argument("bams", nargs="+")
+    a = p.parse_args(argv)
+    run_covstats(a.bams, n=a.n, regions=a.regions)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""samplename: print unique SM tags from a BAM's @RG header lines.
+
+Reference: samplename/samplename.go:14-68.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..io.bam import BamReader
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu samplename",
+        description="report the sample name(s) in a bam file",
+    )
+    p.add_argument("bam")
+    a = p.parse_args(argv)
+    names = BamReader.from_file(a.bam).header.sample_names()
+    for n in names:
+        print(n)
+    if not names:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

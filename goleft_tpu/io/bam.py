@@ -140,10 +140,15 @@ class ReadColumns:
     flag: np.ndarray  # uint16
     tlen: np.ndarray  # int32
     read_len: np.ndarray  # int32
+    mate_pos: np.ndarray  # int32
+    single_m: np.ndarray  # bool: cigar is exactly one M op
     seg_tid: np.ndarray  # int32 (n_segs,)
     seg_start: np.ndarray  # int32
     seg_end: np.ndarray  # int32
     seg_read: np.ndarray  # int32 index into read rows
+
+    _FIELDS = ("tid", "pos", "end", "mapq", "flag", "tlen", "read_len",
+               "mate_pos", "single_m", "seg_tid", "seg_start", "seg_end")
 
     @property
     def n_reads(self) -> int:
@@ -155,7 +160,8 @@ class ReadColumns:
         return ReadColumns(
             z32, z32, z32,
             np.zeros(0, dtype=np.uint8), np.zeros(0, dtype=np.uint16),
-            z32, z32, z32.copy(), z32.copy(), z32.copy(), z32.copy(),
+            z32, z32, z32.copy(), np.zeros(0, dtype=bool),
+            z32.copy(), z32.copy(), z32.copy(), z32.copy(),
         )
 
     @staticmethod
@@ -166,8 +172,7 @@ class ReadColumns:
         offs = np.cumsum([0] + [p.n_reads for p in parts[:-1]])
         return ReadColumns(
             *[np.concatenate([getattr(p, f) for p in parts])
-              for f in ("tid", "pos", "end", "mapq", "flag", "tlen",
-                        "read_len", "seg_tid", "seg_start", "seg_end")],
+              for f in ReadColumns._FIELDS],
             np.concatenate(
                 [p.seg_read + o for p, o in zip(parts, offs)]
             ).astype(np.int32),
@@ -266,6 +271,7 @@ class BamReader:
         """
         tids, poss, ends, mapqs, flags, tlens, rlens = \
             [], [], [], [], [], [], []
+        mposs, singlem = [], []
         seg_t, seg_s, seg_e, seg_r = [], [], [], []
         n = 0
         while True:
@@ -283,7 +289,7 @@ class BamReader:
                     continue
                 if end is not None and pos >= end:
                     break
-            tlen = struct.unpack_from("<i", buf, 28)[0]
+            mpos, tlen = struct.unpack_from("<ii", buf, 24)
             off = 32 + l_rn
             cig = np.frombuffer(buf, dtype=np.uint32, count=n_cig, offset=off)
             oplen = (cig >> 4).astype(np.int64)
@@ -301,6 +307,8 @@ class BamReader:
             flags.append(flag)
             tlens.append(tlen)
             rlens.append(l_seq)
+            mposs.append(mpos)
+            singlem.append(n_cig == 1 and (cig[0] & 0xF) == 0)
             # aligned blocks
             ref_steps = oplen * _CONSUMES_REF[opc]
             block_starts = pos + np.concatenate(
@@ -322,6 +330,8 @@ class BamReader:
             np.asarray(flags, dtype=np.uint16),
             np.asarray(tlens, dtype=np.int32),
             np.asarray(rlens, dtype=np.int32),
+            np.asarray(mposs, dtype=np.int32),
+            np.asarray(singlem, dtype=bool),
             np.asarray(seg_t, dtype=np.int32),
             np.asarray(seg_s, dtype=np.int32),
             np.asarray(seg_e, dtype=np.int32),

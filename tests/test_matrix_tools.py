@@ -1,0 +1,223 @@
+"""Tests for depthwed, samplename, indexsplit, covstats."""
+
+import io
+
+import numpy as np
+import pytest
+
+from goleft_tpu.commands.depthwed import run_depthwed, name_from_file
+from goleft_tpu.commands.covstats import (
+    mad_filter, mean_std, bam_stats, run_covstats,
+)
+from goleft_tpu.commands.indexsplit import split, Chunk
+from goleft_tpu.commands.samplename import main as samplename_main
+from goleft_tpu.io.bam import BamReader, BamWriter, parse_cigar
+from goleft_tpu.utils.regions import IntervalSet, read_tree, overlaps
+
+from helpers import write_bam, write_bam_and_bai, random_reads
+
+
+# ---------- depthwed ----------
+
+def _write_depth_bed(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write("\t".join(str(x) for x in r) + "\n")
+
+
+def test_depthwed_aggregates(tmp_path):
+    rows_a = [("chr1", 0, 250, "1.5"), ("chr1", 250, 500, "2.4"),
+              ("chr1", 500, 750, "3.0"), ("chr1", 750, 1000, "0"),
+              ("chr2", 0, 250, "5.0"), ("chr2", 250, 500, "1.0")]
+    rows_b = [("chr1", 0, 250, "2.5"), ("chr1", 250, 500, "0.4"),
+              ("chr1", 500, 750, "1.0"), ("chr1", 750, 1000, "1.0"),
+              ("chr2", 0, 250, "0.2"), ("chr2", 250, 500, "2.6")]
+    pa = str(tmp_path / "sampleA.depth.bed")
+    pb = str(tmp_path / "sampleB.depth.bed")
+    _write_depth_bed(pa, rows_a)
+    _write_depth_bed(pb, rows_b)
+    out = io.StringIO()
+    run_depthwed([pa, pb], size=500, out=out)
+    lines = out.getvalue().splitlines()
+    assert lines[0] == "#chrom\tstart\tend\tsampleA\tsampleB"
+    # chr1: two groups of 2 rows; depth = round-half-up mean then summed
+    assert lines[1] == "chr1\t0\t500\t4\t3"  # 2+2, 3(round2.5)+0
+    assert lines[2] == "chr1\t500\t1000\t3\t2"
+    # chr2 partial tail group is cut by EOF and dropped (reference :64-71)
+    assert lines[3] == "chr2\t0\t500\t6\t3"
+    assert len(lines) == 4
+
+
+def test_depthwed_chrom_boundary(tmp_path):
+    # odd row count per chrom: group cut at chromosome change
+    rows = [("chr1", 0, 100, "1"), ("chr1", 100, 200, "1"),
+            ("chr1", 200, 300, "1"),
+            ("chr2", 0, 100, "2"), ("chr2", 100, 200, "2"),
+            ("chr2", 200, 300, "2"), ("chr2", 300, 400, "2")]
+    p = str(tmp_path / "s.depth.bed")
+    _write_depth_bed(p, rows)
+    out = io.StringIO()
+    run_depthwed([p], size=200, out=out)
+    lines = out.getvalue().splitlines()[1:]
+    assert lines[0] == "chr1\t0\t200\t2"
+    assert lines[1] == "chr1\t200\t300\t1"  # chrom-change flush
+    assert lines[2] == "chr2\t0\t200\t4"
+    # chr2 trailing group [200,400) completes via span
+    assert lines[3] == "chr2\t200\t400\t4"
+
+
+def test_name_from_file():
+    assert name_from_file("/x/y/NA12878.depth.bed.gz") == "NA12878"
+    assert name_from_file("s1.bed") == "s1"
+
+
+# ---------- samplename ----------
+
+def test_samplename(tmp_path, capsys):
+    p = str(tmp_path / "t.bam")
+    write_bam(p, [(0, 10, "50M", 60, 0)])
+    assert samplename_main([p]) == 0
+    assert capsys.readouterr().out == "sampleA\n"
+
+
+# ---------- interval sets ----------
+
+def test_interval_set(tmp_path):
+    ivs = IntervalSet([10, 100, 50], [20, 200, 300])
+    assert ivs.overlaps(15, 16)
+    assert ivs.overlaps(250, 260)  # covered by [50,300)
+    assert not ivs.overlaps(20, 50)
+    assert not ivs.overlaps(0, 10)
+    bed = tmp_path / "p.bed"
+    bed.write_text("chr1\t10\t20\nchr2\t0\t5\n")
+    tree = read_tree(str(bed))
+    assert overlaps(tree, "chr1", 5, 11)
+    assert not overlaps(tree, "chr1", 20, 30)
+    assert not overlaps(tree, "chr3", 0, 100)
+    assert not overlaps(None, "chr1", 0, 100)
+
+
+# ---------- indexsplit ----------
+
+def test_indexsplit_tiles_genome(tmp_path):
+    rng = np.random.default_rng(11)
+    paths = []
+    for s in range(3):
+        reads = random_reads(rng, 3000, 0, 1_000_000) + random_reads(
+            rng, 600, 1, 200_000
+        )
+        p = str(tmp_path / f"s{s}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1", "chr2"),
+                          ref_lens=(1_000_000, 200_000))
+        paths.append(p)
+    refs = [(0, "chr1", 1_000_000), (1, "chr2", 200_000)]
+    chunks = list(split(paths, refs, 20))
+    # chunks tile each chromosome contiguously from 0 to ref length
+    for chrom, ln in (("chr1", 1_000_000), ("chr2", 200_000)):
+        cs = [c for c in chunks if c.chrom == chrom]
+        assert cs[0].start == 0
+        assert cs[-1].end == ln
+        for a, b in zip(cs, cs[1:]):
+            assert a.end == b.start
+    # roughly the requested number of regions (greedy, so approximate)
+    assert 10 <= len(chunks) <= 40
+    # data sums are balanced-ish for same-coverage samples on chr1
+    sums = [c.sum for c in chunks if c.chrom == "chr1" and c.splits == 1]
+    assert max(sums) / max(min(sums), 1e-9) < 20
+
+
+def test_indexsplit_problematic_forces_splits(tmp_path):
+    rng = np.random.default_rng(12)
+    reads = random_reads(rng, 5000, 0, 1_000_000)
+    p = str(tmp_path / "s.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(1_000_000,))
+    bed = tmp_path / "probs.bed"
+    bed.write_text("chr1\t100000\t120000\n")
+    refs = [(0, "chr1", 1_000_000)]
+    plain = list(split([p], refs, 5))
+    probbed = list(split([p], refs, 5, read_tree(str(bed))))
+    # problematic region forces more/finer chunks
+    assert len(probbed) >= len(plain)
+    assert any(c.splits > 1 for c in probbed)
+
+
+def test_indexsplit_empty_chrom():
+    chunks = list(split([], [(0, "chrEmpty", 5000)], 4))
+    assert chunks == [Chunk("chrEmpty", 0, 5000, 0.0, 0)]
+
+
+# ---------- covstats ----------
+
+def test_mad_filter_quirk():
+    arr = np.arange(100)
+    out = mad_filter(arr, 10)
+    # nothing exceeds med+10*mad → final element dropped (reference quirk)
+    assert len(out) == 99
+    arr2 = np.concatenate([np.arange(100), [10_000]])
+    out2 = mad_filter(arr2, 10)
+    assert 10_000 not in out2
+
+
+def test_mean_std():
+    m, s = mean_std(np.array([1, 2, 3, 4]))
+    assert m == pytest.approx(2.5)
+    assert s == pytest.approx(np.sqrt(1.25))
+
+
+def _paired_bam(tmp_path, n_pairs=300, insert=150, read_len=100, seed=13):
+    """Coordinate-sorted proper pairs with known insert-size structure."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for _ in range(n_pairs):
+        s = int(rng.integers(0, 500_000))
+        isz = insert + int(rng.integers(-20, 21))
+        mate_start = s + read_len + isz
+        tlen = mate_start + read_len - s
+        recs.append((s, mate_start, tlen))
+    recs.sort()
+    p = str(tmp_path / "pairs.bam")
+    with open(p, "wb") as fh:
+        with BamWriter(fh, "@HD\tVN:1.6\n@RG\tID:x\tSM:pp\n", ["chr1"],
+                       [1_000_000], level=0, block_size=4096) as w:
+            rows = []
+            for i, (s, ms, tl) in enumerate(recs):
+                rows.append((s, ms, tl, 0x2 | 0x1 | 0x20, f"p{i}"))
+                rows.append((ms, s, -tl, 0x2 | 0x1 | 0x10, f"p{i}"))
+            rows.sort()
+            for s, ms, tl, flag, nm in rows:
+                w.write_record(0, s, parse_cigar(f"{read_len}M"),
+                               mapq=60, flag=flag, name=nm,
+                               mate_tid=0, mate_pos=ms, tlen=tl)
+    return p
+
+
+def test_bam_stats_inserts(tmp_path):
+    p = _paired_bam(tmp_path)
+    cols = BamReader.from_file(p).read_columns()
+    st = bam_stats(cols, n=200, skip=0)
+    # inserts ≈ 150 ± 20
+    assert st["insert_mean"] == pytest.approx(150, abs=10)
+    assert 100 < st["insert_5"] < 150 < st["insert_95"] < 200
+    assert st["template_mean"] == pytest.approx(350, abs=10)
+    assert st["prop_proper"] == pytest.approx(1.0)
+    assert st["prop_unmapped"] == 0.0
+    assert st["max_read_len"] == 100
+    assert st["read_len_mean"] == pytest.approx(100)
+    assert len(st["histogram"]) > 0
+    assert st["histogram"].sum() == pytest.approx(1.0)
+
+
+def test_run_covstats_output(tmp_path):
+    p = _paired_bam(tmp_path, n_pairs=200)
+    from goleft_tpu.io.bai import build_bai, write_bai
+
+    write_bai(build_bai(p), p + ".bai")
+    out = io.StringIO()
+    res = run_covstats([p], n=100, skip=0, out=out)
+    lines = out.getvalue().splitlines()
+    assert lines[0].startswith("coverage\tinsert_mean")
+    fields = lines[1].split("\t")
+    assert fields[-1] == "pp"
+    # coverage = mapped * readlen / genome = 400*100/1e6 = 0.04
+    assert float(fields[0]) == pytest.approx(0.04, abs=0.01)
+    assert res[0]["sample"] == "pp"
