@@ -19,3 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# float64 on the CPU test platform so EM kernels can be validated exactly
+# against float64 oracles (device kernels that want f32 request it
+# explicitly, so this only upgrades default-precision math).
+import jax
+
+jax.config.update("jax_enable_x64", True)

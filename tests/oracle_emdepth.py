@@ -1,0 +1,125 @@
+"""Sequential oracle transcription of the reference EM semantics
+(emdepth/emdepth.go) used to validate the batched JAX kernel. Kept in
+tests/ — product code uses goleft_tpu.models.emdepth."""
+
+import math
+
+MAX_CN = 8
+MAX_ITER = 10
+EPS = 0.01
+LOWER = -0.80
+UPPER = 0.40
+
+
+def median32(a):
+    b = sorted(float(x) for x in a)
+    n = len(b)
+    if n % 2 == 1:
+        return b[n // 2]
+    # reference quirk (emdepth.go:25-28): even-length median averages the
+    # two elements ABOVE the midpoint (b[n/2], b[n/2+1]), not the usual
+    # b[n/2-1], b[n/2]
+    return (b[n // 2] + b[n // 2 + 1]) / 2
+
+
+def search(a, x):
+    lo, hi = 0, len(a)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if a[mid] >= x:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
+
+
+def pmf(k, mu):
+    if mu <= 0:
+        return 0.0
+    return math.exp(k * math.log(mu) - math.lgamma(k + 1) - mu)
+
+
+def em_depth(depths):
+    m = median32(depths)
+    lam = [0.0] * (MAX_CN + 1)
+    lam[0], lam[2] = EPS * m, m
+    for i in range(1, MAX_CN + 1):
+        if i != 2:
+            lam[i] = lam[2] * (i / 2) ** 1.1
+    last = list(lam)
+    sumd, maxd = 100.0, 100.0
+    it = 0
+    while it < MAX_ITER and sumd > EPS and maxd > 0.5:
+        it += 1
+        binned = [[] for _ in range(MAX_CN + 1)]
+        last = list(lam)
+        for df in depths:
+            d = float(df)
+            if lam[1] < d < lam[3] and (
+                abs(d - lam[2]) < abs(d - lam[1])
+                and abs(d - lam[2]) < abs(d - lam[3])
+            ):
+                binned[2].append(d)
+                continue
+            idx = search(lam, d)
+            if idx == 0:
+                binned[0].append(d)
+            elif idx == len(lam):
+                binned[idx - 1].append(d)
+            elif abs(d - lam[idx]) < abs(d - lam[idx - 1]):
+                binned[idx].append(d)
+            else:
+                binned[idx - 1].append(d)
+        lam[2] = sum(binned[2]) / len(binned[2]) if binned[2] else 0.0
+        if lam[2] == 0:
+            n = float(len(depths))
+            for i in range(1, len(lam) - 1):
+                b = binned[i]
+                p = len(b) / n
+                if lam[i] < EPS:
+                    lam[i] = EPS
+                mean_b = sum(b) / len(b) if b else 0.0
+                lam[2] += mean_b * (2 / i) * p
+        for i in range(1, len(lam)):
+            lam[i] = lam[2] * (i / 2)
+        span = lam[2] - lam[1]
+        lam[1] -= span / 1.5
+        lam[3] += span / 1.5
+        sumd = sum(abs(a - b) for a, b in zip(lam, last))
+        maxd = max(abs(a - b) for a, b in zip(lam, last))
+    return lam
+
+
+def cn_type(lam, d):
+    df = float(d)
+    idx = search(lam, df)
+    if idx == 0:
+        cn = 0
+    elif idx == len(lam):
+        cn = len(lam)
+    elif abs(df - lam[idx]) < abs(df - lam[idx - 1]):
+        cn = idx
+    else:
+        cn = idx - 1
+    if cn != 2 and cn < len(lam):
+        dk = int(0.5 + df)
+        o, o2 = pmf(dk, lam[cn]), pmf(dk, lam[2])
+        if o * 0.9 < o2:
+            cn = 2
+    return cn
+
+
+def cns(depths):
+    lam = em_depth(depths)
+    return [cn_type(lam, d) for d in depths]
+
+
+def log2fc(depths, lam):
+    return [math.log2(float(d) / lam[2]) if d > 0 else float("-inf")
+            for d in depths]
+
+
+if __name__ == "__main__":
+    print(cns([1, 8, 33, 34, 35, 37, 31, 22, 66]))
+    print(cns([30, 28, 33, 34, 35, 37, 31, 22, 38]))
+    print(cns([296.6, 16.7, 17.0, 3019.2, 14.4, 16.5, 14.2, 26, 7]))

@@ -1,0 +1,105 @@
+"""Batched EM kernel vs the reference golden vectors and the sequential
+oracle, plus streaming CNV merge tests."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.models import emdepth as em
+import oracle_emdepth as oracle
+
+
+GOLDEN = [
+    # (depths, expected CN) from emdepth_test.go:11-38
+    ([1, 8, 33, 34, 35, 37, 31, 22, 66], [0, 1, 2, 2, 2, 2, 2, 2, 4]),
+    ([30, 28, 33, 34, 35, 37, 31, 22, 38], [2] * 9),
+    ([296.6, 16.7, 17.0, 3019.2, 14.4, 16.5, 14.2, 26, 7],
+     [8, 2, 2, 8, 2, 2, 2, 3, 1]),
+]
+
+
+@pytest.mark.parametrize("depths,expected", GOLDEN)
+def test_golden_cn(depths, expected):
+    d = np.asarray(depths, dtype=np.float64)[None]
+    lam = np.asarray(em.em_depth_batch(d))
+    cns = np.asarray(em.cn_batch(lam, d))[0]
+    assert list(cns) == expected
+
+
+def test_lambda_matches_oracle():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        n = int(rng.integers(4, 40))
+        d = rng.gamma(5, 6, size=n).astype(np.float64)
+        # sprinkle outliers and zeros
+        if rng.random() < 0.5:
+            d[0] *= 10
+        if rng.random() < 0.3:
+            d[-1] = 0
+        lam_o = oracle.em_depth(d)
+        lam_k = np.asarray(em.em_depth_batch(d[None]))[0]
+        np.testing.assert_allclose(lam_k, lam_o, rtol=1e-9, atol=1e-9)
+
+
+def test_cn_matches_oracle_batch():
+    rng = np.random.default_rng(1)
+    B, S = 50, 24
+    depths = rng.gamma(5, 6, size=(B, S))
+    depths[rng.random((B, S)) < 0.05] *= 8  # dups
+    depths[rng.random((B, S)) < 0.05] /= 4  # dels
+    lam = np.asarray(em.em_depth_batch(depths))
+    cns = np.asarray(em.cn_batch(lam, depths))
+    for b in range(B):
+        want = [min(c, em.MAX_CN) for c in oracle.cns(depths[b])]
+        assert list(cns[b]) == want, b
+
+
+def test_same_golden():
+    # emdepth_test.go:40-53
+    v1 = np.array([296.6, 16.7, 17.0, 3019.2, 14.4, 16.5, 14.2, 26, 7])
+    v2 = np.array([96.6, 16.7, 17.0, 319.2, 14.4, 16.5, 14.2, 7, 16])
+    e1 = em.em_depth(v1)
+    e2 = em.em_depth(v2)
+    non2, changed, pct = e2.same(e1)
+    assert pct == pytest.approx(7.0 / 9.0)
+    assert non2 == [0, 3]
+    assert changed == [7, 8]
+
+
+def test_cache_merges_cnvs():
+    rng = np.random.default_rng(2)
+    S = 10
+    cache = em.Cache()
+    out_all = []
+    # windows of 1kb; sample 3 has a deletion in windows 5..9
+    for w in range(30):
+        d = rng.gamma(40, 0.8, size=S)
+        if 5 <= w <= 9:
+            d[3] *= 0.25
+        e = em.em_depth(d, start=w * 1000, end=(w + 1) * 1000)
+        out_all += cache.add(e)
+    out_all += cache.clear(None)
+    assert any(c.sample_i == 3 for c in out_all)
+    c3 = next(c for c in out_all if c.sample_i == 3)
+    # Cache.add registers a sample only when BOTH adjacent windows are
+    # aberrant (emdepth.go:339), so the merged CNV starts one window in
+    assert c3.positions[0][0] == 6000
+    assert c3.positions[-1][1] == 10000
+    assert all(cn < 2 for cn in c3.cn)
+    assert all(fc <= -0.5 for fc in c3.log2fc)
+
+
+def test_cache_gap_rule():
+    rng = np.random.default_rng(3)
+    S = 8
+    cache = em.Cache()
+    emitted = []
+    # deletion at window 0 for sample 0, then long gap: the 30kb gap rule
+    # must flush it once subsequent windows are far enough
+    for w in range(6):
+        d = rng.gamma(40, 0.8, size=S)
+        if w == 0:
+            d[0] *= 0.2
+        start = w * 40_000
+        e = em.em_depth(d, start=start, end=start + 1000)
+        emitted += cache.add(e)
+    assert any(c.sample_i == 0 for c in emitted)
