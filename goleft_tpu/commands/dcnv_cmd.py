@@ -1,0 +1,52 @@
+"""dcnv: GC-debias and normalize a depth matrix.
+
+Rebuild of the reference's standalone prototype (dcnv/dcnv.go): read a
+depthwed-style matrix + reference fasta, compute GC per window (flanked
+250bp upstream, dcnv.go:82-86), sample-median normalize (65th pctile of
+nonzero, ":108-125"), sort-by-GC → moving-median debias → unsort
+(":331-335"), and write the normalized matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from ..io.fai import Faidx
+from ..models.dcnv import gc_debias_pipeline
+from .emdepth_cmd import read_matrix
+
+
+def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None):
+    out = out or sys.stdout
+    chroms, starts, ends, depths, samples = read_matrix(matrix_path)
+    fa = Faidx(fasta)
+    gcs = np.array([
+        fa.window_stats(c, max(int(s) - 250, 0), int(e))["gc"]
+        for c, s, e in zip(chroms, starts, ends)
+    ])
+    norm = gc_debias_pipeline(depths, gcs, window=window)
+    out.write("#chrom\tstart\tend\t" + "\t".join(samples) + "\n")
+    for i in range(len(chroms)):
+        vals = "\t".join(f"{v:.3f}" for v in norm[i])
+        out.write(f"{chroms[i]}\t{starts[i]}\t{ends[i]}\t{vals}\n")
+    return norm
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu dcnv",
+        description="GC-debias + normalize a depth matrix",
+    )
+    p.add_argument("-f", "--fasta", required=True)
+    p.add_argument("-w", "--window", type=int, default=9,
+                   help="moving-median window (rows)")
+    p.add_argument("matrix")
+    a = p.parse_args(argv)
+    run_dcnv(a.matrix, a.fasta, window=a.window)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""emdepth: EM copy-number calls from a depth matrix.
+
+The reference ships emdepth as a library only (SURVEY.md §2.3); this
+command exposes the batched TPU kernel on a depthwed-style matrix
+(#chrom start end sample...), writing per-sample CNV calls as
+  chrom  start  end  sample  CN  log2FC
+after the streaming 30kb-gap merge (models/emdepth.py Cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from ..models import emdepth as em
+from ..utils.xopen import xopen
+
+
+def read_matrix(path: str):
+    """depthwed matrix → (chroms, starts, ends, depths (B,S), samples)."""
+    chroms, starts, ends, rows = [], [], [], []
+    with xopen(path) as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        samples = header[3:]
+        for line in fh:
+            t = line.rstrip("\n").split("\t")
+            chroms.append(t[0])
+            starts.append(int(t[1]))
+            ends.append(int(t[2]))
+            rows.append([float(x) for x in t[3:]])
+    return (np.array(chroms), np.array(starts), np.array(ends),
+            np.array(rows, dtype=np.float64), samples)
+
+
+def run_emdepth(matrix_path: str, out=None, normalize: bool = True):
+    out = out or sys.stdout
+    chroms, starts, ends, depths, samples = read_matrix(matrix_path)
+    if len(depths) == 0:
+        return
+    if normalize:
+        # scale each sample to its median so depths are comparable; the
+        # reference expects pre-normalized input (emdepth.go:7)
+        med = np.median(depths, axis=0)
+        med[med == 0] = 1.0
+        depths = depths / med[None, :] * np.median(med)
+
+    lambdas = np.asarray(em.em_depth_batch(depths))
+    cns = np.asarray(em.cn_batch(lambdas, depths))
+    out.write("#chrom\tstart\tend\tsample\tCN\tlog2FC\n")
+    cache = em.Cache()
+    results = []
+
+    def emit(cnvs, chrom):
+        for c in cnvs:
+            results.append(
+                (chrom, c.positions[0][0], c.positions[-1][1],
+                 samples[c.sample_i],
+                 int(round(np.median(c.cn))),
+                 float(np.mean(c.log2fc)))
+            )
+
+    cur = None
+    for b in range(len(depths)):
+        if chroms[b] != cur:
+            emit(cache.clear(None), cur)
+            cache = em.Cache()
+            cur = chroms[b]
+        e = em.EMD(lambdas[b], depths[b], int(starts[b]), int(ends[b]))
+        emit(cache.add(e), cur)
+    emit(cache.clear(None), cur)
+    for chrom, s, e, sample, cn, fc in results:
+        out.write(f"{chrom}\t{s}\t{e}\t{sample}\t{cn}\t{fc:.3f}\n")
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu emdepth",
+        description="EM copy-number calls from a depthwed matrix",
+    )
+    p.add_argument("--no-normalize", action="store_true",
+                   help="input is already normalized")
+    p.add_argument("matrix", help="depthwed-style matrix (tsv/gz)")
+    a = p.parse_args(argv)
+    run_emdepth(a.matrix, normalize=not a.no_normalize)
+
+
+if __name__ == "__main__":
+    main()

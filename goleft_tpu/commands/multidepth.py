@@ -1,0 +1,175 @@
+"""multidepth: joint depth blocks across many BAMs.
+
+Rebuild of the reference's unregistered prototype
+(multidepth/multidepth.go): one ``samtools depth`` over all bams per 5Mb
+chunk becomes a vmapped device coverage kernel producing a
+(samples × bases) depth matrix per chunk; positions where
+> minSamples samples have depth ≥ MinCov are kept, split into blocks at
+gaps > MaxSkip (":163-171,242-254"), blocks shorter than MinSize sites
+dropped (":245"), long blocks discretized to Window (":184-199"), and
+per-sample mean depth written as %.2f (":270-283").
+
+The reference processes chunks in parallel with a skip-until-gap
+handshake at chunk boundaries (":217-241"); we stream chunks sequentially
+with carried state, so blocks spanning chunk boundaries are exact rather
+than heuristic.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import numpy as np
+
+from ..io.bai import read_bai
+from ..io.bam import BamReader
+from ..ops.coverage import bucket_size, depth_from_segments
+from .depth import _decode_shard
+from .indexcov import get_short_name
+
+CHUNK = 5_000_000
+
+
+def _chunk_depth_matrix(bam_blobs, bais, tid, start, end, mapq, max_cov):
+    """(n_samples, end-start) int32 depth matrix for one chunk."""
+    L = end - start
+    cols = [
+        _decode_shard(blob, bai, tid, start, end)
+        for blob, bai in zip(bam_blobs, bais)
+    ]
+    n_seg = max((len(c.seg_start) for c in cols), default=0)
+    b = bucket_size(max(n_seg, 1))
+    S = len(cols)
+    seg_s = np.zeros((S, b), dtype=np.int32)
+    seg_e = np.zeros((S, b), dtype=np.int32)
+    keep = np.zeros((S, b), dtype=bool)
+    for i, c in enumerate(cols):
+        n = len(c.seg_start)
+        if not n:
+            continue
+        seg_s[i, :n] = c.seg_start
+        seg_e[i, :n] = c.seg_end
+        ok = (c.mapq >= mapq) & ((c.flag & 0x704) == 0)
+        keep[i, :n] = ok[c.seg_read]
+    fn = jax.vmap(
+        lambda s, e, k: depth_from_segments(
+            s, e, k, L, region_start=start, depth_cap=max_cov
+        )
+    )
+    return np.asarray(fn(seg_s, seg_e, keep))
+
+
+def run_multidepth(
+    bams: list[str],
+    chrom: str,
+    mapq: int = 10,
+    min_cov: int = 7,
+    max_cov: int = 1000,
+    max_skip: int = 10,
+    min_size: int = 15,
+    window: int = 10_000_000,
+    min_samples: float = 0.5,
+    out=None,
+):
+    out = out or sys.stdout
+    blobs = []
+    bais = []
+    names = []
+    tid = None
+    chrom_len = None
+    import os
+
+    for b in bams:
+        with open(b, "rb") as fh:
+            blobs.append(fh.read())
+        hdr = BamReader(blobs[-1]).header
+        bai_p = b + ".bai" if os.path.exists(b + ".bai") else b[:-4] + ".bai"
+        bais.append(read_bai(bai_p))
+        names.append(get_short_name(b))
+        if tid is None:
+            if chrom not in hdr.ref_names:
+                raise SystemExit(
+                    f"multidepth: chromosome {chrom} not found in {b}"
+                )
+            tid = hdr.tid(chrom)
+            chrom_len = hdr.ref_lens[tid]
+
+    n_min = int(0.5 + min_samples * len(bams))
+    out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
+
+    # streamed qualifying-site runs carried across chunk boundaries
+    cache_pos: list[int] = []
+    cache_depths: list[np.ndarray] = []
+
+    def flush():
+        if len(cache_pos) >= min_size:
+            for blk_s, blk_e, means in _split_blocks(
+                cache_pos, cache_depths, window
+            ):
+                vals = "\t".join(f"{m:.2f}" for m in means)
+                out.write(f"{chrom}\t{blk_s}\t{blk_e}\t{vals}\n")
+        cache_pos.clear()
+        cache_depths.clear()
+
+    for cstart in range(0, chrom_len, CHUNK):
+        cend = min(cstart + CHUNK, chrom_len)
+        mat = _chunk_depth_matrix(
+            blobs, bais, tid, cstart, cend, mapq, max_cov
+        )
+        qual = (mat >= min_cov).sum(axis=0) > n_min
+        has_any = mat.sum(axis=0) > 0  # samtools only emits covered rows
+        qual &= has_any
+        idxs = np.flatnonzero(qual)
+        for i in idxs:
+            p = cstart + int(i)
+            if cache_pos and p - (cache_pos[-1] + 1) > max_skip:
+                flush()
+            cache_pos.append(p)
+            cache_depths.append(mat[:, i])
+    flush()
+
+
+def _split_blocks(positions, depths, window):
+    """Discretize a run of sites into ≤window blocks
+    (multidepth.go:184-199); per-sample mean over the sites of each block
+    divided by block span."""
+    i = 0
+    n = len(positions)
+    while i < n:
+        bs = positions[i]
+        j = i + 1
+        while j < n and positions[j] - bs < window:
+            j += 1
+        be = positions[j - 1] + 1
+        span = be - bs
+        sums = np.sum(depths[i:j], axis=0, dtype=np.float64)
+        yield bs, be, sums / span
+        i = j
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu multidepth",
+        description="joint depth blocks across many bams",
+    )
+    p.add_argument("-Q", "--mapq", type=int, default=10)
+    p.add_argument("-c", "--chrom", required=True)
+    p.add_argument("--mincov", type=int, default=7)
+    p.add_argument("--maxcov", type=int, default=1000)
+    p.add_argument("-k", "--maxskip", type=int, default=10)
+    p.add_argument("-m", "--minsize", type=int, default=15)
+    p.add_argument("-w", "--window", type=int, default=10_000_000)
+    p.add_argument("--minsamples", type=float, default=0.5)
+    p.add_argument("bams", nargs="+")
+    a = p.parse_args(argv)
+    run_multidepth(
+        a.bams, a.chrom, mapq=a.mapq, min_cov=a.mincov, max_cov=a.maxcov,
+        max_skip=a.maxskip, min_size=a.minsize, window=a.window,
+        min_samples=a.minsamples,
+    )
+
+
+if __name__ == "__main__":
+    main()

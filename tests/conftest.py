@@ -26,3 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# The axon TPU plugin force-overrides the JAX_PLATFORMS env var, so pin
+# the platform through the config API — tests must run on the virtual
+# 8-device CPU mesh, never the real chip.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,138 @@
+"""CLI-level tests: emdepth, dcnv, cnveval, multidepth commands."""
+
+import io
+
+import numpy as np
+import pytest
+
+from goleft_tpu.commands.emdepth_cmd import run_emdepth, read_matrix
+from goleft_tpu.commands.dcnv_cmd import run_dcnv
+from goleft_tpu.commands.cnveval_cmd import run_cnveval
+from goleft_tpu.commands.multidepth import run_multidepth
+from goleft_tpu.cli import main as cli_main, PROGS
+
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+def _write_matrix(path, chroms, starts, ends, depths, samples):
+    with open(path, "w") as fh:
+        fh.write("#chrom\tstart\tend\t" + "\t".join(samples) + "\n")
+        for i in range(len(chroms)):
+            vals = "\t".join(str(v) for v in depths[i])
+            fh.write(f"{chroms[i]}\t{starts[i]}\t{ends[i]}\t{vals}\n")
+
+
+def test_emdepth_cmd_finds_deletion(tmp_path):
+    rng = np.random.default_rng(0)
+    n_win, n_s = 40, 12
+    depths = rng.gamma(40, 1.0, size=(n_win, n_s)).round(1)
+    depths[10:16, 4] *= 0.25  # heterozygous-deletion-like run in sample 4
+    starts = np.arange(n_win) * 1000
+    p = str(tmp_path / "m.tsv")
+    _write_matrix(p, ["chr1"] * n_win, starts, starts + 1000, depths,
+                  [f"s{i}" for i in range(n_s)])
+    out = io.StringIO()
+    results = run_emdepth(p, out=out)
+    assert any(r[3] == "s4" and r[4] < 2 for r in results)
+    hit = next(r for r in results if r[3] == "s4")
+    assert 10000 <= hit[1] <= 12000
+    assert hit[2] <= 17000
+    lines = out.getvalue().splitlines()
+    assert lines[0].startswith("#chrom")
+
+
+def test_read_matrix_roundtrip(tmp_path):
+    p = str(tmp_path / "m.tsv")
+    _write_matrix(p, ["1", "1"], [0, 100], [100, 200],
+                  [[1.5, 2.0], [3.0, 4.0]], ["a", "b"])
+    chroms, starts, ends, d, samples = read_matrix(p)
+    assert samples == ["a", "b"]
+    np.testing.assert_array_equal(d, [[1.5, 2.0], [3.0, 4.0]])
+
+
+def test_dcnv_cmd(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 200
+    w = 1000  # window >> the 250bp GC flank so the covariate stays sharp
+    seqs = []
+    gcs = rng.random(n)
+    for g in gcs:
+        n_gc = int(g * w)
+        seqs.append("G" * n_gc + "A" * (w - n_gc))
+    fasta = write_fasta(str(tmp_path / "r.fa"), {"chr1": "".join(seqs)})
+    starts = np.arange(n) * w
+    depths = np.outer(50 + 100 * gcs, np.ones(3)) + rng.normal(0, 2, (n, 3))
+    p = str(tmp_path / "m.tsv")
+    _write_matrix(p, ["chr1"] * n, starts, starts + w, depths.round(1),
+                  ["a", "b", "c"])
+    out = io.StringIO()
+    norm = run_dcnv(p, fasta, out=out)
+    assert norm.shape == (n, 3)
+    r = np.corrcoef(gcs, norm[:, 0])[0, 1]
+    assert abs(r) < 0.45  # GC bias largely removed
+    lines = out.getvalue().splitlines()
+    assert len(lines) == n + 1
+
+
+def test_cnveval_cmd(tmp_path):
+    truth = tmp_path / "truth.bed"
+    truth.write_text(
+        "1\t1000\t15000\t1\ta,b\n"
+        "1\t50000\t140000\t3\ta\n"
+        "2\t0\t300000\t1\tc\n"
+    )
+    test = tmp_path / "test.bed"
+    test.write_text(
+        "1\t1000\t15000\t1\ta\n"  # TP small
+        "1\t50000\t140000\t3\ta\n"  # TP medium
+        "1\t500000\t540000\t1\ta\n"  # FP medium
+        "2\t600000\t620000\t1\tb\n"  # FP for b; b's truth becomes FN
+    )
+    out = io.StringIO()
+    tabs = run_cnveval(str(truth), str(test), out=out)
+    assert tabs["all"].tp == 2
+    assert tabs["all"].fp >= 2
+    # b (has calls) misses its truth → FN. Sample c has NO calls at all and
+    # the reference counts no FN for call-less samples (cnveval.go:290-292)
+    assert tabs["all"].fn == 1
+    text = out.getvalue()
+    assert "size-class" in text and "precision" in text
+
+
+def test_multidepth(tmp_path):
+    rng = np.random.default_rng(3)
+    ref_len = 50_000
+    paths = []
+    for s in range(4):
+        # dense coverage in [10k, 20k), sparse elsewhere
+        reads = sorted(
+            random_reads(rng, 600, 0, 10_000) +  # positions 0..10k sparse-ish
+            [(0, int(p), "100M", 60, 0)
+             for p in rng.integers(10_000, 19_900, size=2000)]
+        )
+        reads = sorted(reads, key=lambda r: r[1])
+        p = str(tmp_path / f"md{s}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(ref_len,))
+        paths.append(p)
+    out = io.StringIO()
+    run_multidepth(paths, "chr1", mapq=1, min_cov=7, min_samples=0.5,
+                   out=out)
+    lines = out.getvalue().splitlines()
+    # names come from the @RG SM tag (get_short_name prefers it)
+    assert lines[0] == "#chrom\tstart\tend" + "\tsampleA" * 4
+    rows = [l.split("\t") for l in lines[1:]]
+    assert rows, "expected at least one block"
+    # blocks should be inside the densely covered region
+    for r in rows:
+        s, e = int(r[1]), int(r[2])
+        assert 9_500 <= s < e <= 20_500
+        # per-sample means ≥ some depth
+        assert all(float(v) > 1 for v in r[3:])
+
+
+def test_cli_dispatcher(capsys):
+    assert cli_main([]) == 0
+    err = capsys.readouterr().err
+    for prog in PROGS:
+        assert prog in err
+    assert cli_main(["nope"]) == 1

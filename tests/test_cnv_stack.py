@@ -1,0 +1,207 @@
+"""Tests: mops EM, dcnv scalers/debiasers, cnveval, emdepth/dcnv/cnveval
+CLIs, multidepth."""
+
+import io
+
+import numpy as np
+import pytest
+
+from goleft_tpu.models import mops
+from goleft_tpu.models import dcnv
+from goleft_tpu.models.cnveval import CNV, Truth, evaluate, tabulate
+
+
+# ---------- mops ----------
+
+def test_mops_posteriors_normal_cohort():
+    d = np.array([[30, 28, 33, 34, 35, 37, 31, 22, 38]], dtype=np.float64)
+    res = mops.mops_batch(d)
+    aik = np.asarray(res["aik"])[0]
+    # posterior columns sum to ~1
+    np.testing.assert_allclose(aik.sum(axis=0), 1.0, atol=1e-5)
+    cns = np.asarray(mops.posterior_cn(np.asarray(res["aik"])))[0]
+    assert list(cns) == [2] * 9
+    # information gain near zero for an all-CN2 window
+    ig = np.asarray(mops.information_gain(np.asarray(res["aik"])))[0]
+    assert ig < 0.1
+
+
+def test_mops_detects_outliers():
+    d = np.array(
+        [[296.6, 16.7, 17.0, 319.2, 14.4, 16.5, 14.2]], dtype=np.float64
+    )
+    res = mops.mops_batch(d)
+    cns = np.asarray(mops.posterior_cn(np.asarray(res["aik"])))[0]
+    # characterization: the reference equations (mean-based λ init,
+    # mops.go:139-161) converge to λ≈73 here, putting the outliers in the
+    # top class and typical samples at CN1
+    assert cns[0] == 7 and cns[3] == 7
+    assert all(c == 1 for c in cns[[1, 2, 4, 5, 6]])
+    ig = np.asarray(mops.information_gain(np.asarray(res["aik"])))[0]
+    assert ig > 0.1
+    lam = float(np.asarray(res["lambda"])[0])
+    assert lam == pytest.approx(73.1158, abs=0.01)
+
+
+def test_mops_batch_shapes():
+    rng = np.random.default_rng(0)
+    d = rng.gamma(30, 1, size=(7, 12))
+    res = mops.mops_batch(d)
+    assert np.asarray(res["aik"]).shape == (7, mops.MAX_CN, 12)
+    assert np.asarray(res["alpha"]).shape == (7, mops.MAX_CN)
+
+
+# ---------- dcnv scalers ----------
+
+def test_zscore_roundtrip():
+    rng = np.random.default_rng(1)
+    a = rng.gamma(10, 3, size=(40, 6))
+    z = dcnv.ZScore()
+    scaled = z.scale(a.copy())
+    np.testing.assert_allclose(scaled.mean(axis=1), 0, atol=1e-12)
+    back = z.unscale(scaled)
+    np.testing.assert_allclose(back, a, rtol=1e-9)
+
+
+def test_log2_roundtrip():
+    rng = np.random.default_rng(2)
+    a = rng.gamma(10, 3, size=(30, 4))
+    l2 = dcnv.Log2()
+    back = l2.unscale(l2.scale(a.copy()))
+    np.testing.assert_allclose(back, 1 + a, rtol=1e-9)  # 2^(log2(1+d))
+    # round-trip recovers 1+d (reference UnScale has the same asymmetry:
+    # scalers.go:155-163 exponentiates without subtracting the 1)
+
+
+def test_row_col_centered_roundtrip():
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(10, 5))
+    for cls, axis in ((dcnv.RowCentered, 1), (dcnv.ColCentered, 0)):
+        sc = cls(np.median)
+        out = sc.scale(a.copy())
+        assert np.allclose(np.median(out, axis=axis), 0, atol=1e-12)
+        np.testing.assert_allclose(sc.unscale(out), a, rtol=1e-12)
+
+
+def test_general_debiaser_sort_roundtrip():
+    rng = np.random.default_rng(4)
+    a = rng.normal(size=(50, 3))
+    gcs = rng.random(50)
+    db = dcnv.GeneralDebiaser(gcs.copy())
+    srt = db.sort(a.copy())
+    # sorted by gc
+    assert np.all(np.diff(db.vals) >= 0)
+    back = db.unsort(srt)
+    np.testing.assert_array_equal(back, a)
+    np.testing.assert_array_equal(db.vals, gcs)
+
+
+def test_gc_debias_removes_bias():
+    rng = np.random.default_rng(5)
+    n = 400
+    gcs = rng.random(n)
+    # depth strongly biased by GC: depth ~ 100 * (0.5 + gc)
+    bias = 0.5 + gcs
+    depths = np.outer(bias * 100, np.ones(4)) + rng.normal(0, 2, (n, 4))
+    norm = dcnv.gc_debias_pipeline(depths, gcs, window=31)
+    # after debias, correlation with GC is largely removed
+    r_before = np.corrcoef(gcs, depths[:, 0])[0, 1]
+    r_after = np.corrcoef(gcs, norm[:, 0])[0, 1]
+    assert abs(r_before) > 0.9
+    assert abs(r_after) < 0.3
+
+
+def test_chunk_debiaser():
+    rng = np.random.default_rng(6)
+    n = 200
+    gcs = np.sort(rng.random(n))
+    depths = np.outer(50 + 100 * gcs, np.ones(2))
+    cd = dcnv.ChunkDebiaser(gcs.copy(), score_window=0.1)
+    srt = cd.sort(depths.copy())
+    deb = cd.debias(srt)
+    out = cd.unsort(deb)
+    # each bucket normalized to ~1 around its median
+    assert 0.5 < np.median(out) < 2.0
+    assert out.std() < depths.std()
+
+
+def test_svd_debiaser_removes_dominant_component():
+    rng = np.random.default_rng(7)
+    batch_effect = np.outer(rng.normal(size=100), rng.normal(size=8)) * 10
+    signal = rng.normal(size=(100, 8))
+    a = batch_effect + signal
+    out = dcnv.SVDDebiaser(min_variance_pct=20).debias(a)
+    assert np.linalg.norm(out) < np.linalg.norm(a) * 0.8
+
+
+def test_sample_medians():
+    depths = np.array(
+        [[0, 10], [0, 20], [4, 30], [8, 40], [12, 50]], dtype=float
+    )
+    meds = dcnv.sample_medians(depths)
+    # col0 nonzero = [4,8,12] → idx int(0.65*3)=1 → 8
+    assert meds[0] == 8
+    # col1 = [10..50] → idx int(0.65*5)=3 → 40
+    assert meds[1] == 40
+
+
+# ---------- cnveval ----------
+
+def _t(chrom, s, e, samples, cn):
+    return Truth(chrom, s, e, samples, cn)
+
+
+def _c(chrom, s, e, sample, cn):
+    return CNV(chrom, s, e, sample, cn)
+
+
+def test_cnveval_perfect_calls():
+    truths = [_t("1", 1000, 15000, ["a"], 1),
+              _t("1", 50000, 140000, ["b"], 3)]
+    cnvs = [_c("1", 1000, 15000, "a", 1), _c("1", 50000, 140000, "b", 3)]
+    tabs = tabulate(evaluate(cnvs, truths, 0.4))
+    assert tabs["all"].tp == 2 and tabs["all"].fp == 0
+    assert tabs["all"].fn == 0
+    assert tabs["small"].tp == 1  # 14kb
+    assert tabs["medium"].tp == 1  # 90kb
+    assert tabs["all"].precision() == 1.0
+    assert tabs["all"].recall() == 1.0
+
+
+def test_cnveval_fn_and_fp():
+    truths = [_t("1", 1000, 15000, ["a"], 1)]
+    cnvs = [_c("1", 200000, 230000, "a", 3)]  # no overlap → FP; truth → FN
+    tabs = tabulate(evaluate(cnvs, truths, 0.4))
+    assert tabs["all"].fn == 1
+    assert tabs["all"].fp == 1
+    assert tabs["all"].tp == 0
+
+
+def test_cnveval_cn_collapse():
+    # CN 4 vs CN 3 collapse to the same dup state (cnveval.go:354-362)
+    truths = [_t("1", 1000, 15000, ["a"], 4)]
+    cnvs = [_c("1", 1000, 15000, "a", 3)]
+    tabs = tabulate(evaluate(cnvs, truths, 0.4))
+    assert tabs["all"].tp == 1
+    # but CN 1 vs CN 3 do not match
+    truths = [_t("1", 1000, 15000, ["a"], 1)]
+    tabs = tabulate(evaluate([_c("1", 1000, 15000, "a", 3)], truths, 0.4))
+    assert tabs["all"].tp == 0
+
+
+def test_cnveval_cross_sample_fp():
+    # call matches a truth interval that belongs to another sample → FP
+    truths = [_t("1", 1000, 15000, ["b"], 1)]
+    cnvs = [_c("1", 1000, 15000, "a", 1)]
+    tabs = tabulate(evaluate(cnvs, truths, 0.4))
+    assert tabs["all"].fp >= 1
+    assert tabs["all"].tp == 0
+
+
+def test_cnveval_reciprocal_overlap():
+    # tiny call inside a big truth: poverlap uses the smaller interval, so
+    # a fully-contained call always "overlaps"
+    truths = [_t("1", 0, 100000, ["a"], 1)]
+    cnvs = [_c("1", 40000, 45000, "a", 1)]
+    tabs = tabulate(evaluate(cnvs, truths, 0.4))
+    assert tabs["all"].tp == 1
