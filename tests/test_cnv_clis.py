@@ -106,7 +106,7 @@ def test_multidepth(tmp_path):
     for s in range(4):
         # dense coverage in [10k, 20k), sparse elsewhere
         reads = sorted(
-            random_reads(rng, 600, 0, 10_000) +  # positions 0..10k sparse-ish
+            random_reads(rng, 100, 0, 10_000) +  # ~1x over 0..10k: sparse
             [(0, int(p), "100M", 60, 0)
              for p in rng.integers(10_000, 19_900, size=2000)]
         )
