@@ -1,0 +1,1 @@
+from .mesh import make_mesh, best_grid  # noqa: F401

@@ -1,0 +1,112 @@
+"""Host shard scheduler: decode pipeline with retry + result cache.
+
+The reference's execution layer is gargs' process pool with
+``Options{Retries: 1, Ordered}`` and red-banner error propagation
+(depth/depth.go:392-399); here the units of work are (bam, region) decode
+tasks feeding the device, run on a thread pool with:
+
+  - retry-once per shard (matching Retries: 1)
+  - ordered result consumption (matching Ordered)
+  - max-exit-code-style error propagation: failures are recorded, other
+    shards keep running, and the first exception re-raises at the end
+  - an optional on-disk result cache keyed by (file identity, region,
+    params) making reruns/resume nearly free (SURVEY.md §5 checkpoint
+    gap: the reference restarts from scratch)
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import hashlib
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Sequence
+
+
+@dataclass
+class ShardResult:
+    key: tuple
+    value: Any = None
+    error: Exception | None = None
+    attempts: int = 1
+    from_cache: bool = False
+
+
+class ResultCache:
+    """Pickle-per-key cache under a directory."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: tuple) -> str:
+        h = hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+        return os.path.join(self.dir, h + ".pkl")
+
+    def get(self, key: tuple):
+        p = self._path(key)
+        if not os.path.exists(p):
+            return None
+        try:
+            with open(p, "rb") as fh:
+                return pickle.load(fh)
+        except Exception:
+            return None
+
+    def put(self, key: tuple, value) -> None:
+        p = self._path(key)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(value, fh)
+        os.replace(tmp, p)
+
+
+def file_key(path: str) -> tuple:
+    """Cache-key component identifying a file's content cheaply."""
+    st = os.stat(path)
+    return (os.path.abspath(path), st.st_size, int(st.st_mtime))
+
+
+def run_sharded(
+    tasks: Sequence[tuple],
+    fn: Callable[..., Any],
+    processes: int = 4,
+    retries: int = 1,
+    cache: ResultCache | None = None,
+    ordered: bool = True,
+    strict: bool = False,
+) -> Iterable[ShardResult]:
+    """Run fn(*task) per task; yield ShardResults in task order (ordered)
+    or completion order. Failed shards come back with .error set and the
+    rest keep running (the reference's max-exit-code behavior); with
+    strict=True the first error re-raises once all tasks finish."""
+
+    def attempt(task) -> ShardResult:
+        key = tuple(task)
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                return ShardResult(key, hit, from_cache=True)
+        err = None
+        for a in range(retries + 1):
+            try:
+                val = fn(*task)
+                if cache is not None:
+                    cache.put(key, val)
+                return ShardResult(key, val, attempts=a + 1)
+            except Exception as e:  # noqa: BLE001 - shard isolation
+                err = e
+        return ShardResult(key, error=err, attempts=retries + 1)
+
+    first_error: Exception | None = None
+    with cf.ThreadPoolExecutor(max_workers=max(processes, 1)) as ex:
+        futs = [ex.submit(attempt, t) for t in tasks]
+        it = futs if ordered else cf.as_completed(futs)
+        for f in it:
+            res = f.result()
+            if res.error is not None and first_error is None:
+                first_error = res.error
+            yield res
+    if strict and first_error is not None:
+        raise first_error

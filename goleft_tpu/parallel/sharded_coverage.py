@@ -1,0 +1,148 @@
+"""Sequence-parallel coverage: segmented cumsum with inter-shard carries.
+
+This is the rebuild's true "sequence parallelism" (SURVEY.md §2.5): the
+genome-position axis is sharded across the mesh's ``seq`` axis. Each
+device scatter-adds the delta endpoints that fall in its shard (reads
+straddling shard boundaries contribute their +1 and −1 to *different*
+shards — no duplication or boundary bookkeeping, unlike the reference's
+window flush/backfill code at depth/depth.go:293-359), computes a local
+cumsum, then adds the exclusive prefix of all left-shard totals, obtained
+with one small all_gather over ICI. Sample batches ride the ``data`` axis
+(fully independent — no collectives).
+
+Layout contract: callers pass segment endpoint arrays already partitioned
+per seq-shard (equal padded length per shard) — the host scheduler's
+bucketing (indexsplit-style even-data planning) produces exactly this.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def sharded_depth_fn(mesh: Mesh, shard_len: int, window: int,
+                     seq_axis: str = "seq", data_axis: str = "data"):
+    """Build a jitted (samples × genome) coverage function over ``mesh``.
+
+    Returns fn(seg_start, seg_end, keep) with shapes
+      seg_start/seg_end: (S, n_seq * n_per_shard) int32, genome-absolute
+      keep: same shape bool
+    computing (S, n_seq * shard_len) per-base depth and
+    (S, n_win_total) window sums. S must be divisible by the data axis.
+    """
+    n_seq = mesh.shape[seq_axis]
+    if shard_len % window:
+        raise ValueError("shard_len must be a multiple of window")
+
+    def local(seg_s, seg_e, keep, shard_id):
+        # seg arrays: (S_local, n_per_shard) — endpoints for THIS shard
+        lo = shard_id * shard_len
+        s = jnp.where(keep, seg_s - lo, shard_len)
+        e = jnp.where(keep, seg_e - lo, shard_len)
+        s = jnp.clip(s, 0, shard_len)
+        e = jnp.clip(e, 0, shard_len)
+
+        def one(si, ei):
+            delta = jnp.zeros(shard_len + 1, jnp.int32)
+            delta = delta.at[si].add(1).at[ei].add(-1)
+            return delta[:shard_len]
+
+        deltas = jax.vmap(one)(s, e)  # (S_local, shard_len)
+        local_cs = jnp.cumsum(deltas, axis=1)
+        totals = local_cs[:, -1]  # (S_local,)
+        # exclusive prefix over seq shards: one tiny all_gather on ICI
+        all_totals = jax.lax.all_gather(
+            totals, seq_axis, axis=0
+        )  # (n_seq, S_local)
+        carry = jnp.sum(
+            jnp.where(
+                (jnp.arange(n_seq) < shard_id)[:, None], all_totals, 0
+            ),
+            axis=0,
+        )
+        depth = local_cs + carry[:, None]
+        wsums = depth.astype(jnp.float32).reshape(
+            depth.shape[0], -1, window
+        ).sum(axis=2)
+        return depth, wsums
+
+    def wrapped(seg_s, seg_e, keep):
+        def inner(seg_s, seg_e, keep):
+            sid = jax.lax.axis_index(seq_axis)
+            return local(seg_s, seg_e, keep, sid)
+
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(data_axis, seq_axis),) * 3,
+            out_specs=(P(data_axis, seq_axis), P(data_axis, seq_axis)),
+            check_rep=False,
+        )(seg_s, seg_e, keep)
+
+    return jax.jit(wrapped)
+
+
+def partition_segments(seg_start, seg_end, keep, n_seq: int,
+                       shard_len: int, pad_to: int | None = None):
+    """Host-side endpoint partitioning for the sharded kernel.
+
+    Each segment's +1 endpoint goes to the shard containing its start and
+    its −1 endpoint to the shard containing its end; an endpoint at or
+    past the sharded extent is dropped (its effect is identical to
+    clipping at the global end). Returns (seg_s, seg_e, keep) arrays of
+    shape (S, n_seq * per_shard) laid out shard-major for P("data","seq").
+    """
+    import numpy as np
+
+    S = seg_start.shape[0]
+    L = n_seq * shard_len
+    out_s, out_e, out_k = [], [], []
+    per_shard = pad_to or 0
+    parts = []
+    for b in range(S):
+        ss, ee, kk = seg_start[b], seg_end[b], keep[b]
+        ss, ee = ss[kk], ee[kk]
+        row = []
+        for q in range(n_seq):
+            lo, hi = q * shard_len, (q + 1) * shard_len
+            starts_here = ss[(ss >= lo) & (ss < hi)]
+            # half-open on the same side as starts: an end exactly at lo
+            # belongs to THIS shard as a −1 at local position 0 — putting
+            # it at the previous shard's top slot would drop it from that
+            # shard's total and over-carry every shard to the right
+            ends_here = ee[(ee >= lo) & (ee < hi)]
+            # balance the two lists into one (start, end) array: starts
+            # pair with dummy ends at the shard top and vice versa — the
+            # kernel treats the two endpoint columns independently
+            n = max(len(starts_here), len(ends_here))
+            per_shard = max(per_shard, n)
+            row.append((starts_here, ends_here))
+        parts.append(row)
+    per = per_shard if per_shard > 0 else 1
+    seg_s = np.full((S, n_seq, per), 0, dtype=np.int32)
+    seg_e = np.full((S, n_seq, per), 0, dtype=np.int32)
+    kp = np.zeros((S, n_seq, per), dtype=bool)
+    for b in range(S):
+        for q in range(n_seq):
+            starts_here, ends_here = parts[b][q]
+            lo, hi = q * shard_len, (q + 1) * shard_len
+            n = max(len(starts_here), len(ends_here))
+            if n == 0:
+                continue
+            srow = np.full(n, hi, dtype=np.int64)  # clip-slot: no effect
+            erow = np.full(n, hi, dtype=np.int64)
+            srow[: len(starts_here)] = starts_here
+            erow[: len(ends_here)] = ends_here
+            seg_s[b, q, :n] = srow
+            seg_e[b, q, :n] = erow
+            kp[b, q, :n] = True
+    return (
+        seg_s.reshape(S, n_seq * per),
+        seg_e.reshape(S, n_seq * per),
+        kp.reshape(S, n_seq * per),
+    )

@@ -1,0 +1,134 @@
+"""Sharded coverage on the 8-device virtual CPU mesh + scheduler tests."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from goleft_tpu.parallel.mesh import make_mesh, best_grid
+from goleft_tpu.parallel.sharded_coverage import (
+    sharded_depth_fn, partition_segments,
+)
+from goleft_tpu.parallel.scheduler import (
+    ResultCache, ShardResult, run_sharded, file_key,
+)
+
+
+def brute_depth(starts, ends, L):
+    d = np.zeros(L, dtype=np.int64)
+    for s, e in zip(starts, ends):
+        d[max(s, 0):min(e, L)] += 1
+    return d
+
+
+def test_best_grid():
+    assert best_grid(8) == (2, 4)
+    assert best_grid(4) == (2, 2)
+    assert best_grid(1) == (1, 1)
+    assert best_grid(8, prefer_seq=8) == (1, 8)
+
+
+def test_mesh_shape():
+    mesh = make_mesh(8)
+    assert mesh.shape["data"] == 2 and mesh.shape["seq"] == 4
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_depth_matches_brute():
+    mesh = make_mesh(8)  # data=2, seq=4
+    shard_len, window = 4096, 256
+    n_seq = mesh.shape["seq"]
+    L = n_seq * shard_len
+    S = 4  # divisible by data=2
+    rng = np.random.default_rng(0)
+    n = 900
+    starts = rng.integers(0, L - 500, size=(S, n)).astype(np.int32)
+    ends = (starts + rng.integers(50, 2000, size=(S, n))).astype(np.int32)
+    keep = rng.random((S, n)) < 0.9
+    seg_s, seg_e, kp = partition_segments(starts, ends, keep, n_seq,
+                                          shard_len)
+    fn = sharded_depth_fn(mesh, shard_len, window)
+    with mesh:
+        depth, wsums = fn(seg_s, seg_e, kp)
+    depth = np.asarray(depth)
+    wsums = np.asarray(wsums)
+    assert depth.shape == (S, L)
+    for b in range(S):
+        want = brute_depth(starts[b][keep[b]],
+                           np.minimum(ends[b][keep[b]], L), L)
+        np.testing.assert_array_equal(depth[b], want)
+        np.testing.assert_allclose(
+            wsums[b], want.reshape(-1, window).sum(axis=1)
+        )
+
+
+def test_sharded_depth_boundary_reads():
+    """Reads exactly straddling shard boundaries exercise the carry."""
+    mesh = make_mesh(8)
+    shard_len, window = 1024, 128
+    n_seq = mesh.shape["seq"]
+    L = n_seq * shard_len
+    # one read spanning the whole extent + reads crossing each boundary
+    starts = [0]
+    ends = [L]
+    for q in range(1, n_seq):
+        starts.append(q * shard_len - 10)
+        ends.append(q * shard_len + 10)
+    S = 2
+    st = np.tile(np.asarray(starts, np.int32), (S, 1))
+    en = np.tile(np.asarray(ends, np.int32), (S, 1))
+    kp0 = np.ones_like(st, dtype=bool)
+    seg_s, seg_e, kp = partition_segments(st, en, kp0, n_seq, shard_len)
+    fn = sharded_depth_fn(mesh, shard_len, window)
+    with mesh:
+        depth, _ = fn(seg_s, seg_e, kp)
+    depth = np.asarray(depth)
+    want = brute_depth(starts, ends, L)
+    for b in range(S):
+        np.testing.assert_array_equal(depth[b], want)
+
+
+def test_scheduler_retry_and_errors(tmp_path):
+    calls = {"flaky": 0}
+
+    def work(name, x):
+        if name == "flaky":
+            calls["flaky"] += 1
+            if calls["flaky"] == 1:
+                raise RuntimeError("transient")
+        if name == "dead":
+            raise RuntimeError("permanent")
+        return x * 2
+
+    tasks = [("a", 1), ("flaky", 2), ("dead", 3), ("b", 4)]
+    res = list(run_sharded(tasks, work, processes=2, retries=1))
+    assert [r.value for r in res if r.error is None] == [2, 4, 8]
+    assert res[1].attempts == 2  # flaky retried once then succeeded
+    dead = res[2]
+    assert dead.error is not None and dead.attempts == 2
+    with pytest.raises(RuntimeError, match="permanent"):
+        list(run_sharded([("dead", 0)], work, retries=0, strict=True))
+
+
+def test_scheduler_cache(tmp_path):
+    cache = ResultCache(str(tmp_path / "c"))
+    n_calls = {"n": 0}
+
+    def work(x):
+        n_calls["n"] += 1
+        return x + 100
+
+    tasks = [(1,), (2,)]
+    r1 = list(run_sharded(tasks, work, cache=cache))
+    assert n_calls["n"] == 2
+    r2 = list(run_sharded(tasks, work, cache=cache))
+    assert n_calls["n"] == 2  # cache hits, no recompute
+    assert all(r.from_cache for r in r2)
+    assert [r.value for r in r2] == [101, 102]
+
+
+def test_file_key(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello")
+    k1 = file_key(str(p))
+    assert k1[1] == 5
