@@ -81,7 +81,7 @@ def sharded_depth_fn(mesh: Mesh, shard_len: int, window: int,
             mesh=mesh,
             in_specs=(P(data_axis, seq_axis),) * 3,
             out_specs=(P(data_axis, seq_axis), P(data_axis, seq_axis)),
-            check_rep=False,
+            check_vma=False,
         )(seg_s, seg_e, keep)
 
     return jax.jit(wrapped)
