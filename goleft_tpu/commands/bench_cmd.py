@@ -1,0 +1,13 @@
+"""bench: run the TPU benchmark suite (wraps repo-root bench.py)."""
+
+from __future__ import annotations
+
+
+def main(argv=None):
+    import bench
+
+    bench.main(argv or [])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""The flagship end-to-end cohort step: sharded coverage → scaled depth →
+batched EM copy number, as ONE jitted program over the device mesh.
+
+This is the TPU composition of the reference's whole pipeline
+(depth → depthwed → emdepth, SURVEY.md §3.1/§3.5): genome axis sharded
+(``seq``), samples data-parallel (``data``); the only cross-device
+traffic is the segmented-cumsum carry all_gather inside
+sharded_coverage and the resharding between the coverage layout
+(samples × genome) and the EM layout (windows × samples).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.emdepth import em_depth_batch, cn_batch
+from .sharded_coverage import sharded_depth_fn
+
+
+def build_cohort_step(mesh: Mesh, shard_len: int, window: int):
+    """Returns jitted fn(seg_s, seg_e, keep) → dict(depth, wmeans, lambdas,
+    cn). Input arrays (S, n_seq*per) laid out for P('data','seq')."""
+    coverage = sharded_depth_fn(mesh, shard_len, window)
+
+    def step(seg_s, seg_e, keep):
+        depth, wsums = coverage(seg_s, seg_e, keep)
+        wmeans = wsums / window  # (S, n_win)
+        # per-sample scaling (indexcov-style mean-normalization; medians
+        # stay in the host indexcov path where int64 exactness matters)
+        scale = jnp.maximum(wmeans.mean(axis=1, keepdims=True), 1e-6)
+        scaled = wmeans / scale
+        # reshard: EM wants (windows, samples) with windows on 'seq'
+        wm = jax.lax.with_sharding_constraint(
+            scaled.T, NamedSharding(mesh, P("seq", "data"))
+        )
+        lambdas = em_depth_batch(wm * 30.0)  # EM at ~30x pseudo-depth
+        cn = cn_batch(lambdas, wm * 30.0)
+        return {
+            "depth": depth,
+            "wmeans": wmeans,
+            "lambdas": lambdas,
+            "cn": cn,
+        }
+
+    in_shard = NamedSharding(mesh, P("data", "seq"))
+    return jax.jit(step, in_shardings=(in_shard,) * 3)
