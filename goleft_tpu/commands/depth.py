@@ -33,7 +33,7 @@ import sys
 import numpy as np
 
 from ..io.bai import read_bai, query_voffset
-from ..io.bam import BamReader, ReadColumns
+from ..io.bam import ReadColumns, open_bam
 from ..io.fai import Faidx, read_fai
 from ..ops.coverage import (
     bucket_size, run_length_encode, window_bounds, CLASS_NAMES,
@@ -69,16 +69,17 @@ def gen_regions(
     return out
 
 
-def _decode_shard(
-    bam_bytes: bytes, bai, tid: int, start: int, end: int
-) -> ReadColumns:
-    """Host decode of records overlapping [start, end) on tid."""
+def _decode_shard(bam, bai, tid: int, start: int, end: int) -> ReadColumns:
+    """Host decode of records overlapping [start, end) on tid.
+
+    ``bam`` is an open_bam() handle: the native C++ decoder when
+    available (decompressed once, GIL-free per-shard decode), else the
+    pure-Python streaming reader.
+    """
     voff = query_voffset(bai, tid, start)
     if voff is None:
         return ReadColumns.empty()
-    rdr = BamReader(bam_bytes)
-    rdr.seek_virtual(voff)
-    return rdr.read_columns(tid=tid, start=start, end=end)
+    return bam.read_columns(tid=tid, start=start, end=end, voffset=voff)
 
 
 class DepthEngine:
@@ -175,7 +176,8 @@ def run_depth(
 ) -> tuple[str, str]:
     with open(bam, "rb") as fh:
         bam_bytes = fh.read()
-    hdr = BamReader(bam_bytes).header
+    handle = open_bam(bam_bytes)
+    hdr = handle.header
     bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
                    else bam[:-4] + ".bai")
     fai_path = fai or (reference + ".fai" if reference else None)
@@ -211,7 +213,7 @@ def run_depth(
     with open(depth_path, "w") as dout, open(call_path, "w") as cout:
         with cf.ThreadPoolExecutor(max_workers=max(processes, 1)) as ex:
             futs = [
-                ex.submit(_decode_shard, bam_bytes, bai,
+                ex.submit(_decode_shard, handle, bai,
                           tid_of.get(c, -1), s, e)
                 if c in tid_of else None
                 for (c, s, e) in regions

@@ -24,7 +24,7 @@ import jax
 import numpy as np
 
 from ..io.bai import read_bai
-from ..io.bam import BamReader
+from ..io.bam import open_bam
 from ..ops.coverage import bucket_size, depth_from_segments
 from .depth import _decode_shard
 from .indexcov import get_short_name
@@ -36,8 +36,8 @@ def _chunk_depth_matrix(bam_blobs, bais, tid, start, end, mapq, max_cov):
     """(n_samples, end-start) int32 depth matrix for one chunk."""
     L = end - start
     cols = [
-        _decode_shard(blob, bai, tid, start, end)
-        for blob, bai in zip(bam_blobs, bais)
+        _decode_shard(handle, bai, tid, start, end)
+        for handle, bai in zip(bam_blobs, bais)
     ]
     n_seg = max((len(c.seg_start) for c in cols), default=0)
     b = bucket_size(max(n_seg, 1))
@@ -83,8 +83,8 @@ def run_multidepth(
 
     for b in bams:
         with open(b, "rb") as fh:
-            blobs.append(fh.read())
-        hdr = BamReader(blobs[-1]).header
+            blobs.append(open_bam(fh.read()))
+        hdr = blobs[-1].header
         bai_p = b + ".bai" if os.path.exists(b + ".bai") else b[:-4] + ".bai"
         bais.append(read_bai(bai_p))
         names.append(get_short_name(b))

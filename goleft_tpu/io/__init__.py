@@ -1,5 +1,5 @@
 from .bgzf import BgzfReader, BgzfWriter, bgzf_decompress  # noqa: F401
-from .bam import BamReader, BamWriter, BamHeader  # noqa: F401
+from .bam import BamReader, BamWriter, BamHeader, BamFile, open_bam  # noqa: F401
 from .bai import BaiIndex, read_bai  # noqa: F401
 from .crai import CraiIndex, read_crai  # noqa: F401
 from .fai import FaiRecord, read_fai, Faidx  # noqa: F401

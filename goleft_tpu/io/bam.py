@@ -339,6 +339,128 @@ class BamReader:
         )
 
 
+def _parse_header_buf(buf) -> tuple[BamHeader, int]:
+    """Parse the BAM header block from an uncompressed buffer; returns
+    (header, offset of first alignment record)."""
+    if bytes(buf[:4]) != BAM_MAGIC:
+        raise ValueError("not a BAM file (bad magic)")
+    (l_text,) = struct.unpack_from("<i", buf, 4)
+    text = bytes(buf[8 : 8 + l_text]).rstrip(b"\x00").decode()
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", buf, off)
+    off += 4
+    names, lens = [], []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", buf, off)
+        names.append(bytes(buf[off + 4 : off + 4 + l_name - 1]).decode())
+        (l_ref,) = struct.unpack_from("<i", buf, off + 4 + l_name)
+        lens.append(l_ref)
+        off += 8 + l_name
+    return BamHeader(text, names, lens), off
+
+
+class BamFile:
+    """Whole-file decoded BAM with the native decode fast path.
+
+    The compressed stream is inflated ONCE (C++ when available, Python
+    zlib otherwise) and shard decodes run directly over the uncompressed
+    body — the native calls release the GIL so decode threads scale.
+    Virtual offsets from a BAI translate through the block table.
+    """
+
+    def __init__(self, data: bytes):
+        from . import native
+        from .bgzf import bgzf_decompress
+
+        scan = None
+        try:
+            scan = native.bgzf_scan(data)
+        except Exception:
+            scan = None
+        if scan is not None:
+            self._co, self._uo, total = scan
+            self.body = native.bgzf_inflate(data, total)
+            self.native = True
+        else:
+            raw = bgzf_decompress(data)
+            self.body = np.frombuffer(raw, dtype=np.uint8)
+            self._co = self._uo = None
+            self.native = False
+        self.header, self._body_start = _parse_header_buf(
+            bytes(self.body[: min(len(self.body), 1 << 22)])
+        )
+
+    @classmethod
+    def from_file(cls, path: str) -> "BamFile":
+        with open(path, "rb") as fh:
+            return cls(fh.read())
+
+    def voffset_to_offset(self, voff: int) -> int:
+        if self._co is None:
+            raise ValueError("no block table (python fallback)")
+        import numpy as _np
+
+        blk = int(_np.searchsorted(self._co, voff >> 16, side="right")) - 1
+        blk = max(blk, 0)
+        return int(self._uo[blk]) + (voff & 0xFFFF)
+
+    def read_columns(self, tid: int | None = None, start: int = 0,
+                     end: int | None = None,
+                     voffset: int | None = None) -> "ReadColumns":
+        from . import native
+
+        if not self.native:
+            raise RuntimeError("BamFile requires the native library; "
+                               "use open_bam() for automatic fallback")
+        if voffset is not None and self._co is not None:
+            offset = self.voffset_to_offset(voffset)
+        else:
+            offset = self._body_start
+        out = native.bam_decode(
+            self.body, offset,
+            -1 if tid is None else tid, start,
+            -1 if end is None else end,
+        )
+        return ReadColumns(
+            out["tid"], out["pos"], out["end"], out["mapq"],
+            out["flag"], out["tlen"], out["read_len"],
+            out["mate_pos"], out["single_m"].astype(bool),
+            out["tid"][out["seg_read"]] if out["n_reads"] else
+            np.zeros(0, np.int32),
+            out["seg_start"], out["seg_end"], out["seg_read"],
+        )
+
+
+class _PyBamAdapter:
+    """BamFile-compatible shard decoder over the pure-Python reader."""
+
+    native = False
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self.header = BamReader(data).header
+
+    def read_columns(self, tid=None, start=0, end=None, voffset=None
+                     ) -> "ReadColumns":
+        rdr = BamReader(self._data)
+        if voffset is not None:
+            rdr.seek_virtual(voffset)
+        return rdr.read_columns(tid=tid, start=start, end=end)
+
+
+def open_bam(data: bytes):
+    """Decoded-BAM handle: native fast path when available, else the
+    pure-Python streaming adapter (same read_columns signature)."""
+    from . import native
+
+    if native.get_lib() is not None:
+        try:
+            return BamFile(data)
+        except Exception:
+            pass
+    return _PyBamAdapter(data)
+
+
 def reg2bin(beg: int, end: int) -> int:
     """SAM spec section 5.3 bin number for [beg, end)."""
     end -= 1

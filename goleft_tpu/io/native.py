@@ -1,0 +1,173 @@
+"""ctypes loader for the C++ host-IO fast path (csrc/fastio.cpp).
+
+Builds libgoleftio.so lazily with g++ on first use and falls back to the
+pure-Python codecs on any failure (missing toolchain, build error). The
+native calls release the GIL, so the shard-decode thread pool scales.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("goleft-tpu.native")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _build(src: str, out: str) -> bool:
+    try:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        r = subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", src,
+             "-lz", "-o", out],
+            capture_output=True, text=True, timeout=120,
+        )
+        if r.returncode != 0:
+            log.warning("native build failed: %s", r.stderr[-500:])
+            return False
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.warning("native build unavailable: %s", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None (pure-Python fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("GOLEFT_TPU_NO_NATIVE"):
+            return None
+        src = os.path.join(_root(), "csrc", "fastio.cpp")
+        out = os.path.join(_root(), "build", "libgoleftio.so")
+        if not os.path.exists(out) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(out)
+        ):
+            if not os.path.exists(src) or not _build(src, out):
+                return None
+        try:
+            lib = ctypes.CDLL(out)
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+            return None
+        lib.bgzf_scan.restype = ctypes.c_long
+        lib.bgzf_inflate_all.restype = ctypes.c_long
+        lib.bam_decode.restype = ctypes.c_long
+        _lib = lib
+        return _lib
+
+
+def bgzf_scan(data: bytes):
+    """(coffsets, uoffsets, total_uncompressed) via the native scanner;
+    None when native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    max_blocks = max(len(data) // 28 + 2, 16)
+    co = np.zeros(max_blocks, dtype=np.int64)
+    uo = np.zeros(max_blocks, dtype=np.int64)
+    total = ctypes.c_long(0)
+    n = lib.bgzf_scan(
+        data, ctypes.c_long(len(data)),
+        co.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        uo.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        ctypes.c_long(max_blocks), ctypes.byref(total),
+    )
+    if n < 0:
+        raise ValueError(f"bgzf_scan error {n}")
+    return co[:n], uo[:n], int(total.value)
+
+
+def bgzf_inflate(data: bytes, total: int) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty(total, dtype=np.uint8)
+    r = lib.bgzf_inflate_all(
+        data, ctypes.c_long(len(data)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.c_long(total),
+    )
+    if r < 0:
+        raise ValueError(f"bgzf_inflate error {r}")
+    return out[:r]
+
+
+def bam_decode(body: np.ndarray, offset: int, target_tid: int,
+               start: int, end: int, cap_reads: int | None = None):
+    """Decode records into columnar arrays; returns a dict of arrays plus
+    consumed byte count, or None when native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    remaining = len(body) - offset
+    if cap_reads is None:
+        cap_reads = max(remaining // 40 + 16, 1024)
+    while True:
+        cap_segs = cap_reads * 4
+        a = {
+            "tid": np.empty(cap_reads, np.int32),
+            "pos": np.empty(cap_reads, np.int32),
+            "end": np.empty(cap_reads, np.int32),
+            "mapq": np.empty(cap_reads, np.uint8),
+            "flag": np.empty(cap_reads, np.uint16),
+            "tlen": np.empty(cap_reads, np.int32),
+            "read_len": np.empty(cap_reads, np.int32),
+            "mate_pos": np.empty(cap_reads, np.int32),
+            "single_m": np.empty(cap_reads, np.uint8),
+            "seg_start": np.empty(cap_segs, np.int32),
+            "seg_end": np.empty(cap_segs, np.int32),
+            "seg_read": np.empty(cap_segs, np.int32),
+        }
+        n_segs = ctypes.c_long(0)
+        consumed = ctypes.c_long(0)
+
+        def ptr(x, t):
+            return a[x].ctypes.data_as(ctypes.POINTER(t))
+
+        nr = lib.bam_decode(
+            body.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.c_long(len(body)), ctypes.c_long(offset),
+            ctypes.c_int(target_tid), ctypes.c_int(start),
+            ctypes.c_int(end), ctypes.c_long(cap_reads),
+            ctypes.c_long(cap_segs),
+            ptr("tid", ctypes.c_int32), ptr("pos", ctypes.c_int32),
+            ptr("end", ctypes.c_int32), ptr("mapq", ctypes.c_uint8),
+            ptr("flag", ctypes.c_uint16), ptr("tlen", ctypes.c_int32),
+            ptr("read_len", ctypes.c_int32),
+            ptr("mate_pos", ctypes.c_int32),
+            ptr("single_m", ctypes.c_uint8),
+            ptr("seg_start", ctypes.c_int32),
+            ptr("seg_end", ctypes.c_int32),
+            ptr("seg_read", ctypes.c_int32),
+            ctypes.byref(n_segs), ctypes.byref(consumed),
+        )
+        if nr == -2:
+            cap_reads *= 2
+            continue
+        if nr < 0:
+            raise ValueError(f"bam_decode error {nr}")
+        ns = int(n_segs.value)
+        out = {k: v[: (ns if k.startswith("seg_") else nr)]
+               for k, v in a.items()}
+        out["n_reads"] = int(nr)
+        out["consumed"] = int(consumed.value)
+        return out

@@ -1,0 +1,112 @@
+"""Native C++ io path: build, scan/inflate, decode parity vs pure Python."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import native
+from goleft_tpu.io.bam import BamReader, BamFile, open_bam, _PyBamAdapter
+from goleft_tpu.io.bgzf import bgzf_decompress
+from goleft_tpu.io.bai import build_bai, query_voffset
+
+from helpers import write_bam, write_bam_and_bai, random_reads
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_bgzf_scan_and_inflate(tmp_path):
+    rng = np.random.default_rng(0)
+    p = str(tmp_path / "t.bam")
+    write_bam(p, random_reads(rng, 300, 0, 50_000))
+    data = open(p, "rb").read()
+    co, uo, total = native.bgzf_scan(data)
+    body = native.bgzf_inflate(data, total)
+    want = bgzf_decompress(data)
+    assert bytes(body) == want
+    assert uo[0] == 0 and co[0] == 0
+    assert np.all(np.diff(co) > 0)
+
+
+@needs_native
+def test_native_decode_matches_python(tmp_path):
+    reads = [
+        (0, 100, "100M", 60, 0),
+        (0, 150, "50M10D50M", 30, 0),
+        (0, 200, "10S90M", 20, 0x400),
+        (0, 300, "20M5I30M2N40M", 50, 0),
+        (1, 5, "100M", 60, 0),
+    ]
+    p = str(tmp_path / "t.bam")
+    write_bam(p, reads)
+    data = open(p, "rb").read()
+    bf = BamFile(data)
+    assert bf.native
+    py = BamReader(data).read_columns()
+    nat = bf.read_columns()
+    for f in ("tid", "pos", "end", "mapq", "flag", "tlen", "read_len",
+              "mate_pos", "seg_start", "seg_end", "seg_read"):
+        np.testing.assert_array_equal(getattr(nat, f), getattr(py, f), f)
+    np.testing.assert_array_equal(nat.single_m, py.single_m)
+
+
+@needs_native
+def test_native_region_decode(tmp_path):
+    rng = np.random.default_rng(1)
+    reads = random_reads(rng, 2000, 0, 200_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(200_000,))
+    data = open(p, "rb").read()
+    bf = BamFile(data)
+    idx = build_bai(p)
+    start, end = 50_000, 60_000
+    voff = query_voffset(idx, 0, start)
+    nat = bf.read_columns(tid=0, start=start, end=end, voffset=voff)
+    rdr = BamReader(data)
+    rdr.seek_virtual(voff)
+    py = rdr.read_columns(tid=0, start=start, end=end)
+    np.testing.assert_array_equal(nat.pos, py.pos)
+    np.testing.assert_array_equal(nat.seg_start, py.seg_start)
+    assert nat.n_reads > 0
+
+
+def test_open_bam_fallback(tmp_path, monkeypatch):
+    rng = np.random.default_rng(2)
+    p = str(tmp_path / "t.bam")
+    write_bam(p, random_reads(rng, 50, 0, 10_000))
+    data = open(p, "rb").read()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    h = open_bam(data)
+    assert isinstance(h, _PyBamAdapter)
+    cols = h.read_columns()
+    assert cols.n_reads == 50
+
+
+@needs_native
+def test_depth_cli_with_native(tmp_path):
+    """depth CLI produces identical output with and without native io."""
+    import os
+    from goleft_tpu.commands.depth import run_depth
+    from helpers import write_fasta
+    from goleft_tpu.io.fai import write_fai
+
+    rng = np.random.default_rng(3)
+    reads = random_reads(rng, 800, 0, 60_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(60_000,))
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 60_000})
+    write_fai(fa)
+    d1, c1 = run_depth(p, str(tmp_path / "nat"), reference=fa, window=500)
+    os.environ["GOLEFT_TPU_NO_NATIVE"] = "1"
+    try:
+        native._lib, native._tried = None, False
+        d2, c2 = run_depth(p, str(tmp_path / "pyf"), reference=fa,
+                           window=500)
+    finally:
+        del os.environ["GOLEFT_TPU_NO_NATIVE"]
+        native._lib, native._tried = None, False
+    assert open(d1).read().replace("nat", "") == \
+        open(d2).read().replace("pyf", "")
+    assert open(c1).read() == open(c2).read()
