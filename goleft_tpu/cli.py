@@ -42,6 +42,8 @@ PROGS = {
     "cnveval": ("evaluate CNV calls against a truth set",
                 _lazy(".commands.cnveval_cmd")),
     "bench": ("run the TPU benchmark suite", _lazy(".commands.bench_cmd")),
+    "anonymize": ("make shareable header-only bam+bai fixtures",
+                  _lazy(".commands.anonymize")),
 }
 
 

@@ -26,7 +26,7 @@ Semantics preserved from the reference:
 from __future__ import annotations
 
 import argparse
-import concurrent.futures as cf
+
 import os
 import sys
 
@@ -173,6 +173,7 @@ def run_depth(
     bed: str | None = None,
     stats: bool = False,
     processes: int = 4,
+    cache_dir: str | None = None,
 ) -> tuple[str, str]:
     with open(bam, "rb") as fh:
         bam_bytes = fh.read()
@@ -210,20 +211,40 @@ def run_depth(
     call_path = f"{prefix}{suffix}.callable.bed"
     tid_of = {n: i for i, n in enumerate(hdr.ref_names)}
 
+    from ..parallel.scheduler import ResultCache, file_key, run_sharded
+
+    rc = ResultCache(cache_dir) if cache_dir else None
+    fkey = file_key(bam) if cache_dir else bam
+
+    def shard_fn(c, s, e, _fk):
+        cols = (
+            _decode_shard(handle, bai, tid_of[c], s, e)
+            if c in tid_of else ReadColumns.empty()
+        )
+        starts, ends, sums, cls = engine.run_shard(cols, s, e)
+        return starts, ends, sums, cls
+
+    params = (window, min_cov, max_mean_depth, mapq)
+    tasks = [(c, s, e, (fkey, params)) for (c, s, e) in regions]
+    n_failed = 0
     with open(depth_path, "w") as dout, open(call_path, "w") as cout:
-        with cf.ThreadPoolExecutor(max_workers=max(processes, 1)) as ex:
-            futs = [
-                ex.submit(_decode_shard, handle, bai,
-                          tid_of.get(c, -1), s, e)
-                if c in tid_of else None
-                for (c, s, e) in regions
-            ]
-            for (c, s, e), fut in zip(regions, futs):
-                cols = fut.result() if fut is not None \
-                    else ReadColumns.empty()
-                starts, ends, sums, cls = engine.run_shard(cols, s, e)
-                write_shard_output(c, starts, ends, sums, cls, s,
-                                   dout, cout, fa)
+        for (c, s, e), res in zip(
+            regions,
+            run_sharded(tasks, shard_fn, processes=processes,
+                        retries=1, cache=rc, ordered=True),
+        ):
+            if res.error is not None:
+                # reference behavior: failed shard reports, others keep
+                # going, nonzero exit at the end (depth/depth.go:395-399)
+                print(f"ERROR with shard {c}:{s}-{e}: {res.error}",
+                      file=sys.stderr)
+                n_failed += 1
+                continue
+            starts, ends, sums, cls = res.value
+            write_shard_output(c, starts, ends, sums, cls, s,
+                               dout, cout, fa)
+    if n_failed:
+        raise SystemExit(1)
     return depth_path, call_path
 
 
@@ -247,6 +268,8 @@ def main(argv=None):
     p.add_argument("-p", "--processes", type=int, default=4)
     p.add_argument("-b", "--bed", default=None,
                    help="restrict to regions in this bed")
+    p.add_argument("--cache", default=None,
+                   help="shard result-cache directory (resume support)")
     p.add_argument("--prefix", required=True)
     p.add_argument("bam")
     a = p.parse_args(argv)
@@ -254,6 +277,7 @@ def main(argv=None):
         a.bam, a.prefix, reference=a.reference, window=a.windowsize,
         min_cov=a.mincov, max_mean_depth=a.maxmeandepth, mapq=a.mapq,
         chrom=a.chrom, bed=a.bed, stats=a.stats, processes=a.processes,
+        cache_dir=a.cache,
     )
 
 

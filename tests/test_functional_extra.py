@@ -1,0 +1,104 @@
+"""Functional edge cases mirroring the reference shell suites:
+anonymize→indexcov, crai-input indexcov, depth shard cache resume,
+single-sample and no-sex cohorts."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from goleft_tpu.commands.anonymize import anonymize
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.commands.indexcov import run_indexcov
+from helpers import write_bam_and_bai, write_fasta, random_reads
+from goleft_tpu.io.fai import write_fai
+
+
+def test_anonymize_then_indexcov(tmp_path):
+    rng = np.random.default_rng(0)
+    orig = []
+    for i in range(3):
+        reads = random_reads(rng, 2000, 0, 500_000)
+        p = str(tmp_path / f"real{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(500_000,))
+        orig.append(p)
+    outs = anonymize("cohortx", orig, str(tmp_path))
+    assert [os.path.basename(o) for o in outs] == [
+        f"sample_cohortx_{i:04d}.bam" for i in (1, 2, 3)
+    ]
+    res = run_indexcov(outs, str(tmp_path / "out"), sex="",
+                       write_html=False, write_png=False)
+    with open(res["ped"]) as fh:
+        header = fh.readline()
+        rows = fh.read().splitlines()
+    assert len(rows) == 3
+    assert "sample_cohortx_0001" in rows[0]
+
+
+def test_indexcov_crai_input(tmp_path):
+    # synthetic .crai cohort driven through the full indexcov pipeline
+    n_tiles = 40
+    fasta = write_fasta(
+        str(tmp_path / "g.fa"), {"chr1": "A" * (n_tiles * 16384)}
+    )
+    write_fai(fasta)
+    rng = np.random.default_rng(1)
+    crais = []
+    for s in range(5):
+        lines = []
+        for t in range(n_tiles):
+            nbytes = int(800 * (1 + 0.2 * rng.standard_normal()))
+            lines.append(f"0\t{t * 16384}\t16384\t{t * 1000}\t0\t{nbytes}")
+        p = tmp_path / f"c{s}.crai"
+        p.write_bytes(gzip.compress(("\n".join(lines) + "\n").encode()))
+        crais.append(str(p))
+    res = run_indexcov(crais, str(tmp_path / "out"), sex="",
+                       fai=fasta + ".fai", extra_normalize=True,
+                       write_html=False, write_png=False)
+    with gzip.open(res["bed"], "rt") as fh:
+        header = fh.readline().rstrip("\n").split("\t")
+        rows = [l.split("\t") for l in fh.read().splitlines()]
+    assert header[3:] == [f"c{s}" for s in range(5)]
+    assert len(rows) == n_tiles
+    vals = np.array([[float(v) for v in r[3:]] for r in rows])
+    assert abs(np.median(vals) - 1.0) < 0.25
+
+
+def test_indexcov_single_sample_no_sex(tmp_path):
+    rng = np.random.default_rng(2)
+    reads = random_reads(rng, 3000, 0, 800_000)
+    p = str(tmp_path / "solo.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(800_000,))
+    res = run_indexcov([p], str(tmp_path / "out"), sex="",
+                       write_html=False, write_png=False)
+    assert os.path.exists(res["ped"])
+    assert res["sexes"] == {}
+    with open(res["ped"]) as fh:
+        hdr = fh.readline().rstrip("\n").split("\t")
+        row = fh.readline().rstrip("\n").split("\t")
+    # no CN columns, sex = -9
+    assert not any(c.startswith("CN") for c in hdr)
+    assert row[4] == "-9"
+
+
+def test_depth_cache_resume(tmp_path):
+    rng = np.random.default_rng(3)
+    reads = random_reads(rng, 500, 0, 50_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(50_000,))
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 50_000})
+    write_fai(fa)
+    cache = str(tmp_path / "cache")
+    d1, c1 = run_depth(p, str(tmp_path / "a"), reference=fa, window=500,
+                       cache_dir=cache)
+    assert len(os.listdir(cache)) > 0
+    d2, c2 = run_depth(p, str(tmp_path / "b"), reference=fa, window=500,
+                       cache_dir=cache)
+    assert open(d1).read() == open(d2).read()
+    assert open(c1).read() == open(c2).read()
+    # different params → different cache keys, not a stale hit
+    d3, _ = run_depth(p, str(tmp_path / "c"), reference=fa, window=500,
+                      mapq=50, cache_dir=cache)
+    assert open(d3).read() != open(d1).read()
