@@ -174,6 +174,7 @@ def run_depth(
     stats: bool = False,
     processes: int = 4,
     cache_dir: str | None = None,
+    profile_dir: str | None = None,
 ) -> tuple[str, str]:
     with open(bam, "rb") as fh:
         bam_bytes = fh.read()
@@ -212,22 +213,27 @@ def run_depth(
     tid_of = {n: i for i, n in enumerate(hdr.ref_names)}
 
     from ..parallel.scheduler import ResultCache, file_key, run_sharded
+    from ..utils.profiling import StageTimer, trace
 
     rc = ResultCache(cache_dir) if cache_dir else None
     fkey = file_key(bam) if cache_dir else bam
+    timer = StageTimer()
 
     def shard_fn(c, s, e, _fk):
-        cols = (
-            _decode_shard(handle, bai, tid_of[c], s, e)
-            if c in tid_of else ReadColumns.empty()
-        )
-        starts, ends, sums, cls = engine.run_shard(cols, s, e)
+        with timer.stage("host-decode"):
+            cols = (
+                _decode_shard(handle, bai, tid_of[c], s, e)
+                if c in tid_of else ReadColumns.empty()
+            )
+        with timer.stage("device-compute"):
+            starts, ends, sums, cls = engine.run_shard(cols, s, e)
         return starts, ends, sums, cls
 
     params = (window, min_cov, max_mean_depth, mapq)
     tasks = [(c, s, e, (fkey, params)) for (c, s, e) in regions]
     n_failed = 0
-    with open(depth_path, "w") as dout, open(call_path, "w") as cout:
+    with trace(profile_dir), open(depth_path, "w") as dout, \
+            open(call_path, "w") as cout:
         for (c, s, e), res in zip(
             regions,
             run_sharded(tasks, shard_fn, processes=processes,
@@ -241,8 +247,11 @@ def run_depth(
                 n_failed += 1
                 continue
             starts, ends, sums, cls = res.value
-            write_shard_output(c, starts, ends, sums, cls, s,
-                               dout, cout, fa)
+            with timer.stage("write-output"):
+                write_shard_output(c, starts, ends, sums, cls, s,
+                                   dout, cout, fa)
+    if profile_dir:
+        timer.log_report()
     if n_failed:
         raise SystemExit(1)
     return depth_path, call_path
@@ -270,6 +279,8 @@ def main(argv=None):
                    help="restrict to regions in this bed")
     p.add_argument("--cache", default=None,
                    help="shard result-cache directory (resume support)")
+    p.add_argument("--profile", default=None,
+                   help="write a JAX profiler trace to this directory")
     p.add_argument("--prefix", required=True)
     p.add_argument("bam")
     a = p.parse_args(argv)
@@ -277,7 +288,7 @@ def main(argv=None):
         a.bam, a.prefix, reference=a.reference, window=a.windowsize,
         min_cov=a.mincov, max_mean_depth=a.maxmeandepth, mapq=a.mapq,
         chrom=a.chrom, bed=a.bed, stats=a.stats, processes=a.processes,
-        cache_dir=a.cache,
+        cache_dir=a.cache, profile_dir=a.profile,
     )
 
 

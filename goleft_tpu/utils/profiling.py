@@ -1,0 +1,60 @@
+"""Profiling/observability: JAX profiler traces + per-stage wall clocks.
+
+The reference's only profiling hook is an unconditional CPU pprof dump in
+the cnveval CLI (cnveval/cmd/cnveval/cnveval.go:41-46, SURVEY.md §5); the
+TPU rebuild gets first-class hooks: a ``trace(dir)`` context manager
+around any pipeline (view with TensorBoard / xprof) and a ``StageTimer``
+whose report shows where host decode vs device compute time goes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+log = logging.getLogger("goleft-tpu.profile")
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None):
+    """jax.profiler trace context; no-op when trace_dir is falsy."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+    log.info("profiler trace written to %s", trace_dir)
+
+
+class StageTimer:
+    """Accumulating wall-clock timers keyed by stage name."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = []
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            lines.append(
+                f"{name:<24} {self.totals[name]:8.3f}s "
+                f"({self.counts[name]} calls)"
+            )
+        return "\n".join(lines)
+
+    def log_report(self) -> None:
+        for line in self.report().splitlines():
+            log.info("%s", line)
