@@ -18,7 +18,7 @@ import argparse
 import numpy as np
 
 from ..io.bai import read_bai
-from ..io.bam import BamReader, ReadColumns
+from ..io.bam import BamReader, ReadColumns, open_bam
 from ..utils.xopen import xopen
 
 N_MADS = 10
@@ -156,13 +156,20 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
     out.write(HEADER + "\n")
     results = []
     for path in bams:
-        rdr = BamReader.from_file(path)
-        names = ",".join(rdr.header.sample_names()) or "<no-read-groups>"
-        # decode enough records for the sampling emulation
-        cols = rdr.read_columns(max_records=skip + 4 * n)
+        with open(path, "rb") as fh:
+            data = fh.read()
+        handle = open_bam(data)
+        names = ",".join(handle.header.sample_names()) or \
+            "<no-read-groups>"
+        if getattr(handle, "native", False):
+            cols = handle.read_columns()
+        else:
+            # python fallback: decode only what the sampling loop needs
+            rdr = BamReader(data)
+            cols = rdr.read_columns(max_records=skip + 4 * n)
         st = bam_stats(cols, n, skip)
 
-        genome_bases = sum(rdr.header.ref_lens)
+        genome_bases = sum(handle.header.ref_lens)
         mapped = 0
         try:
             import os
