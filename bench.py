@@ -17,7 +17,13 @@ depth/depth.go:282-325), which cannot run here. The reference's true
 text pipeline is strictly slower than the numpy vector version, so the
 reported speedup is a lower bound.
 
-Usage: python bench.py [--quick]
+``--suite`` additionally times the cohort-scale workloads from
+BASELINE.md configs 3-5 (indexcov normalization over 500 synthetic
+index-size arrays, batched EM over a 2504-sample depth matrix) and
+writes them to BENCH_details.json (stdout still carries exactly one
+line).
+
+Usage: python bench.py [--quick] [--suite]
 """
 
 from __future__ import annotations
@@ -49,6 +55,63 @@ def numpy_pipeline(seg_s, seg_e, keep, length, window, cap=2500,
     wsums = depth.reshape(-1, window).sum(axis=1)
     cls = np.where(depth == 0, 0, np.where(depth < min_cov, 1, 2))
     return wsums, cls
+
+
+def bench_suite(quick: bool) -> dict:
+    """Cohort-scale secondary benchmarks (BASELINE.md configs 3-5)."""
+    import jax
+
+    from goleft_tpu.ops import indexcov_ops as ic
+    from goleft_tpu.models.emdepth import em_depth_batch, cn_batch
+
+    out = {}
+    rng = np.random.default_rng(0)
+
+    # indexcov: 500 samples x ~190k tiles (whole genome at 16KB)
+    n_samples = 100 if quick else 500
+    n_tiles = 30_000 if quick else 190_000
+    depths = rng.gamma(20, 0.05, size=(n_samples, n_tiles)).astype(
+        np.float32
+    )
+    valid = np.ones_like(depths, dtype=bool)
+    d = jax.device_put(depths)
+    v = jax.device_put(valid)
+    # compile all four stages before timing
+    jax.block_until_ready((
+        ic.counts_roc(ic.counts_at_depth(d, v)),
+        ic.bin_counters(d, v, np.int32(n_tiles)),
+        ic.get_cn(d, v),
+    ))
+    t0 = time.perf_counter()
+    counts = ic.counts_at_depth(d, v)
+    rocs = ic.counts_roc(counts)
+    cnt = ic.bin_counters(d, v, np.int32(n_tiles))
+    cn = ic.get_cn(d, v)
+    jax.block_until_ready((rocs, cnt, cn))
+    dt = time.perf_counter() - t0
+    out["indexcov_cohort"] = {
+        "samples": n_samples, "tiles": n_tiles,
+        "seconds": round(dt, 4),
+        "samples_per_sec": round(n_samples / dt, 1),
+        "note": "hist+ROC+counters+CN on device (excl. index parse)",
+    }
+
+    # emdepth: 2504-sample 1000G-scale matrix, batched EM over windows
+    n_s = 500 if quick else 2504
+    n_w = 200 if quick else 1000
+    mat = (rng.gamma(30, 1.0, size=(n_w, n_s))).astype(np.float32)
+    m = jax.device_put(mat)
+    jax.block_until_ready(cn_batch(em_depth_batch(m), m))  # compile
+    t0 = time.perf_counter()
+    lam = em_depth_batch(m)
+    cns = cn_batch(lam, m)
+    jax.block_until_ready(cns)
+    dt = time.perf_counter() - t0
+    out["emdepth_em"] = {
+        "windows": n_w, "samples": n_s, "seconds": round(dt, 4),
+        "window_calls_per_sec": round(n_w / dt, 1),
+    }
+    return out
 
 
 def main(argv=None):
@@ -105,6 +168,14 @@ def main(argv=None):
     numpy_pipeline(seg_s, seg_e, keep, length, window)
     np_dt = time.perf_counter() - t0
     np_gbps = length / np_dt / 1e9
+
+    details = {}
+    if "--suite" in argv:
+        details = bench_suite(quick)
+        with open("BENCH_details.json", "w") as fh:
+            json.dump(details, fh, indent=1)
+        for k, v in details.items():
+            print(f"{k}: {v}", file=sys.stderr)
 
     dev = jax.devices()[0]
     print(json.dumps({

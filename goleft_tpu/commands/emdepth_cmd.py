@@ -20,6 +20,8 @@ from ..utils.xopen import xopen
 
 def read_matrix(path: str):
     """depthwed matrix → (chroms, starts, ends, depths (B,S), samples)."""
+    from ..utils.dtypes import preferred_float
+
     chroms, starts, ends, rows = [], [], [], []
     with xopen(path) as fh:
         header = fh.readline().rstrip("\n").split("\t")
@@ -31,7 +33,7 @@ def read_matrix(path: str):
             ends.append(int(t[2]))
             rows.append([float(x) for x in t[3:]])
     return (np.array(chroms), np.array(starts), np.array(ends),
-            np.array(rows, dtype=np.float64), samples)
+            np.array(rows, dtype=preferred_float()), samples)
 
 
 def run_emdepth(matrix_path: str, out=None, normalize: bool = True):

@@ -1,0 +1,20 @@
+"""Dtype policy: float64 where the backend supports it, float32 on TPU.
+
+Tests run on CPU with x64 enabled so EM kernels verify exactly against
+float64 oracles; on TPU (no native f64) the same kernels run in float32
+— the reference's own EM math is float64 for λ but float32 depths, and
+the CN outputs are integer-stable well beyond f32 precision for real
+coverage data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def preferred_float():
+    import jax
+
+    if jax.config.jax_enable_x64 and jax.default_backend() == "cpu":
+        return np.float64
+    return np.float32
