@@ -44,6 +44,8 @@ PROGS = {
     "bench": ("run the TPU benchmark suite", _lazy(".commands.bench_cmd")),
     "anonymize": ("make shareable header-only bam+bai fixtures",
                   _lazy(".commands.anonymize")),
+    "cohortdepth": ("depth matrix for many bams in one device pass",
+                    _lazy(".commands.cohortdepth")),
 }
 
 

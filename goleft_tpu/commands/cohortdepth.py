@@ -1,0 +1,166 @@
+"""cohortdepth: windowed depth matrix for many BAMs in one device pass.
+
+The reference reaches a cohort matrix by running ``goleft depth`` once
+per sample and matricizing with ``depthwed`` (SURVEY.md §3.1, BASELINE
+config 3). This command fuses the whole path: per shard, all samples'
+read segments decode in parallel threads (native C++, GIL-free) and the
+depth pipeline runs vmapped over the sample axis on device, emitting the
+``#chrom start end sample...`` matrix directly — the per-sample bed files
+and the depthwed re-aggregation pass disappear.
+
+Output values are round-half-up integer window means, exactly what
+depthwed produces from %.4g bed rows (depthwed.go:94-106) for whole
+windows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+
+import jax
+import numpy as np
+
+from ..io.bai import read_bai, query_voffset
+from ..io.bam import ReadColumns, open_bam
+from ..io.fai import read_fai, write_fai
+from ..ops.coverage import bucket_size, window_bounds
+from ..ops.depth_pipeline import shard_depth_pipeline
+from .depth import STEP, DEPTH_CAP_EXTRA, gen_regions
+from .indexcov import get_short_name
+
+
+def _batched_pipeline(seg_s, seg_e, keep, w0, rs, re, cap, length, window):
+    fn = functools.partial(
+        shard_depth_pipeline, length=length, window=window,
+    )
+    return jax.vmap(
+        lambda a, b, c: fn(a, b, c, w0, rs, re, cap, np.int32(4),
+                           np.int32(0))[0]
+    )(seg_s, seg_e, keep)
+
+
+def run_cohortdepth(
+    bams: list[str],
+    reference: str | None = None,
+    fai: str | None = None,
+    window: int = 250,
+    mapq: int = 1,
+    chrom: str = "",
+    processes: int = 8,
+    out=None,
+):
+    import concurrent.futures as cf
+    import os
+
+    out = out or sys.stdout
+    handles = []
+    bais = []
+    names = []
+
+    def load(b):
+        with open(b, "rb") as fh:
+            h = open_bam(fh.read())
+        bai_p = b + ".bai" if os.path.exists(b + ".bai") else \
+            b[:-4] + ".bai"
+        return h, read_bai(bai_p), get_short_name(b)
+
+    with cf.ThreadPoolExecutor(max_workers=processes) as ex:
+        for h, bai, nm in ex.map(load, bams):
+            handles.append(h)
+            bais.append(bai)
+            names.append(nm)
+
+    fai_path = fai or (reference + ".fai" if reference else None)
+    if fai_path is None:
+        raise SystemExit("cohortdepth: need -r reference or --fai")
+    if not os.path.exists(fai_path) and reference:
+        write_fai(reference)
+    fai_records = read_fai(fai_path)
+    regions = gen_regions(fai_records, chrom, window, None)
+    if not regions:
+        raise SystemExit(
+            f"cohortdepth: no regions (chromosome {chrom!r} not in "
+            f"{fai_path}?)"
+        )
+    max_span = max(e - (s // window) * window for _, s, e in regions)
+    length = (max_span + window - 1) // window * window
+    cap = np.int32(DEPTH_CAP_EXTRA)
+    # tid is per-sample: reference dictionaries may order contigs
+    # differently (or miss some) across BAMs
+    tid_maps = [
+        {n: i for i, n in enumerate(h.header.ref_names)} for h in handles
+    ]
+
+    out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
+    S = len(handles)
+
+    def decode(args):
+        h, bai, tid, s, e = args
+        if tid < 0:
+            return ReadColumns.empty()
+        voff = query_voffset(bai, tid, s)
+        if voff is None:
+            return ReadColumns.empty()
+        return h.read_columns(tid=tid, start=s, end=e, voffset=voff)
+
+    with cf.ThreadPoolExecutor(max_workers=processes) as ex:
+        for c, s, e in regions:
+            cols = list(ex.map(
+                decode,
+                [(h, b, tm.get(c, -1), s, e)
+                 for h, b, tm in zip(handles, bais, tid_maps)],
+            ))
+            n_max = max((len(cl.seg_start) for cl in cols), default=0)
+            b = bucket_size(max(n_max, 1))
+            seg_s = np.zeros((S, b), dtype=np.int32)
+            seg_e = np.zeros((S, b), dtype=np.int32)
+            keep = np.zeros((S, b), dtype=bool)
+            for i, cl in enumerate(cols):
+                n = len(cl.seg_start)
+                if not n:
+                    continue
+                seg_s[i, :n] = cl.seg_start
+                seg_e[i, :n] = cl.seg_end
+                ok = (cl.mapq >= mapq) & ((cl.flag & 0x704) == 0)
+                keep[i, :n] = ok[cl.seg_read]
+            w0 = s // window * window
+            sums = np.asarray(_batched_pipeline(
+                seg_s, seg_e, keep, np.int32(w0), np.int32(s),
+                np.int32(e), cap, length, window,
+            ))
+            starts, ends, _, _ = window_bounds(s, e, window)
+            spans = (ends - starts).astype(np.float64)
+            means = sums[:, : len(starts)] / spans[None, :]
+            vals = (0.5 + means).astype(np.int64)
+            lines = [
+                f"{c}\t{starts[i]}\t{ends[i]}\t"
+                + "\t".join(str(v) for v in vals[:, i]) + "\n"
+                for i in range(len(starts))
+            ]
+            out.write("".join(lines))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu cohortdepth",
+        description="windowed depth matrix for a cohort in one "
+                    "device-batched pass",
+    )
+    p.add_argument("-w", "--windowsize", type=int, default=250)
+    p.add_argument("-Q", "--mapq", type=int, default=1)
+    p.add_argument("-c", "--chrom", default="")
+    p.add_argument("-r", "--reference", default=None)
+    p.add_argument("--fai", default=None)
+    p.add_argument("-p", "--processes", type=int, default=8)
+    p.add_argument("bams", nargs="+")
+    a = p.parse_args(argv)
+    run_cohortdepth(
+        a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
+        mapq=a.mapq, chrom=a.chrom, processes=a.processes,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -75,7 +75,9 @@ def get_short_name(path: str) -> str:
     else derived from the filename (indexcov.go:213-246)."""
     if not path.endswith((".crai", ".bai")):
         try:
-            names = BamReader.from_file(path).header.sample_names()
+            from ..io.bam import read_header_only
+
+            names = read_header_only(path).sample_names()
             if len(names) > 1:
                 raise ValueError(f"more than one RG SM for {path}")
             if names:
@@ -211,19 +213,18 @@ def run_indexcov(
         # print 0 (indexcov.go:678-680, depthsFor :1038-1048).
         # np.char.mod formats the whole block at C speed — the Python
         # f-string loop dominated large-cohort runs.
-        if longest > 0:
-            block = np.char.mod("%.3g", mat[:, :longest].T)
-            block[~valid[:, :longest].T] = "0"
-            starts_col = np.char.mod(
-                "%d", np.arange(longest, dtype=np.int64) * TILE
-            )
-            ends_col = np.char.mod(
-                "%d", (np.arange(longest, dtype=np.int64) + 1) * TILE
-            )
+        # chunked so a big cohort's formatted block stays bounded in RAM
+        for lo in range(0, longest, 2048):
+            hi = min(lo + 2048, longest)
+            block = np.char.mod("%.3g", mat[:, lo:hi].T)
+            block[~valid[:, lo:hi].T] = "0"
+            idx = np.arange(lo, hi, dtype=np.int64)
+            starts_col = np.char.mod("%d", idx * TILE)
+            ends_col = np.char.mod("%d", (idx + 1) * TILE)
             rows_txt = [
                 ref_name + "\t" + starts_col[i] + "\t" + ends_col[i]
                 + "\t" + "\t".join(block[i]) + "\n"
-                for i in range(longest)
+                for i in range(hi - lo)
             ]
             bed.write("".join(rows_txt).encode())
 

@@ -448,6 +448,24 @@ class _PyBamAdapter:
         return rdr.read_columns(tid=tid, start=start, end=end)
 
 
+def read_header_only(path: str, initial: int = 1 << 20) -> BamHeader:
+    """Parse just the BAM header, reading a growing file prefix — avoids
+    pulling multi-GB files into memory for an SM-tag lookup."""
+    import os
+
+    size = os.path.getsize(path)
+    n = min(initial, size)
+    while True:
+        with open(path, "rb") as fh:
+            data = fh.read(n)
+        try:
+            return BamReader(data).header
+        except Exception:
+            if n >= size:
+                raise
+            n = min(n * 4, size)
+
+
 def open_bam(data: bytes):
     """Decoded-BAM handle: native fast path when available, else the
     pure-Python streaming adapter (same read_columns signature)."""

@@ -1,0 +1,60 @@
+"""cohortdepth: one device pass must equal depth→depthwed per sample."""
+
+import io
+
+import numpy as np
+
+from goleft_tpu.commands.cohortdepth import run_cohortdepth
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.commands.depthwed import run_depthwed
+from goleft_tpu.io.fai import write_fai
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+def test_cohortdepth_matches_depth_plus_depthwed(tmp_path):
+    rng = np.random.default_rng(0)
+    ref_len = 43_210
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(3):
+        reads = random_reads(rng, 700, 0, ref_len)
+        p = str(tmp_path / f"s{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,))
+        bams.append(p)
+
+    out = io.StringIO()
+    run_cohortdepth(bams, reference=fa, window=500, out=out)
+    cohort_lines = out.getvalue().splitlines()
+
+    # classic path: depth per sample then depthwed at the same window
+    beds = []
+    for i, p in enumerate(bams):
+        d, _ = run_depth(p, str(tmp_path / f"w{i}"), reference=fa,
+                         window=500)
+        beds.append(d)
+    wed = io.StringIO()
+    run_depthwed(beds, size=500, out=wed)
+    wed_lines = wed.getvalue().splitlines()
+
+    # compare values row by row (names differ: SM tag vs filename)
+    assert len(cohort_lines) == len(wed_lines)
+    for cl, wl in zip(cohort_lines[1:], wed_lines[1:]):
+        ct = cl.split("\t")
+        wt = wl.split("\t")
+        assert ct[:3] == wt[:3]
+        assert ct[3:] == wt[3:], (cl, wl)
+
+
+def test_cohortdepth_header_names(tmp_path):
+    rng = np.random.default_rng(1)
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 10_000})
+    write_fai(fa)
+    p = str(tmp_path / "one.bam")
+    write_bam_and_bai(p, random_reads(rng, 100, 0, 10_000),
+                      ref_names=("chr1",), ref_lens=(10_000,))
+    out = io.StringIO()
+    run_cohortdepth([p], reference=fa, window=1000, out=out)
+    hdr = out.getvalue().splitlines()[0]
+    assert hdr == "#chrom\tstart\tend\tsampleA"
