@@ -55,12 +55,24 @@ long bgzf_scan(const uint8_t* data, long len, long* coffsets,
     return n;
 }
 
+long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
+                        long c_end, uint8_t* out, long out_cap);
+
 // Inflate the whole BGZF stream into out (caller sizes it via bgzf_scan).
 long bgzf_inflate_all(const uint8_t* data, long len, uint8_t* out,
                       long out_cap) {
-    long off = 0, total = 0;
+    return bgzf_inflate_range(data, len, 0, len, out, out_cap);
+}
+
+// Inflate only the blocks whose compressed offset lies in
+// [c_begin, c_end) — the region-decode fast path that keeps host
+// memory proportional to a shard, not the whole file.
+long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
+                        long c_end, uint8_t* out, long out_cap) {
+    long off = c_begin, total = 0;
+    if (c_end > len) c_end = len;
     z_stream zs;
-    while (off + 28 <= len) {
+    while (off < c_end && off + 28 <= len) {
         uint16_t xlen;
         memcpy(&xlen, data + off + 10, 2);
         long xoff = off + 12, xend = xoff + xlen;
@@ -116,13 +128,19 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
                 uint8_t* mapq, uint16_t* flag, int32_t* tlen,
                 int32_t* read_len, int32_t* mate_pos, uint8_t* single_m,
                 int32_t* seg_start, int32_t* seg_end, int32_t* seg_read,
-                long* n_segs_out, long* consumed_out) {
+                long* n_segs_out, long* consumed_out, int32_t* done_out) {
     long off = offset;
     long nr = 0, ns = 0;
+    // done=1: clean stop (past region / sorted-past-tid / exact EOF);
+    // done=0: buffer ended mid-record — caller must extend the window.
+    *done_out = 1;
     while (off + 4 <= body_len) {
         int32_t block_size;
         memcpy(&block_size, body + off, 4);
-        if (off + 4 + block_size > body_len) break;  // truncated tail
+        if (off + 4 + block_size > body_len) {
+            *done_out = 0;  // truncated tail
+            break;
+        }
         const uint8_t* p = body + off + 4;
         int32_t rtid, rpos;
         memcpy(&rtid, p, 4);
@@ -176,6 +194,7 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
         nr++;
         off += 4 + block_size;
     }
+    if (off < body_len && off + 4 > body_len) *done_out = 0;
     *n_segs_out = ns;
     *consumed_out = off - offset;
     return nr;

@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from ..io.bai import read_bai, query_voffset
-from ..io.bam import ReadColumns, open_bam
+from ..io.bam import ReadColumns, open_bam_file
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
 from ..ops.depth_pipeline import shard_depth_pipeline
@@ -60,8 +60,9 @@ def run_cohortdepth(
     names = []
 
     def load(b):
-        with open(b, "rb") as fh:
-            h = open_bam(fh.read())
+        # lazy mmap-backed handles: residency scales with the shard
+        # being decoded, not sum-of-BAM-sizes
+        h = open_bam_file(b, lazy=True)
         bai_p = b + ".bai" if os.path.exists(b + ".bai") else \
             b[:-4] + ".bai"
         return h, read_bai(bai_p), get_short_name(b)
@@ -103,7 +104,8 @@ def run_cohortdepth(
         voff = query_voffset(bai, tid, s)
         if voff is None:
             return ReadColumns.empty()
-        return h.read_columns(tid=tid, start=s, end=e, voffset=voff)
+        return h.read_columns(tid=tid, start=s, end=e, voffset=voff,
+                              end_voffset=query_voffset(bai, tid, e))
 
     with cf.ThreadPoolExecutor(max_workers=processes) as ex:
         for c, s, e in regions:

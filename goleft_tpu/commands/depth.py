@@ -33,7 +33,7 @@ import sys
 import numpy as np
 
 from ..io.bai import read_bai, query_voffset
-from ..io.bam import ReadColumns, open_bam
+from ..io.bam import ReadColumns, open_bam_file
 from ..io.fai import Faidx, read_fai
 from ..ops.coverage import (
     bucket_size, run_length_encode, window_bounds, CLASS_NAMES,
@@ -73,13 +73,18 @@ def _decode_shard(bam, bai, tid: int, start: int, end: int) -> ReadColumns:
     """Host decode of records overlapping [start, end) on tid.
 
     ``bam`` is an open_bam() handle: the native C++ decoder when
-    available (decompressed once, GIL-free per-shard decode), else the
-    pure-Python streaming reader.
+    available (lazy handles inflate only the shard's block range,
+    GIL-free), else the pure-Python streaming reader. The BAI linear
+    index bounds the block window on both sides.
     """
+    if tid < 0:
+        return ReadColumns.empty()
     voff = query_voffset(bai, tid, start)
     if voff is None:
         return ReadColumns.empty()
-    return bam.read_columns(tid=tid, start=start, end=end, voffset=voff)
+    end_voff = query_voffset(bai, tid, end)
+    return bam.read_columns(tid=tid, start=start, end=end, voffset=voff,
+                            end_voffset=end_voff)
 
 
 class DepthEngine:
@@ -176,9 +181,7 @@ def run_depth(
     cache_dir: str | None = None,
     profile_dir: str | None = None,
 ) -> tuple[str, str]:
-    with open(bam, "rb") as fh:
-        bam_bytes = fh.read()
-    handle = open_bam(bam_bytes)
+    handle = open_bam_file(bam, lazy=True)
     hdr = handle.header
     bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
                    else bam[:-4] + ".bai")

@@ -360,15 +360,23 @@ def _parse_header_buf(buf) -> tuple[BamHeader, int]:
 
 
 class BamFile:
-    """Whole-file decoded BAM with the native decode fast path.
+    """Native-decoded BAM with eager or lazy (region-streaming) modes.
 
-    The compressed stream is inflated ONCE (C++ when available, Python
-    zlib otherwise) and shard decodes run directly over the uncompressed
-    body — the native calls release the GIL so decode threads scale.
-    Virtual offsets from a BAI translate through the block table.
+    Eager: the compressed stream inflates ONCE and shard decodes run
+    over the resident uncompressed body — best for full-file scans
+    (covstats) of files that fit in RAM.
+
+    Lazy: only the BGZF block table is built up front; each
+    ``read_columns(voffset=...)`` inflates just the block range the
+    region needs (C++ ``bgzf_inflate_range``), so host memory scales
+    with the shard, not the file — the mode cohort tools use, over
+    mmap-backed compressed bytes. The decode window self-extends until
+    the decoder reports a clean stop.
+
+    All native calls release the GIL, so shard decode threads scale.
     """
 
-    def __init__(self, data: bytes):
+    def __init__(self, data, lazy: bool = False):
         from . import native
         from .bgzf import bgzf_decompress
 
@@ -377,50 +385,96 @@ class BamFile:
             scan = native.bgzf_scan(data)
         except Exception:
             scan = None
-        if scan is not None:
-            self._co, self._uo, total = scan
-            self.body = native.bgzf_inflate(data, total)
-            self.native = True
-        else:
-            raw = bgzf_decompress(data)
+        if scan is None:
+            raw = bgzf_decompress(
+                bytes(data) if not isinstance(data, bytes) else data
+            )
             self.body = np.frombuffer(raw, dtype=np.uint8)
             self._co = self._uo = None
+            self._comp = None
             self.native = False
-        self.header, self._body_start = _parse_header_buf(
-            bytes(self.body[: min(len(self.body), 1 << 22)])
-        )
+            self.lazy = False
+        else:
+            self._co, self._uo, self._total = scan
+            self.native = True
+            self.lazy = lazy
+            if lazy:
+                self._comp = native._as_u8(data)
+                self.body = None
+            else:
+                self._comp = None
+                self.body = native.bgzf_inflate(data, self._total)
+        self.header, self._body_start = self._parse_header()
+
+    def _parse_header(self):
+        from . import native
+
+        if self.body is not None:
+            return _parse_header_buf(
+                bytes(self.body[: min(len(self.body), 1 << 22)])
+            )
+        # lazy: inflate a growing block prefix until the header parses
+        nb = len(self._co)
+        k = min(8, nb)
+        while True:
+            c_end = int(self._co[k]) if k < nb else len(self._comp)
+            cap = int(self._uo[k]) if k < nb else self._total
+            buf = native.bgzf_inflate_range(self._comp, 0, c_end, cap)
+            try:
+                return _parse_header_buf(bytes(buf))
+            except Exception:
+                if k >= nb:
+                    raise
+                k = min(k * 4, nb)
 
     @classmethod
-    def from_file(cls, path: str) -> "BamFile":
+    def from_file(cls, path: str, lazy: bool = False) -> "BamFile":
+        if lazy:
+            import mmap
+
+            # POSIX mmap stays valid after the fd closes
+            with open(path, "rb") as fh:
+                mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+            return cls(mm, lazy=True)
         with open(path, "rb") as fh:
             return cls(fh.read())
+
+    def _block_of(self, voff: int) -> int:
+        blk = int(np.searchsorted(self._co, voff >> 16, side="right")) - 1
+        return max(blk, 0)
 
     def voffset_to_offset(self, voff: int) -> int:
         if self._co is None:
             raise ValueError("no block table (python fallback)")
-        import numpy as _np
-
-        blk = int(_np.searchsorted(self._co, voff >> 16, side="right")) - 1
-        blk = max(blk, 0)
+        blk = self._block_of(voff)
         return int(self._uo[blk]) + (voff & 0xFFFF)
+
+    def _decode(self, offset, tid, start, end):
+        from . import native
+
+        return native.bam_decode(
+            self.body, offset,
+            -1 if tid is None else tid, start,
+            -1 if end is None else end,
+        )
 
     def read_columns(self, tid: int | None = None, start: int = 0,
                      end: int | None = None,
-                     voffset: int | None = None) -> "ReadColumns":
+                     voffset: int | None = None,
+                     end_voffset: int | None = None) -> "ReadColumns":
         from . import native
 
         if not self.native:
             raise RuntimeError("BamFile requires the native library; "
                                "use open_bam() for automatic fallback")
-        if voffset is not None and self._co is not None:
-            offset = self.voffset_to_offset(voffset)
+        if self.lazy:
+            out = self._read_lazy(tid, start, end, voffset, end_voffset)
         else:
-            offset = self._body_start
-        out = native.bam_decode(
-            self.body, offset,
-            -1 if tid is None else tid, start,
-            -1 if end is None else end,
-        )
+            if voffset is not None:
+                offset = self.voffset_to_offset(voffset)
+            else:
+                offset = self._body_start
+            out = self._decode(offset, tid, start, end)
         return ReadColumns(
             out["tid"], out["pos"], out["end"], out["mapq"],
             out["flag"], out["tlen"], out["read_len"],
@@ -430,18 +484,52 @@ class BamFile:
             out["seg_start"], out["seg_end"], out["seg_read"],
         )
 
+    def _read_lazy(self, tid, start, end, voffset, end_voffset):
+        from . import native
+
+        nb = len(self._co)
+        if voffset is not None:
+            b0 = self._block_of(voffset)
+            in_block = voffset & 0xFFFF
+        else:
+            b0 = 0
+            in_block = self._body_start  # header is in block 0's stream
+        b1 = nb if end_voffset is None else min(
+            self._block_of(end_voffset) + 4, nb
+        )
+        while True:
+            c0 = int(self._co[b0])
+            c_end = int(self._co[b1]) if b1 < nb else len(self._comp)
+            cap = (int(self._uo[b1]) if b1 < nb else self._total) - int(
+                self._uo[b0]
+            )
+            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap)
+            out = native.bam_decode(
+                body, in_block,
+                -1 if tid is None else tid, start,
+                -1 if end is None else end,
+            )
+            # a stop strictly inside the window is a genuine region
+            # break; consuming the whole window is ambiguous (the window
+            # may end exactly on a record boundary) — extend to be sure
+            mid_stop = in_block + out["consumed"] < len(body)
+            if (out["done"] and mid_stop) or b1 >= nb:
+                return out
+            b1 = min(b1 + max(b1 - b0, 64), nb)
+
 
 class _PyBamAdapter:
     """BamFile-compatible shard decoder over the pure-Python reader."""
 
     native = False
+    lazy = False
 
-    def __init__(self, data: bytes):
-        self._data = data
-        self.header = BamReader(data).header
+    def __init__(self, data):
+        self._data = data if isinstance(data, bytes) else bytes(data)
+        self.header = BamReader(self._data).header
 
-    def read_columns(self, tid=None, start=0, end=None, voffset=None
-                     ) -> "ReadColumns":
+    def read_columns(self, tid=None, start=0, end=None, voffset=None,
+                     end_voffset=None) -> "ReadColumns":
         rdr = BamReader(self._data)
         if voffset is not None:
             rdr.seek_virtual(voffset)
@@ -466,17 +554,32 @@ def read_header_only(path: str, initial: int = 1 << 20) -> BamHeader:
             n = min(n * 4, size)
 
 
-def open_bam(data: bytes):
+def open_bam(data, lazy: bool = False):
     """Decoded-BAM handle: native fast path when available, else the
     pure-Python streaming adapter (same read_columns signature)."""
     from . import native
 
     if native.get_lib() is not None:
         try:
-            return BamFile(data)
+            return BamFile(data, lazy=lazy)
         except Exception:
             pass
     return _PyBamAdapter(data)
+
+
+def open_bam_file(path: str, lazy: bool = True):
+    """Open from disk; lazy native handles mmap the compressed file so
+    host residency stays proportional to the regions actually decoded,
+    not the file (or its ~4x inflated body)."""
+    from . import native
+
+    if lazy and native.get_lib() is not None:
+        try:
+            return BamFile.from_file(path, lazy=True)
+        except Exception:
+            pass
+    with open(path, "rb") as fh:
+        return open_bam(fh.read(), lazy=False)
 
 
 def reg2bin(beg: int, end: int) -> int:

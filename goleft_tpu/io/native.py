@@ -70,25 +70,38 @@ def get_lib() -> ctypes.CDLL | None:
             return None
         lib.bgzf_scan.restype = ctypes.c_long
         lib.bgzf_inflate_all.restype = ctypes.c_long
+        lib.bgzf_inflate_range.restype = ctypes.c_long
         lib.bam_decode.restype = ctypes.c_long
         _lib = lib
         return _lib
 
 
-def bgzf_scan(data: bytes):
+def _as_u8(data) -> np.ndarray:
+    """bytes / mmap / ndarray → zero-copy uint8 view."""
+    if isinstance(data, np.ndarray):
+        return data
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def _ptr(arr: np.ndarray, t=ctypes.c_ubyte):
+    return arr.ctypes.data_as(ctypes.POINTER(t))
+
+
+def bgzf_scan(data):
     """(coffsets, uoffsets, total_uncompressed) via the native scanner;
-    None when native is unavailable."""
+    None when native is unavailable. Accepts bytes or mmap-backed
+    arrays."""
     lib = get_lib()
     if lib is None:
         return None
-    max_blocks = max(len(data) // 28 + 2, 16)
+    buf = _as_u8(data)
+    max_blocks = max(len(buf) // 28 + 2, 16)
     co = np.zeros(max_blocks, dtype=np.int64)
     uo = np.zeros(max_blocks, dtype=np.int64)
     total = ctypes.c_long(0)
     n = lib.bgzf_scan(
-        data, ctypes.c_long(len(data)),
-        co.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
-        uo.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        _ptr(buf), ctypes.c_long(len(buf)),
+        _ptr(co, ctypes.c_long), _ptr(uo, ctypes.c_long),
         ctypes.c_long(max_blocks), ctypes.byref(total),
     )
     if n < 0:
@@ -96,18 +109,35 @@ def bgzf_scan(data: bytes):
     return co[:n], uo[:n], int(total.value)
 
 
-def bgzf_inflate(data: bytes, total: int) -> np.ndarray:
+def bgzf_inflate(data, total: int) -> np.ndarray:
     lib = get_lib()
     if lib is None:
         return None
+    buf = _as_u8(data)
     out = np.empty(total, dtype=np.uint8)
     r = lib.bgzf_inflate_all(
-        data, ctypes.c_long(len(data)),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        _ptr(buf), ctypes.c_long(len(buf)), _ptr(out),
         ctypes.c_long(total),
     )
     if r < 0:
         raise ValueError(f"bgzf_inflate error {r}")
+    return out[:r]
+
+
+def bgzf_inflate_range(data, c_begin: int, c_end: int,
+                       cap: int) -> np.ndarray:
+    """Inflate only blocks with compressed offset in [c_begin, c_end)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    out = np.empty(cap, dtype=np.uint8)
+    r = lib.bgzf_inflate_range(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(c_begin),
+        ctypes.c_long(c_end), _ptr(out), ctypes.c_long(cap),
+    )
+    if r < 0:
+        raise ValueError(f"bgzf_inflate_range error {r}")
     return out[:r]
 
 
@@ -139,13 +169,13 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
         }
         n_segs = ctypes.c_long(0)
         consumed = ctypes.c_long(0)
+        done = ctypes.c_int32(0)
 
         def ptr(x, t):
             return a[x].ctypes.data_as(ctypes.POINTER(t))
 
         nr = lib.bam_decode(
-            body.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
-            ctypes.c_long(len(body)), ctypes.c_long(offset),
+            _ptr(body), ctypes.c_long(len(body)), ctypes.c_long(offset),
             ctypes.c_int(target_tid), ctypes.c_int(start),
             ctypes.c_int(end), ctypes.c_long(cap_reads),
             ctypes.c_long(cap_segs),
@@ -159,6 +189,7 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
             ptr("seg_end", ctypes.c_int32),
             ptr("seg_read", ctypes.c_int32),
             ctypes.byref(n_segs), ctypes.byref(consumed),
+            ctypes.byref(done),
         )
         if nr == -2:
             cap_reads *= 2
@@ -170,4 +201,5 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
                for k, v in a.items()}
         out["n_reads"] = int(nr)
         out["consumed"] = int(consumed.value)
+        out["done"] = bool(done.value)
         return out

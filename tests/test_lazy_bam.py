@@ -1,0 +1,65 @@
+"""Lazy (region-streaming) BamFile mode vs eager decode parity."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import native
+from goleft_tpu.io.bam import BamFile, open_bam_file
+from goleft_tpu.io.bai import build_bai, query_voffset
+from helpers import write_bam_and_bai, random_reads
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_lazy_region_matches_eager(tmp_path):
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 3000, 0, 300_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(300_000,))
+    eager = BamFile.from_file(p)
+    lazy = BamFile.from_file(p, lazy=True)
+    assert lazy.lazy and lazy.body is None
+    assert lazy.header.ref_names == eager.header.ref_names
+    idx = build_bai(p)
+    for start, end in [(0, 50_000), (123_000, 180_000),
+                       (290_000, 300_000)]:
+        voff = query_voffset(idx, 0, start)
+        evoff = query_voffset(idx, 0, end)
+        a = eager.read_columns(tid=0, start=start, end=end, voffset=voff)
+        b = lazy.read_columns(tid=0, start=start, end=end, voffset=voff,
+                              end_voffset=evoff)
+        np.testing.assert_array_equal(a.pos, b.pos, f"{start}-{end}")
+        np.testing.assert_array_equal(a.seg_start, b.seg_start)
+        np.testing.assert_array_equal(a.flag, b.flag)
+
+
+@needs_native
+def test_lazy_window_extension(tmp_path):
+    """A deliberately-too-small end hint must self-extend, not truncate."""
+    rng = np.random.default_rng(1)
+    reads = random_reads(rng, 2000, 0, 100_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(100_000,))
+    idx = build_bai(p)
+    lazy = BamFile.from_file(p, lazy=True)
+    eager = BamFile.from_file(p)
+    voff = query_voffset(idx, 0, 10_000)
+    # end hint points at the START of the region: far too early
+    a = lazy.read_columns(tid=0, start=10_000, end=90_000, voffset=voff,
+                          end_voffset=voff)
+    b = eager.read_columns(tid=0, start=10_000, end=90_000, voffset=voff)
+    np.testing.assert_array_equal(a.pos, b.pos)
+
+
+@needs_native
+def test_lazy_full_scan(tmp_path):
+    rng = np.random.default_rng(2)
+    reads = random_reads(rng, 500, 0, 50_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(50_000,))
+    lazy = open_bam_file(p, lazy=True)
+    cols = lazy.read_columns()
+    assert cols.n_reads == 500
