@@ -1,0 +1,37 @@
+# Native io library + sanitizer/test targets.
+# The Python side builds build/libgoleftio.so lazily; these targets are
+# for CI-style hardening runs (SURVEY.md §5: host C++ under ASan).
+
+CXX ?= g++
+SRC = csrc/fastio.cpp
+
+.PHONY: native asan test test-native-asan clean
+
+native: build/libgoleftio.so
+
+build/libgoleftio.so: $(SRC)
+	mkdir -p build
+	$(CXX) -O3 -march=native -shared -fPIC $(SRC) -lz -o $@
+
+build/libgoleftio_asan.so: $(SRC)
+	mkdir -p build
+	$(CXX) -O1 -g -fsanitize=address -shared -fPIC $(SRC) -lz -o $@
+
+asan: build/libgoleftio_asan.so
+
+test:
+	python -m pytest tests/ -q
+
+# run the io test files with the AddressSanitized library preloaded.
+# Tests that execute XLA are excluded: ASan's allocator interposition is
+# incompatible with the JAX runtime, so only the pure-io paths (which is
+# all the C++ there is) run sanitized.
+test-native-asan: build/libgoleftio_asan.so
+	GOLEFT_TPU_ASAN_LIB=$(PWD)/build/libgoleftio_asan.so \
+	LD_PRELOAD=$(shell $(CXX) -print-file-name=libasan.so) \
+	ASAN_OPTIONS=detect_leaks=0 \
+	python -m pytest tests/test_native.py tests/test_lazy_bam.py -q \
+	    -k "not cli"
+
+clean:
+	rm -rf build

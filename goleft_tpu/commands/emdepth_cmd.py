@@ -36,7 +36,8 @@ def read_matrix(path: str):
             np.array(rows, dtype=preferred_float()), samples)
 
 
-def run_emdepth(matrix_path: str, out=None, normalize: bool = True):
+def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
+                matrix_out: str | None = None):
     out = out or sys.stdout
     chroms, starts, ends, depths, samples = read_matrix(matrix_path)
     if len(depths) == 0:
@@ -50,6 +51,14 @@ def run_emdepth(matrix_path: str, out=None, normalize: bool = True):
 
     lambdas = np.asarray(em.em_depth_batch(depths))
     cns = np.asarray(em.cn_batch(lambdas, depths))
+    if matrix_out:
+        with open(matrix_out, "w") as mf:
+            mf.write("#chrom\tstart\tend\t" + "\t".join(samples) + "\n")
+            for b in range(len(cns)):
+                mf.write(
+                    f"{chroms[b]}\t{starts[b]}\t{ends[b]}\t"
+                    + "\t".join(str(int(c)) for c in cns[b]) + "\n"
+                )
     out.write("#chrom\tstart\tend\tsample\tCN\tlog2FC\n")
     cache = em.Cache()
     results = []
@@ -84,9 +93,12 @@ def main(argv=None):
     )
     p.add_argument("--no-normalize", action="store_true",
                    help="input is already normalized")
+    p.add_argument("--matrix-out", default=None,
+                   help="also write the per-window CN matrix here")
     p.add_argument("matrix", help="depthwed-style matrix (tsv/gz)")
     a = p.parse_args(argv)
-    run_emdepth(a.matrix, normalize=not a.no_normalize)
+    run_emdepth(a.matrix, normalize=not a.no_normalize,
+                matrix_out=a.matrix_out)
 
 
 if __name__ == "__main__":

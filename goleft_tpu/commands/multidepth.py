@@ -24,7 +24,7 @@ import jax
 import numpy as np
 
 from ..io.bai import read_bai
-from ..io.bam import open_bam
+from ..io.bam import open_bam_file
 from ..ops.coverage import bucket_size, depth_from_segments
 from .depth import _decode_shard
 from .indexcov import get_short_name
@@ -82,8 +82,7 @@ def run_multidepth(
     import os
 
     for b in bams:
-        with open(b, "rb") as fh:
-            blobs.append(open_bam(fh.read()))
+        blobs.append(open_bam_file(b, lazy=True))
         hdr = blobs[-1].header
         bai_p = b + ".bai" if os.path.exists(b + ".bai") else b[:-4] + ".bai"
         bais.append(read_bai(bai_p))

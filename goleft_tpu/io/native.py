@@ -56,7 +56,9 @@ def get_lib() -> ctypes.CDLL | None:
         if os.environ.get("GOLEFT_TPU_NO_NATIVE"):
             return None
         src = os.path.join(_root(), "csrc", "fastio.cpp")
-        out = os.path.join(_root(), "build", "libgoleftio.so")
+        out = os.environ.get("GOLEFT_TPU_ASAN_LIB") or os.path.join(
+            _root(), "build", "libgoleftio.so"
+        )
         if not os.path.exists(out) or (
             os.path.exists(src)
             and os.path.getmtime(src) > os.path.getmtime(out)
