@@ -97,6 +97,19 @@ def run_cohortdepth(
     out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
     S = len(handles)
 
+    # multi-chip: shard the sample axis across all devices (data
+    # parallelism — XLA partitions the vmapped pipeline, no collectives
+    # needed); single chip runs the same code unsharded
+    n_dev = len(jax.devices())
+    sharding = None
+    S_pad = S
+    if n_dev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        sharding = NamedSharding(mesh, P("data", None))
+        S_pad = ((S + n_dev - 1) // n_dev) * n_dev
+
     def decode(args):
         h, bai, tid, s, e = args
         if tid < 0:
@@ -116,9 +129,9 @@ def run_cohortdepth(
             ))
             n_max = max((len(cl.seg_start) for cl in cols), default=0)
             b = bucket_size(max(n_max, 1))
-            seg_s = np.zeros((S, b), dtype=np.int32)
-            seg_e = np.zeros((S, b), dtype=np.int32)
-            keep = np.zeros((S, b), dtype=bool)
+            seg_s = np.zeros((S_pad, b), dtype=np.int32)
+            seg_e = np.zeros((S_pad, b), dtype=np.int32)
+            keep = np.zeros((S_pad, b), dtype=bool)
             for i, cl in enumerate(cols):
                 n = len(cl.seg_start)
                 if not n:
@@ -128,10 +141,13 @@ def run_cohortdepth(
                 ok = (cl.mapq >= mapq) & ((cl.flag & 0x704) == 0)
                 keep[i, :n] = ok[cl.seg_read]
             w0 = s // window * window
+            args = (seg_s, seg_e, keep)
+            if sharding is not None:
+                args = tuple(jax.device_put(a, sharding) for a in args)
             sums = np.asarray(_batched_pipeline(
-                seg_s, seg_e, keep, np.int32(w0), np.int32(s),
+                *args, np.int32(w0), np.int32(s),
                 np.int32(e), cap, length, window,
-            ))
+            ))[:S]
             starts, ends, _, _ = window_bounds(s, e, window)
             spans = (ends - starts).astype(np.float64)
             means = sums[:, : len(starts)] / spans[None, :]

@@ -442,14 +442,14 @@ def _write_index_html(directory, base, name, sexes, counters, samples, pcs,
             "pca12",
             [{"label": "samples", "x": pcs[:, 0].tolist(),
               "y": pcs[:, 1].tolist(), "names": samples}],
-            f"PC1 ({100 * var_frac[0]:.1f}%% variance)",
-            f"PC2 ({100 * var_frac[1]:.1f}%% variance)"))
+            f"PC1 ({100 * var_frac[0]:.1f}% variance)",
+            f"PC2 ({100 * var_frac[1]:.1f}% variance)"))
         if pcs.shape[1] > 2:
             charts.append(report.scatter_chart(
                 "pca13",
                 [{"label": "samples", "x": pcs[:, 0].tolist(),
                   "y": pcs[:, 2].tolist(), "names": samples}],
-                "PC1", f"PC3 ({100 * var_frac[2]:.1f}%% variance)"))
+                "PC1", f"PC3 ({100 * var_frac[2]:.1f}% variance)"))
     if any(mapped) or any(unmapped):
         charts.append(report.scatter_chart(
             "mapped",

@@ -1,0 +1,87 @@
+"""Edge cases: long-read CRAI overhang, chrom-restricted runs, index.html
+labels."""
+
+import gzip
+import os
+
+import numpy as np
+
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.commands.indexcov import run_indexcov
+from goleft_tpu.io.crai import CraiIndex, CraiSlice
+from goleft_tpu.io.fai import write_fai
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+def test_crai_long_read_overhang():
+    """Slices whose reads spill > one tile into the next slice exercise
+    the overhang-trim loop (crai.go:91-99)."""
+    t = 16384
+    slices = [
+        # slice 0 covers 3 tiles but its span overshoots by 2.5 tiles
+        CraiSlice(0, int(5.5 * t), 0, 0, 3000),
+        # next slice starts 2.5 tiles before the cursor (long reads)
+        CraiSlice(3 * t, 3 * t, 0, 0, 1500),
+    ]
+    sizes = CraiIndex([slices]).sizes()[0]
+    assert len(sizes) > 0
+    assert np.all(sizes >= 0)
+    # total estimated data is conserved-ish: all per-base values positive
+    assert sizes.sum() > 0
+
+
+def test_crai_negative_final_span():
+    sl = [CraiSlice(0, 16384, 0, 0, 500), CraiSlice(16384, -5, 0, 0, 100)]
+    sizes = CraiIndex([sl]).sizes()[0]
+    # final slice's span zeroed → contributes nothing
+    assert list(sizes) == [int(100000 * 500 / 16384)]
+
+
+def test_depth_chrom_flag(tmp_path):
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 300, 0, 30_000) + random_reads(
+        rng, 300, 1, 20_000
+    )
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1", "chr2"),
+                      ref_lens=(30_000, 20_000))
+    fa = write_fasta(str(tmp_path / "r.fa"),
+                     {"chr1": "A" * 30_000, "chr2": "A" * 20_000})
+    write_fai(fa)
+    dpath, cpath = run_depth(p, str(tmp_path / "o"), reference=fa,
+                             window=1000, chrom="chr2")
+    assert dpath.endswith(".chr2.depth.bed")
+    with open(dpath) as fh:
+        chroms = {line.split("\t")[0] for line in fh}
+    assert chroms == {"chr2"}
+
+
+def test_indexcov_chrom_flag(tmp_path):
+    rng = np.random.default_rng(1)
+    reads = random_reads(rng, 2000, 0, 400_000) + random_reads(
+        rng, 1000, 1, 200_000
+    )
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1", "chr2"),
+                      ref_lens=(400_000, 200_000))
+    res = run_indexcov([p, p], str(tmp_path / "out"), sex="",
+                       chrom="chr2", write_html=False, write_png=False)
+    with gzip.open(res["bed"], "rt") as fh:
+        fh.readline()
+        chroms = {line.split("\t")[0] for line in fh}
+    assert chroms == {"chr2"}
+
+
+def test_index_html_pct_labels(tmp_path):
+    rng = np.random.default_rng(2)
+    paths = []
+    for i in range(4):
+        reads = random_reads(rng, 2000, 0, 600_000)
+        p = str(tmp_path / f"s{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(600_000,))
+        paths.append(p)
+    run_indexcov(paths, str(tmp_path / "out"), sex="", write_png=False)
+    html = open(os.path.join(tmp_path, "out", "index.html")).read()
+    assert "%% variance" not in html
+    assert "% variance" in html
