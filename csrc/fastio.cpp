@@ -28,6 +28,7 @@ long bgzf_scan(const uint8_t* data, long len, long* coffsets,
         uint16_t xlen;
         memcpy(&xlen, data + off + 10, 2);
         long xoff = off + 12, xend = xoff + xlen;
+        if (xend > len) return -6;  // header truncated
         long bsize = -1;
         while (xoff + 4 <= xend) {
             uint8_t si1 = data[xoff], si2 = data[xoff + 1];
@@ -42,6 +43,7 @@ long bgzf_scan(const uint8_t* data, long len, long* coffsets,
             xoff += 4 + slen;
         }
         if (bsize < 0) return -2;
+        if (off + bsize > len) return -6;  // truncated final block
         uint32_t isize;
         memcpy(&isize, data + off + bsize - 4, 4);
         if (n >= max_blocks) return -3;
@@ -76,6 +78,7 @@ long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
         uint16_t xlen;
         memcpy(&xlen, data + off + 10, 2);
         long xoff = off + 12, xend = xoff + xlen;
+        if (xend > len) return -6;  // header truncated
         long bsize = -1;
         while (xoff + 4 <= xend) {
             uint8_t si1 = data[xoff], si2 = data[xoff + 1];
@@ -90,8 +93,10 @@ long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
             xoff += 4 + slen;
         }
         if (bsize < 0) return -2;
+        if (off + bsize > len) return -6;  // truncated final block
         long cdata_off = off + 12 + xlen;
         long cdata_len = bsize - 12 - xlen - 8;
+        if (cdata_len < 0) return -8;  // corrupt header geometry
         uint32_t isize;
         memcpy(&isize, data + off + bsize - 4, 4);
         if (total + (long)isize > out_cap) return -3;
@@ -105,6 +110,10 @@ long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
             int r = inflate(&zs, Z_FINISH);
             inflateEnd(&zs);
             if (r != Z_STREAM_END) return -5;
+            uint32_t want_crc;
+            memcpy(&want_crc, data + off + bsize - 8, 4);
+            uint32_t got = crc32(0L, out + total, isize);
+            if (got != want_crc) return -7;  // corrupt payload
         }
         total += isize;
         off += bsize;

@@ -120,13 +120,20 @@ def run_cohortdepth(
         return h.read_columns(tid=tid, start=s, end=e, voffset=voff,
                               end_voffset=query_voffset(bai, tid, e))
 
+    def submit_decodes(ex, c, s, e):
+        return [
+            ex.submit(decode, (h, b, tm.get(c, -1), s, e))
+            for h, b, tm in zip(handles, bais, tid_maps)
+        ]
+
     with cf.ThreadPoolExecutor(max_workers=processes) as ex:
-        for c, s, e in regions:
-            cols = list(ex.map(
-                decode,
-                [(h, b, tm.get(c, -1), s, e)
-                 for h, b, tm in zip(handles, bais, tid_maps)],
-            ))
+        # double-buffer: while the device chews shard k, threads decode
+        # shard k+1 (native decode releases the GIL)
+        pending = submit_decodes(ex, *regions[0])
+        for ri, (c, s, e) in enumerate(regions):
+            cols = [f.result() for f in pending]
+            if ri + 1 < len(regions):
+                pending = submit_decodes(ex, *regions[ri + 1])
             n_max = max((len(cl.seg_start) for cl in cols), default=0)
             b = bucket_size(max(n_max, 1))
             seg_s = np.zeros((S_pad, b), dtype=np.int32)

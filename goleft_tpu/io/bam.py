@@ -210,6 +210,11 @@ class BamReader:
     """Sequential + random-access BAM reader over an in-memory file."""
 
     def __init__(self, data: bytes):
+        if data[:4] == b"CRAM":
+            raise ValueError(
+                "CRAM decoding is not supported — pass the .crai to "
+                "indexcov/indexsplit, or convert to BAM for depth tools"
+            )
         self._r = BgzfReader(data)
         magic = self._r.read(4)
         if magic != BAM_MAGIC:
@@ -380,6 +385,11 @@ class BamFile:
         from . import native
         from .bgzf import bgzf_decompress
 
+        if bytes(data[:4]) == b"CRAM":
+            raise ValueError(
+                "CRAM decoding is not supported — pass the .crai to "
+                "indexcov/indexsplit, or convert to BAM for depth tools"
+            )
         scan = None
         try:
             scan = native.bgzf_scan(data)
@@ -573,6 +583,14 @@ def open_bam_file(path: str, lazy: bool = True):
     not the file (or its ~4x inflated body)."""
     from . import native
 
+    with open(path, "rb") as fh:
+        magic = fh.read(4)
+    if magic == b"CRAM":
+        raise SystemExit(
+            f"{path}: CRAM decoding is not supported — for index-based "
+            "coverage QC pass the .crai to indexcov/indexsplit, or "
+            "convert to BAM for the depth tools"
+        )
     if lazy and native.get_lib() is not None:
         try:
             return BamFile.from_file(path, lazy=True)

@@ -68,9 +68,11 @@ def bgzf_decompress(data: bytes) -> bytes:
         raw = zlib.decompress(
             data[cdata_off : cdata_off + cdata_len], wbits=-15
         )
-        (isize,) = struct.unpack_from("<I", data, off + bsize - 4)
+        crc, isize = struct.unpack_from("<II", data, off + bsize - 8)
         if len(raw) != isize:
             raise ValueError("bgzf: ISIZE mismatch")
+        if zlib.crc32(raw) & 0xFFFFFFFF != crc:
+            raise ValueError("bgzf: CRC mismatch (corrupt block)")
         out.append(raw)
         off += bsize
     return b"".join(out)
@@ -109,6 +111,9 @@ class BgzfReader:
         self._block = zlib.decompress(
             self._data[cdata_off : cdata_off + cdata_len], wbits=-15
         )
+        (crc,) = struct.unpack_from("<I", self._data, coffset + bsize - 8)
+        if zlib.crc32(self._block) & 0xFFFFFFFF != crc:
+            raise ValueError("bgzf: CRC mismatch (corrupt block)")
         self._coffset = coffset
         self._next_coffset = coffset + bsize
         self._uoffset = 0

@@ -107,7 +107,7 @@ def bgzf_scan(data):
         ctypes.c_long(max_blocks), ctypes.byref(total),
     )
     if n < 0:
-        raise ValueError(f"bgzf_scan error {n}")
+        raise ValueError(f"bgzf scan: {_err(n)}")
     return co[:n], uo[:n], int(total.value)
 
 
@@ -122,7 +122,7 @@ def bgzf_inflate(data, total: int) -> np.ndarray:
         ctypes.c_long(total),
     )
     if r < 0:
-        raise ValueError(f"bgzf_inflate error {r}")
+        raise ValueError(f"bgzf inflate: {_err(r)}")
     return out[:r]
 
 
@@ -139,8 +139,26 @@ def bgzf_inflate_range(data, c_begin: int, c_end: int,
         ctypes.c_long(c_end), _ptr(out), ctypes.c_long(cap),
     )
     if r < 0:
-        raise ValueError(f"bgzf_inflate_range error {r}")
+        raise ValueError(
+            f"bgzf: {_err(r)} (blocks at {c_begin}..{c_end})"
+        )
     return out[:r]
+
+
+_ERRS = {
+    -1: "bad gzip magic",
+    -2: "missing BC subfield (not BGZF)",
+    -3: "output capacity exceeded",
+    -4: "zlib init failed",
+    -5: "corrupt deflate stream",
+    -6: "truncated block",
+    -7: "CRC mismatch (corrupt block)",
+    -8: "corrupt block header geometry",
+}
+
+
+def _err(code) -> str:
+    return _ERRS.get(int(code), f"error {code}")
 
 
 def bam_decode(body: np.ndarray, offset: int, target_tid: int,

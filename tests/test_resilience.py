@@ -1,0 +1,67 @@
+"""Failure handling: corrupt BAMs, CRAM inputs, shard error propagation."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.io.bam import open_bam_file
+from goleft_tpu.io.fai import write_fai
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+def test_cram_input_clear_error(tmp_path):
+    p = tmp_path / "x.cram"
+    p.write_bytes(b"CRAM\x03\x00" + b"\x00" * 64)
+    with pytest.raises(SystemExit, match="CRAM"):
+        open_bam_file(str(p))
+
+
+def test_depth_truncated_bam_fails_cleanly(tmp_path, capsys):
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 2000, 0, 100_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(100_000,))
+    # chop the final quarter of the compressed stream mid-block; keep
+    # the stale (now-lying) index
+    data = open(p, "rb").read()
+    with open(p, "wb") as fh:
+        fh.write(data[: len(data) * 3 // 4 + 7])
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 100_000})
+    write_fai(fa)
+    with pytest.raises(SystemExit):
+        run_depth(p, str(tmp_path / "o"), reference=fa, window=10_000)
+    err = capsys.readouterr().err
+    assert "ERROR with shard" in err
+
+
+def test_depth_corrupt_middle_other_shards_survive(tmp_path, capsys):
+    """A shard hitting corrupt data reports + exits nonzero, but healthy
+    shards still produce output (reference max-exit-code behavior)."""
+    rng = np.random.default_rng(1)
+    reads = random_reads(rng, 3000, 0, 200_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(200_000,))
+    data = bytearray(open(p, "rb").read())
+    # trash bytes in the middle of the compressed stream (past header)
+    mid = len(data) // 2
+    data[mid : mid + 64] = b"\xde\xad" * 32
+    with open(p, "wb") as fh:
+        fh.write(bytes(data))
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 200_000})
+    write_fai(fa)
+    # shard the run finely so some shards avoid the corrupt region
+    from goleft_tpu.commands import depth as depth_mod
+
+    old_step = depth_mod.STEP
+    depth_mod.STEP = 50_000
+    try:
+        with pytest.raises(SystemExit):
+            run_depth(p, str(tmp_path / "o"), reference=fa,
+                      window=10_000)
+    finally:
+        depth_mod.STEP = old_step
+    err = capsys.readouterr().err
+    assert "ERROR with shard" in err
+    # healthy shards wrote rows
+    rows = open(str(tmp_path / "o.depth.bed")).read().splitlines()
+    assert len(rows) > 0
