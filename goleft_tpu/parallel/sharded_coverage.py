@@ -26,7 +26,8 @@ from jax import shard_map
 
 
 def sharded_depth_fn(mesh: Mesh, shard_len: int, window: int,
-                     seq_axis: str = "seq", data_axis: str = "data"):
+                     seq_axis: str = "seq", data_axis: str = "data",
+                     carry_mode: str = "all_gather"):
     """Build a jitted (samples × genome) coverage function over ``mesh``.
 
     Returns fn(seg_start, seg_end, keep) with shapes
@@ -34,10 +35,20 @@ def sharded_depth_fn(mesh: Mesh, shard_len: int, window: int,
       keep: same shape bool
     computing (S, n_seq * shard_len) per-base depth and
     (S, n_win_total) window sums. S must be divisible by the data axis.
+
+    carry_mode picks the inter-shard exclusive-prefix collective:
+      - "all_gather": one gather of the n_seq shard totals, mask+sum
+        locally — one hop, right for small seq axes (≤ a pod slice)
+      - "scan": Hillis-Steele log2(n_seq) ppermute doubling steps —
+        traffic per device stays O(S) regardless of n_seq, the
+        large-mesh choice (each step only talks to one ICI neighbor
+        at distance 2^k)
     """
     n_seq = mesh.shape[seq_axis]
     if shard_len % window:
         raise ValueError("shard_len must be a multiple of window")
+    if carry_mode not in ("all_gather", "scan"):
+        raise ValueError(f"unknown carry_mode {carry_mode!r}")
 
     def local(seg_s, seg_e, keep, shard_id):
         # seg arrays: (S_local, n_per_shard) — endpoints for THIS shard
@@ -55,16 +66,29 @@ def sharded_depth_fn(mesh: Mesh, shard_len: int, window: int,
         deltas = jax.vmap(one)(s, e)  # (S_local, shard_len)
         local_cs = jnp.cumsum(deltas, axis=1)
         totals = local_cs[:, -1]  # (S_local,)
-        # exclusive prefix over seq shards: one tiny all_gather on ICI
-        all_totals = jax.lax.all_gather(
-            totals, seq_axis, axis=0
-        )  # (n_seq, S_local)
-        carry = jnp.sum(
-            jnp.where(
-                (jnp.arange(n_seq) < shard_id)[:, None], all_totals, 0
-            ),
-            axis=0,
-        )
+        if carry_mode == "all_gather":
+            # exclusive prefix over seq shards: one gather on ICI
+            all_totals = jax.lax.all_gather(
+                totals, seq_axis, axis=0
+            )  # (n_seq, S_local)
+            carry = jnp.sum(
+                jnp.where(
+                    (jnp.arange(n_seq) < shard_id)[:, None],
+                    all_totals, 0
+                ),
+                axis=0,
+            )
+        else:
+            # Hillis-Steele inclusive scan via ppermute doubling, then
+            # subtract own totals for the exclusive prefix
+            acc = totals
+            k = 1
+            while k < n_seq:
+                perm = [(src, src + k) for src in range(n_seq - k)]
+                shifted = jax.lax.ppermute(acc, seq_axis, perm)
+                acc = acc + jnp.where(shard_id >= k, shifted, 0)
+                k *= 2
+            carry = acc - totals
         depth = local_cs + carry[:, None]
         wsums = depth.astype(jnp.float32).reshape(
             depth.shape[0], -1, window

@@ -1,0 +1,110 @@
+"""Integration oracle: recompute indexcov's bed.gz and ped values from the
+raw .bai tile sizes with an independent sequential numpy implementation
+of the reference semantics, and compare against run_indexcov's outputs."""
+
+import gzip
+
+import numpy as np
+
+from goleft_tpu.commands.indexcov import run_indexcov
+from goleft_tpu.io.bai import read_bai
+from helpers import write_bam_and_bai, random_reads
+
+REFS = ("chr1", "X")
+LENS = (800_000, 300_000)
+
+
+def _header(s):
+    sq = "".join(f"@SQ\tSN:{n}\tLN:{l}\n" for n, l in zip(REFS, LENS))
+    return f"@HD\tVN:1.6\tSO:coordinate\n{sq}@RG\tID:r\tSM:{s}\n"
+
+
+def oracle_median(all_sizes):
+    flat = np.sort(np.concatenate(all_sizes).astype(np.int64))
+    n98 = flat[int(0.98 * len(flat))]
+    cum = np.cumsum(np.minimum(flat, n98))
+    idx = int(np.searchsorted(cum, int(cum[-1]) // 2, side="right"))
+    return float(flat[min(idx, len(flat) - 1)])
+
+
+def oracle_cn(depths, ploidy=2):
+    tmp = sorted(float(x) for x in depths if x != 0)
+    lows = sum(1 for x in depths if x != 0 and x < 0.02)
+    if not tmp:
+        return -0.1
+    if lows / len(depths) > 0.3:
+        tmp = tmp[lows:]
+    if not tmp:
+        return 0.0
+    return float(np.float32(ploidy) * np.float32(tmp[int(len(tmp) * 0.4)]))
+
+
+def test_indexcov_pipeline_matches_sequential_oracle(tmp_path):
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(4):
+        male = i % 2 == 0
+        reads = random_reads(rng, 4000, 0, LENS[0])
+        n_x = 4000 * LENS[1] // LENS[0]
+        reads += random_reads(rng, n_x // 2 if male else n_x, 1, LENS[1])
+        p = str(tmp_path / f"s{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=REFS, ref_lens=LENS,
+                          header_text=_header(f"s{i}"))
+        paths.append(p)
+
+    res = run_indexcov(paths, str(tmp_path / "out"), sex="X",
+                       write_html=False, write_png=False)
+
+    # independent recomputation from the raw indexes
+    per_sample = []
+    for p in paths:
+        idx = read_bai(p + ".bai")
+        sizes = idx.sizes()
+        med = oracle_median([s for s in sizes if len(s)])
+        norm = [
+            np.minimum(
+                (s.astype(np.float64) / med).astype(np.float32), 50000
+            )
+            for s in sizes
+        ]
+        per_sample.append(norm)
+
+    # bed.gz values must equal the %.3g-formatted oracle normalization
+    with gzip.open(res["bed"], "rt") as fh:
+        fh.readline()
+        rows = [line.rstrip("\n").split("\t") for line in fh]
+    for chrom_i, chrom in enumerate(REFS):
+        crows = [r for r in rows if r[0] == chrom]
+        longest = max(len(ps[chrom_i]) for ps in per_sample)
+        assert len(crows) == longest
+        for b, r in enumerate(crows):
+            assert int(r[1]) == b * 16384
+            for k in range(4):
+                d = per_sample[k][chrom_i]
+                want = "%.3g" % d[b] if b < len(d) else "0"
+                assert r[3 + k] == want, (chrom, b, k)
+
+    # ped CNX equals the sequential GetCN oracle
+    with open(res["ped"]) as fh:
+        hdr = fh.readline().rstrip("\n").split("\t")
+        prows = [line.rstrip("\n").split("\t") for line in fh]
+    cnx_col = hdr.index("CNX")
+    for k in range(4):
+        want = oracle_cn(per_sample[k][1])
+        assert float(prows[k][cnx_col]) == float("%.2f" % want), k
+
+    # counters recomputed: in/out/hi/low over autosome (chr1) bins
+    for name, col in (("in", "bins.in"), ("out", "bins.out"),
+                      ("hi", "bins.hi"), ("lo", "bins.lo")):
+        ci = hdr.index(col)
+        longest = max(len(ps[0]) for ps in per_sample)
+        for k in range(4):
+            d = per_sample[k][0]
+            inside = int(np.sum((d >= 0.85) & (d <= 1.15)))
+            out_n = int(np.sum((d < 0.85) | (d > 1.15)))
+            hi = int(np.sum(d > 1.15))
+            lo = int(np.sum(d < 0.15))
+            tail = longest - len(d)
+            expect = {"in": inside, "out": out_n + tail, "hi": hi,
+                      "lo": lo + tail}[name]
+            assert int(prows[k][ci]) == expect, (name, k)

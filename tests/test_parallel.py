@@ -62,6 +62,31 @@ def test_sharded_depth_matches_brute():
         )
 
 
+def test_sharded_depth_scan_carry_mode():
+    """ppermute log-step scan carry must equal the all_gather carry."""
+    mesh = make_mesh(8, prefer_seq=8)
+    shard_len, window = 2048, 256
+    n_seq = 8
+    L = n_seq * shard_len
+    rng = np.random.default_rng(5)
+    S = 2
+    n = 400
+    starts = rng.integers(0, L - 300, size=(S, n)).astype(np.int32)
+    ends = (starts + rng.integers(20, 3000, size=(S, n))).astype(np.int32)
+    keep = np.ones((S, n), dtype=bool)
+    seg_s, seg_e, kp = partition_segments(starts, ends, keep, n_seq,
+                                          shard_len)
+    fa = sharded_depth_fn(mesh, shard_len, window)
+    fs = sharded_depth_fn(mesh, shard_len, window, carry_mode="scan")
+    with mesh:
+        da, _ = fa(seg_s, seg_e, kp)
+        ds, _ = fs(seg_s, seg_e, kp)
+    np.testing.assert_array_equal(np.asarray(da), np.asarray(ds))
+    want = brute_depth(starts[0][keep[0]],
+                       np.minimum(ends[0][keep[0]], L), L)
+    np.testing.assert_array_equal(np.asarray(ds)[0], want)
+
+
 def test_sharded_depth_boundary_reads():
     """Reads exactly straddling shard boundaries exercise the carry."""
     mesh = make_mesh(8)
