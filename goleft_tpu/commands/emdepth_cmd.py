@@ -36,6 +36,31 @@ def read_matrix(path: str):
             np.array(rows, dtype=preferred_float()), samples)
 
 
+EM_CHUNK = 16384  # windows per device batch
+
+
+def _batched_em(depths: np.ndarray):
+    """Run the EM in fixed-size window chunks: whole-genome matrices
+    (300k windows × 2504 samples ≈ 3GB f32) stream through the device
+    with ONE compile (the final chunk pads with ones and slices off)."""
+    B = len(depths)
+    if B <= EM_CHUNK:
+        lam = np.asarray(em.em_depth_batch(depths))
+        return lam, np.asarray(em.cn_batch(lam, depths))
+    lams, cns = [], []
+    for lo in range(0, B, EM_CHUNK):
+        chunk = depths[lo : lo + EM_CHUNK]
+        n = len(chunk)
+        if n < EM_CHUNK:
+            pad = np.ones((EM_CHUNK - n, depths.shape[1]), depths.dtype)
+            chunk = np.concatenate([chunk, pad])
+        lam = np.asarray(em.em_depth_batch(chunk))
+        cn = np.asarray(em.cn_batch(lam, chunk))
+        lams.append(lam[:n])
+        cns.append(cn[:n])
+    return np.concatenate(lams), np.concatenate(cns)
+
+
 def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
                 matrix_out: str | None = None):
     out = out or sys.stdout
@@ -49,8 +74,7 @@ def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
         med[med == 0] = 1.0
         depths = depths / med[None, :] * np.median(med)
 
-    lambdas = np.asarray(em.em_depth_batch(depths))
-    cns = np.asarray(em.cn_batch(lambdas, depths))
+    lambdas, cns = _batched_em(depths)
     if matrix_out:
         with open(matrix_out, "w") as mf:
             mf.write("#chrom\tstart\tend\t" + "\t".join(samples) + "\n")

@@ -1,0 +1,16 @@
+"""Chunked EM batching must be bit-identical to one full batch."""
+
+import numpy as np
+
+from goleft_tpu.commands import emdepth_cmd as ec
+
+
+def test_chunked_em_identical(monkeypatch):
+    rng = np.random.default_rng(0)
+    d = rng.gamma(30, 1.0, size=(53, 10))
+    monkeypatch.setattr(ec, "EM_CHUNK", 16)  # forces pad+slice path
+    lam_c, cn_c = ec._batched_em(d)
+    monkeypatch.setattr(ec, "EM_CHUNK", 10**9)
+    lam_f, cn_f = ec._batched_em(d)
+    np.testing.assert_allclose(lam_c, lam_f, rtol=1e-12)
+    np.testing.assert_array_equal(cn_c, cn_f)

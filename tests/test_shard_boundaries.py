@@ -1,0 +1,67 @@
+"""Whole-genome sharding: reads and windows crossing 10Mb shard
+boundaries must produce seamless output (the reference spent most of its
+edge-case code here, depth/depth.go:293-359)."""
+
+import numpy as np
+
+from goleft_tpu.commands import depth as depth_mod
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.io.bam import BamReader
+from goleft_tpu.io.fai import write_fai
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+def oracle_per_base(bam_path, ref_len, mapq=1):
+    d = np.zeros(ref_len, dtype=np.int64)
+    for rec in BamReader.from_file(bam_path):
+        if rec.flag & 0x704 or rec.mapq < mapq:
+            continue
+        for s, e in rec.aligned_blocks():
+            d[s:min(e, ref_len)] += 1
+    return d
+
+
+def test_depth_across_shard_boundaries(tmp_path, monkeypatch):
+    ref_len = 100_000
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 1500, 0, ref_len)
+    # plant reads exactly straddling every future shard boundary
+    for b in (20_000, 40_000, 60_000, 80_000):
+        reads.append((0, b - 50, "100M", 60, 0))
+        reads.append((0, b - 1, "100M", 60, 0))
+        reads.append((0, b, "100M", 60, 0))
+    reads.sort(key=lambda r: r[1])
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(ref_len,))
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+
+    monkeypatch.setattr(depth_mod, "STEP", 20_000)
+    dpath, cpath = run_depth(p, str(tmp_path / "o"), reference=fa,
+                             window=300)
+    oracle = oracle_per_base(p, ref_len)
+
+    rows = []
+    with open(dpath) as fh:
+        for line in fh:
+            t = line.rstrip("\n").split("\t")
+            rows.append((int(t[1]), int(t[2]), t[3]))
+    # windows tile [0, ref_len) seamlessly — no duplicate/missing rows
+    # at shard boundaries (step 20_000 is not a multiple of 300, so
+    # shards get realigned to window multiples)
+    assert rows[0][0] == 0 and rows[-1][1] == ref_len
+    for (s0, e0, _), (s1, e1, _) in zip(rows, rows[1:]):
+        assert e0 == s1
+    # every mean matches the oracle exactly
+    for s, e, m in rows:
+        assert f"{oracle[s:e].sum() / (e - s):.4g}" == m, (s, e)
+
+    # callable runs also tile seamlessly with no same-class neighbors
+    crows = []
+    with open(cpath) as fh:
+        for line in fh:
+            t = line.rstrip("\n").split("\t")
+            crows.append((int(t[1]), int(t[2]), t[3]))
+    assert crows[0][0] == 0 and crows[-1][1] == ref_len
+    for (s0, e0, c0), (s1, e1, c1) in zip(crows, crows[1:]):
+        assert e0 == s1
