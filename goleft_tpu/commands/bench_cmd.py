@@ -4,6 +4,13 @@ from __future__ import annotations
 
 
 def main(argv=None):
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
     import bench
 
     bench.main(argv or [])

@@ -27,7 +27,7 @@ from ..io.bam import ReadColumns, open_bam_file
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
 from ..ops.depth_pipeline import shard_depth_pipeline
-from .depth import STEP, DEPTH_CAP_EXTRA, gen_regions
+from .depth import DEPTH_CAP_EXTRA, gen_regions
 from .indexcov import get_short_name
 
 

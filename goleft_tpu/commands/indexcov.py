@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import glob as _glob
-import gzip
 import logging
 import os
 import re

@@ -17,7 +17,6 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..io.fai import read_fai
 from ..utils.regions import read_tree, overlaps
 from .indexcov import SampleIndex, references
 

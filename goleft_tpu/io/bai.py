@@ -141,7 +141,7 @@ def build_bai(bam_path: str) -> BaiIndex:
     virtual offset of any alignment overlapping window w; gaps are filled
     with the preceding value so tile deltas are non-negative.
     """
-    from .bam import BamReader, reg2bin, DEPTH_SKIP_FLAGS  # noqa: F401
+    from .bam import BamReader, reg2bin
     from .bam import FLAG_UNMAPPED
 
     rdr = BamReader.from_file(bam_path)

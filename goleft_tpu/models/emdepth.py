@@ -29,7 +29,6 @@ device results.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, field
 
 import jax

@@ -17,7 +17,6 @@ bucketing (indexsplit-style even-data planning) produces exactly this.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
