@@ -46,6 +46,8 @@ PROGS = {
                   _lazy(".commands.anonymize")),
     "cohortdepth": ("depth matrix for many bams in one device pass",
                     _lazy(".commands.cohortdepth")),
+    "cnv": ("CNV calls straight from bams (cohort depth + EM)",
+            _lazy(".commands.cnv")),
 }
 
 

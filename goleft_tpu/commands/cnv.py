@@ -1,0 +1,63 @@
+"""cnv: BAMs → CNV calls in one TPU pass.
+
+Composition of the framework's pieces that takes the reference three
+separate tools and a shell pipeline (depth × N → depthwed → emdepth
+library): decode cohort reads per shard (lazy native io), batch the
+windowed depth matrix on device (cohortdepth machinery), run the batched
+EM copy-number caller with the 30kb streaming merge, and emit
+  chrom  start  end  sample  CN  log2FC
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .cohortdepth import run_cohortdepth
+from .emdepth_cmd import run_emdepth
+
+
+def run_cnv(bams, reference=None, fai=None, window: int = 1000,
+            mapq: int = 1, chrom: str = "", processes: int = 8,
+            out=None, matrix_out=None):
+    out = out or sys.stdout
+    import os
+    import tempfile
+
+    # stream the matrix straight to a temp TSV (one resident copy, not a
+    # StringIO + file round-trip)
+    with tempfile.NamedTemporaryFile("w", suffix=".tsv",
+                                     delete=False) as tf:
+        run_cohortdepth(bams, reference=reference, fai=fai,
+                        window=window, mapq=mapq, chrom=chrom,
+                        processes=processes, out=tf)
+        path = tf.name
+    try:
+        return run_emdepth(path, out=out, matrix_out=matrix_out)
+    finally:
+        os.unlink(path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "goleft-tpu cnv",
+        description="CNV calls straight from BAMs: cohort depth matrix "
+                    "+ EM copy number in one device pipeline",
+    )
+    p.add_argument("-w", "--windowsize", type=int, default=1000)
+    p.add_argument("-Q", "--mapq", type=int, default=1)
+    p.add_argument("-c", "--chrom", default="")
+    p.add_argument("-r", "--reference", default=None)
+    p.add_argument("--fai", default=None)
+    p.add_argument("-p", "--processes", type=int, default=8)
+    p.add_argument("--matrix-out", default=None,
+                   help="also write the per-window CN matrix here")
+    p.add_argument("bams", nargs="+")
+    a = p.parse_args(argv)
+    run_cnv(a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
+            mapq=a.mapq, chrom=a.chrom, processes=a.processes,
+            matrix_out=a.matrix_out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""End-to-end cnv command: planted deletion recovered straight from BAMs."""
+
+import io
+
+import numpy as np
+
+from goleft_tpu.commands.cnv import run_cnv
+from goleft_tpu.io.fai import write_fai
+from helpers import write_bam_and_bai, write_fasta
+
+
+def test_cnv_finds_planted_deletion(tmp_path):
+    rng = np.random.default_rng(0)
+    ref_len = 120_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    del_lo, del_hi = 40_000, 60_000
+    bams = []
+    for i in range(8):
+        deleted = i == 3
+        starts = np.sort(rng.integers(0, ref_len - 100, size=4000))
+        if deleted:
+            # drop ~half the reads in the deletion region (het del)
+            in_del = (starts >= del_lo) & (starts < del_hi)
+            drop = in_del & (rng.random(len(starts)) < 0.5)
+            starts = starts[~drop]
+        reads = [(0, int(s), "100M", 60, 0) for s in starts]
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:p{i}\n")
+        p = str(tmp_path / f"p{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,), header_text=hdr)
+        bams.append(p)
+    out = io.StringIO()
+    mpath = str(tmp_path / "cn.tsv")
+    results = run_cnv(bams, reference=fa, window=2000, out=out,
+                      matrix_out=mpath)
+    # the deleted sample gets a CNV call overlapping the planted region
+    hits = [r for r in results if r[3] == "p3" and r[4] < 2]
+    assert hits, results
+    c, s, e, sample, cn, fc = hits[0]
+    assert s < del_hi and e > del_lo
+    assert fc < -0.3
+    # no other sample gets a deletion call spanning most of the region
+    for r in results:
+        if r[3] != "p3" and r[4] < 2:
+            assert (min(r[2], del_hi) - max(r[1], del_lo)) < 10_000
+    # CN matrix written
+    rows = open(mpath).read().splitlines()
+    assert rows[0] == "#chrom\tstart\tend\t" + "\t".join(
+        f"p{i}" for i in range(8)
+    )
+    assert len(rows) == ref_len // 2000 + 1
