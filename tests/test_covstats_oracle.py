@@ -1,0 +1,111 @@
+"""covstats vectorized sampling emulation vs a sequential transcription
+of the reference loop (covstats/covstats.go:122-220)."""
+
+import numpy as np
+
+from goleft_tpu.commands.covstats import bam_stats, mad_filter, mean_std
+from goleft_tpu.io.bam import ReadColumns
+
+
+def make_cols(rng, n):
+    """Random read columns with paired/dup/unmapped/qcfail mixtures."""
+    flag = np.zeros(n, dtype=np.int64)
+    flag[rng.random(n) < 0.05] |= 0x4  # unmapped
+    flag[rng.random(n) < 0.08] |= 0x400  # dup
+    flag[rng.random(n) < 0.03] |= 0x200  # qcfail
+    proper = rng.random(n) < 0.7
+    flag[proper] |= 0x2
+    pos = np.sort(rng.integers(0, 10_000_000, size=n))
+    read_len = rng.choice([100, 101, 150], size=n)
+    end = pos + read_len
+    mate_pos = pos + rng.integers(-400, 400, size=n)
+    tlen = mate_pos + read_len - pos
+    single_m = rng.random(n) < 0.9
+    z = np.zeros(0, np.int32)
+    return ReadColumns(
+        np.zeros(n, np.int32), pos.astype(np.int32), end.astype(np.int32),
+        np.full(n, 60, np.uint8), flag.astype(np.uint16),
+        tlen.astype(np.int32), read_len.astype(np.int32),
+        mate_pos.astype(np.int32), single_m, z, z, z, z,
+    )
+
+
+def oracle_bam_stats(cols, n, skip):
+    """Direct transcription of BamStats' sequential loop."""
+    sizes, inserts, templates = [], [], []
+    n_bad = n_unmapped = k = 0
+    prop_dup = prop_proper = 0
+    i = skip
+    N = cols.n_reads
+    while len(inserts) < n and i < N:
+        flag = int(cols.flag[i])
+        if flag & 0x4:
+            n_unmapped += 1
+            i += 1
+            continue
+        k += 1
+        if flag & (0x400 | 0x200):
+            if flag & 0x400:
+                prop_dup += 1
+            n_bad += 1
+            i += 1
+            continue
+        if flag & 0x2:
+            prop_proper += 1
+        if len(sizes) < 2 * n:
+            sizes.append(int(cols.read_len[i]))
+        elif len(inserts) == 0:
+            i += 1
+            break
+        if (cols.pos[i] < cols.mate_pos[i] and flag & 0x2
+                and cols.single_m[i]):
+            inserts.append(int(cols.mate_pos[i]) - int(cols.end[i]))
+            templates.append(int(cols.tlen[i]))
+        i += 1
+    denom = max(k + n_unmapped, 1)
+    st = {
+        "prop_bad": n_bad / denom,
+        "prop_dup": prop_dup / denom,
+        "prop_proper": prop_proper / denom,
+        "prop_unmapped": n_unmapped / denom,
+    }
+    if sizes:
+        ss = sorted(sizes)
+        st["read_len_median"] = float(ss[(len(ss) - 1) // 2]) - 1
+        st["read_len_mean"] = mean_std(np.array(ss))[0]
+        st["max_read_len"] = ss[-1]
+    if inserts:
+        si = np.sort(np.array(inserts))
+        l = float(len(si) - 1)
+        st["insert_5"] = int(si[int(0.05 * l + 0.5)])
+        st["insert_95"] = int(si[int(0.95 * l + 0.5)])
+        st["insert_mean"], st["insert_sd"] = mean_std(mad_filter(si))
+        st["template_mean"], st["template_sd"] = mean_std(
+            mad_filter(np.sort(np.array(templates)))
+        )
+    return st
+
+
+def test_bam_stats_matches_sequential_oracle():
+    rng = np.random.default_rng(0)
+    for trial, (n_reads, n, skip) in enumerate(
+        [(5000, 300, 100), (2000, 10_000, 0), (800, 100, 700)]
+    ):
+        cols = make_cols(rng, n_reads)
+        got = bam_stats(cols, n, skip)
+        want = oracle_bam_stats(cols, n, skip)
+        for key, w in want.items():
+            g = got[key]
+            assert np.isclose(g, w, rtol=1e-12), (trial, key, g, w)
+
+
+def test_bam_stats_single_end_early_stop():
+    """All single-end (no proper pairs): stops once 2n sizes banked."""
+    rng = np.random.default_rng(1)
+    cols = make_cols(rng, 3000)
+    cols.flag[:] = 0  # mapped, unpaired, never proper
+    got = bam_stats(cols, n=100, skip=0)
+    want = oracle_bam_stats(cols, 100, 0)
+    assert got["insert_mean"] == 0.0
+    for key, w in want.items():
+        assert np.isclose(got[key], w, rtol=1e-12), key
