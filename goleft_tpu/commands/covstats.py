@@ -54,6 +54,11 @@ def mean_std(arr: np.ndarray) -> tuple[float, float]:
 
 def bam_stats(cols: ReadColumns, n: int, skip: int = SKIP_READS) -> dict:
     """Emulates BamStats over pre-decoded columns."""
+    if cols.n_reads <= skip:
+        # the reference warns and proceeds with whatever remains
+        # (covstats.go:128-133)
+        print("covstats: not enough reads to sample for bam stats",
+              file=__import__("sys").stderr)
     flag = cols.flag.astype(np.int64)[skip:]
     pos = cols.pos[skip:]
     end = cols.end[skip:]
