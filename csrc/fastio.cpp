@@ -123,13 +123,17 @@ long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
 
 // CIGAR op properties: MIDNSHP=X
 static const int CONSUMES_REF[9] = {1, 0, 1, 1, 0, 0, 0, 1, 1};
+static const int CONSUMES_QUERY[9] = {1, 1, 0, 0, 1, 0, 0, 1, 1};
 static const int IS_ALIGNED[9] = {1, 0, 0, 0, 0, 0, 0, 1, 1};
 
 // Decode BAM records from an uncompressed body buffer starting at
 // `offset`, keeping records on `target_tid` overlapping [start, end)
 // (target_tid < 0 keeps everything). Fills columnar outputs; returns
 // number of reads decoded, with n_segs_out/consumed_out side outputs.
-// Error codes: -1 truncated, -2 capacity exceeded.
+// Error codes: -1 truncated, -2 capacity exceeded, -9 malformed record
+// geometry (BGZF CRC only validates compression, so a corrupt or
+// mid-record-truncated BAM body reaches this code; every record-relative
+// read below must be bounded by block_size before it happens).
 long bam_decode(const uint8_t* body, long body_len, long offset,
                 int target_tid, int start, int end, long cap_reads,
                 long cap_segs,
@@ -146,7 +150,11 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
     while (off + 4 <= body_len) {
         int32_t block_size;
         memcpy(&block_size, body + off, 4);
-        if (off + 4 + block_size > body_len) {
+        // A record is at least the 32-byte fixed header; a negative
+        // block_size would otherwise pass the truncation check below and
+        // walk `off` backwards (infinite loop + unbounded retry upstream).
+        if (block_size < 32) return -9;
+        if (off + 4 + (long)block_size > body_len) {
             *done_out = 0;  // truncated tail
             break;
         }
@@ -163,25 +171,32 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
         memcpy(&mtid, p + 20, 4);
         memcpy(&mpos, p + 24, 4);
         memcpy(&tl, p + 28, 4);
+        // Variable-length sections (read name + CIGAR) must fit inside
+        // the record's own block, or the CIGAR loop reads past it.
+        if (32L + l_rn + 4L * n_cig > (long)block_size) return -9;
         if (target_tid >= 0) {
             if (rtid > target_tid || rtid < 0) break;  // sorted: done
             if (rtid < target_tid) { off += 4 + block_size; continue; }
             if (end >= 0 && rpos >= end) break;
         }
         const uint8_t* cig = p + 32 + l_rn;
-        long ref_len = 0;
+        long ref_len = 0, query_len = 0;
         for (int c = 0; c < n_cig; c++) {
             uint32_t v;
             memcpy(&v, cig + 4 * c, 4);
             uint32_t opl = v >> 4, opc = v & 0xF;
             if (opc < 9 && CONSUMES_REF[opc]) ref_len += opl;
+            if (opc < 9 && CONSUMES_QUERY[opc]) query_len += opl;
         }
         int32_t re = rpos + (int32_t)ref_len;
         if (target_tid >= 0 && re <= start) { off += 4 + block_size; continue; }
         if (nr >= cap_reads) return -2;
         tid[nr] = rtid; pos[nr] = rpos; rend[nr] = re;
         mapq[nr] = q; flag[nr] = fl; tlen[nr] = tl;
-        read_len[nr] = l_seq; mate_pos[nr] = mpos;
+        // read length from l_seq, falling back to the CIGAR query length
+        // when SEQ is omitted ('*') — the reference measures the CIGAR
+        read_len[nr] = l_seq > 0 ? l_seq : (int32_t)query_len;
+        mate_pos[nr] = mpos;
         int32_t cursor = rpos;
         int nseg_rec = 0;
         uint32_t first_op = 9;

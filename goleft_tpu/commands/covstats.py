@@ -1,10 +1,10 @@
 """covstats: per-BAM coverage/insert-size estimates by read sampling.
 
 Reference: covstats/covstats.go. The sequential sampling loop (":122-220")
-is emulated exactly with vectorized column math over the decoded read
-columns: skip the first 100k reads, then consume records until n insert
-sizes are collected (or EOF, or 2n read-lengths with zero inserts —
-single-end early stop). Insert sizes come only from proper pairs upstream
+is emulated exactly with vectorized column math over *streamed* decode
+chunks (BamStatsAccumulator): skip the first 100k reads, then consume
+records until n insert sizes are collected (or EOF, or 2n read-lengths
+with zero inserts — single-end early stop), holding only O(n) state. Insert sizes come only from proper pairs upstream
 of their mate with a single-M cigar (":169-172"); outliers are trimmed by
 the 10-MAD upper filter (":57-76" — including its quirk of dropping the
 final element when nothing exceeds the bound); coverage =
@@ -18,7 +18,7 @@ import argparse
 import numpy as np
 
 from ..io.bai import read_bai
-from ..io.bam import BamReader, ReadColumns, open_bam
+from ..io.bam import ReadColumns, open_bam_file
 from ..utils.xopen import xopen
 
 N_MADS = 10
@@ -52,90 +52,161 @@ def mean_std(arr: np.ndarray) -> tuple[float, float]:
     return m, float(np.sqrt(np.mean((arr - m) ** 2)))
 
 
+class BamStatsAccumulator:
+    """Streaming emulation of the reference sampling loop over column
+    chunks (covstats.go:122-220).
+
+    State is O(n): bounded size/insert/template banks plus scalar
+    counters, so a whole-file scan holds one decode window plus these
+    banks — the same memory bound as the reference's record-at-a-time
+    loop. ``update`` consumes a chunk; ``done`` flips once the sequential
+    loop would have exited (n inserts banked, or the single-end early
+    break at the 2n+1-th good record with no inserts yet, covstats.go's
+    ``len(insertSizes) == 0`` branch — which fires *before* that record's
+    own insert would be appended).
+    """
+
+    def __init__(self, n: int, skip: int = SKIP_READS):
+        self.n = n
+        self.skip = skip
+        self.skip_left = skip
+        self.total_seen = 0
+        self.k = 0
+        self.n_unmapped = 0
+        self.n_bad = 0
+        self.n_dup = 0
+        self.n_proper = 0
+        self._sizes: list[np.ndarray] = []
+        self._n_sizes = 0
+        self._total_good = 0
+        self._inserts: list[np.ndarray] = []
+        self._templates: list[np.ndarray] = []
+        self._n_inserts = 0
+        self.done = False
+
+    def update(self, cols: ReadColumns) -> None:
+        if self.done or cols.n_reads == 0:
+            return
+        self.total_seen += cols.n_reads
+        s0 = 0
+        if self.skip_left > 0:
+            s0 = min(self.skip_left, cols.n_reads)
+            self.skip_left -= s0
+            if s0 >= cols.n_reads:
+                return
+        flag = cols.flag.astype(np.int64)[s0:]
+        pos = cols.pos[s0:]
+        end = cols.end[s0:]
+        mate_pos = cols.mate_pos[s0:]
+        tlen = cols.tlen[s0:]
+        read_len = cols.read_len[s0:]
+        single_m = cols.single_m[s0:]
+
+        unmapped = (flag & FLAG_UNMAPPED) != 0
+        mapped = ~unmapped
+        bad = mapped & ((flag & (FLAG_DUP | FLAG_QCFAIL)) != 0)
+        dup = mapped & ((flag & FLAG_DUP) != 0)
+        good = mapped & ~bad
+        proper = good & ((flag & FLAG_PROPER) != 0)
+        ins_ok = (good & (pos < mate_pos)
+                  & ((flag & FLAG_PROPER) != 0) & single_m)
+
+        cum_ins = np.cumsum(ins_ok)
+        stop = len(flag)
+        hit = np.flatnonzero(cum_ins + self._n_inserts >= self.n)
+        if len(hit):
+            stop = int(hit[0]) + 1
+            self.done = True
+        if self._n_inserts == 0:
+            # single-end early break: the first good record that finds the
+            # size bank already full (cumulative good count = 2n+1) exits
+            # before appending its own insert
+            cum_good = np.cumsum(good) + self._total_good
+            full = np.flatnonzero(cum_good >= 2 * self.n + 1)
+            if len(full):
+                j = int(full[0])
+                if cum_ins[j] - int(ins_ok[j]) == 0 and j + 1 <= stop:
+                    stop = j + 1
+                    ins_ok[j] = False
+                    self.done = True
+
+        sl = slice(0, stop)
+        self.k += int(np.sum(mapped[sl]))
+        self.n_unmapped += int(np.sum(unmapped[sl]))
+        self.n_bad += int(np.sum(bad[sl]))
+        self.n_dup += int(np.sum(dup[sl]))
+        self.n_proper += int(np.sum(proper[sl]))
+        good_sl = good[sl]
+        self._total_good += int(np.sum(good_sl))
+        room = 2 * self.n - self._n_sizes
+        if room > 0:
+            sz = read_len[sl][good_sl][:room]
+            if len(sz):
+                self._sizes.append(sz)
+                self._n_sizes += len(sz)
+        ins_mask = ins_ok[sl]
+        room_i = self.n - self._n_inserts
+        if room_i > 0:
+            ins = (mate_pos[sl] - end[sl])[ins_mask][:room_i]
+            if len(ins):
+                self._inserts.append(ins)
+                self._templates.append(tlen[sl][ins_mask][:room_i])
+                self._n_inserts += len(ins)
+
+    def finalize(self) -> dict:
+        import sys
+
+        if not self.done and self.total_seen <= self.skip:
+            # reference warns when the skip loop hits EOF
+            # (covstats.go:128-133) and proceeds with whatever remains
+            print("covstats: not enough reads to sample for bam stats",
+                  file=sys.stderr)
+        denom = max(self.k + self.n_unmapped, 1)
+        st = {
+            "prop_bad": self.n_bad / denom,
+            "prop_dup": self.n_dup / denom,
+            "prop_proper": self.n_proper / denom,
+            "prop_unmapped": self.n_unmapped / denom,
+            "insert_mean": 0.0, "insert_sd": 0.0,
+            "insert_5": 0, "insert_95": 0,
+            "template_mean": 0.0, "template_sd": 0.0,
+            "read_len_mean": 0.0, "read_len_median": 0.0,
+            "max_read_len": 0,
+            "histogram": np.zeros(0),
+        }
+        if self._n_sizes:
+            sizes = np.sort(np.concatenate(self._sizes))
+            st["read_len_median"] = float(sizes[(len(sizes) - 1) // 2]) - 1
+            st["read_len_mean"] = mean_std(sizes)[0]
+            st["max_read_len"] = int(sizes[-1])
+        if self._n_inserts:
+            s_ins = np.sort(np.concatenate(self._inserts))
+            l = float(len(s_ins) - 1)
+            st["insert_5"] = int(s_ins[int(0.05 * l + 0.5)])
+            st["insert_95"] = int(s_ins[int(0.95 * l + 0.5)])
+            filt = mad_filter(s_ins)
+            st["insert_mean"], st["insert_sd"] = mean_std(filt)
+            tfilt = mad_filter(np.sort(np.concatenate(self._templates)))
+            st["template_mean"], st["template_sd"] = mean_std(tfilt)
+            # lumpy-style normalized template histogram (covstats.go:201-217)
+            start = float(st["max_read_len"])
+            stop_h = st["template_mean"] + st["template_sd"] * 4
+            nbins = int(stop_h - start + 1)
+            if nbins > 0:
+                h = np.zeros(nbins)
+                tv = tfilt[(tfilt >= start) & (tfilt <= stop_h)]
+                np.add.at(h, (tv - start).astype(np.int64), 1)
+                if len(tv):
+                    h /= len(tv)
+                st["histogram"] = h
+        return st
+
+
 def bam_stats(cols: ReadColumns, n: int, skip: int = SKIP_READS) -> dict:
-    """Emulates BamStats over pre-decoded columns."""
-    if cols.n_reads <= skip:
-        # the reference warns and proceeds with whatever remains
-        # (covstats.go:128-133)
-        print("covstats: not enough reads to sample for bam stats",
-              file=__import__("sys").stderr)
-    flag = cols.flag.astype(np.int64)[skip:]
-    pos = cols.pos[skip:]
-    end = cols.end[skip:]
-    mate_pos = cols.mate_pos[skip:]
-    tlen = cols.tlen[skip:]
-    read_len = cols.read_len[skip:]
-    single_m = cols.single_m[skip:]
-
-    unmapped = (flag & FLAG_UNMAPPED) != 0
-    mapped = ~unmapped
-    bad = mapped & ((flag & (FLAG_DUP | FLAG_QCFAIL)) != 0)
-    dup = mapped & ((flag & FLAG_DUP) != 0)
-    good = mapped & ~bad
-    proper = good & ((flag & FLAG_PROPER) != 0)
-    ins_ok = good & (pos < mate_pos) & ((flag & FLAG_PROPER) != 0) & single_m
-
-    # stop index: the record that fills the n-th insert, or the single-end
-    # early break once 2n read lengths are banked with zero inserts, or EOF
-    cum_ins = np.cumsum(ins_ok)
-    stop = len(flag)
-    hit = np.flatnonzero(cum_ins >= n)
-    if len(hit):
-        stop = int(hit[0]) + 1
-    cum_sizes = np.cumsum(good)
-    full = np.flatnonzero(cum_sizes >= 2 * n + 1)
-    if len(full):
-        j = int(full[0])
-        if cum_ins[j] == 0:
-            stop = min(stop, j + 1)
-
-    sl = slice(0, stop)
-    k = int(np.sum(mapped[sl]))
-    n_unmapped = int(np.sum(unmapped[sl]))
-    denom = max(k + n_unmapped, 1)
-    st = {
-        "prop_bad": np.sum(bad[sl]) / denom,
-        "prop_dup": np.sum(dup[sl]) / denom,
-        "prop_proper": np.sum(proper[sl]) / denom,
-        "prop_unmapped": n_unmapped / denom,
-        "insert_mean": 0.0, "insert_sd": 0.0,
-        "insert_5": 0, "insert_95": 0,
-        "template_mean": 0.0, "template_sd": 0.0,
-        "read_len_mean": 0.0, "read_len_median": 0.0, "max_read_len": 0,
-        "histogram": np.zeros(0),
-    }
-    sizes = read_len[sl][good[sl]][: 2 * n]
-    if len(sizes):
-        sizes = np.sort(sizes)
-        st["read_len_median"] = float(sizes[(len(sizes) - 1) // 2]) - 1
-        st["read_len_mean"] = mean_std(sizes)[0]
-        st["max_read_len"] = int(sizes[-1])
-
-    ins_mask = ins_ok[sl]
-    inserts = (mate_pos[sl] - end[sl])[ins_mask][:n]
-    templates = tlen[sl][ins_mask][:n]
-    if len(inserts):
-        s_ins = np.sort(inserts)
-        l = float(len(s_ins) - 1)
-        st["insert_5"] = int(s_ins[int(0.05 * l + 0.5)])
-        st["insert_95"] = int(s_ins[int(0.95 * l + 0.5)])
-        filt = mad_filter(s_ins)
-        st["insert_mean"], st["insert_sd"] = mean_std(filt)
-        tfilt = mad_filter(np.sort(templates))
-        st["template_mean"], st["template_sd"] = mean_std(tfilt)
-        # lumpy-style normalized template histogram (covstats.go:201-217)
-        start = float(st["max_read_len"])
-        stop_h = st["template_mean"] + st["template_sd"] * 4
-        nbins = int(stop_h - start + 1)
-        if nbins > 0:
-            h = np.zeros(nbins)
-            tv = tfilt[(tfilt >= start) & (tfilt <= stop_h)]
-            idx = (tv - start).astype(np.int64)
-            np.add.at(h, idx, 1)
-            if len(tv):
-                h /= len(tv)
-            st["histogram"] = h
-    return st
+    """Emulates BamStats over pre-decoded columns (one-shot form)."""
+    acc = BamStatsAccumulator(n, skip)
+    acc.update(cols)
+    return acc.finalize()
 
 
 def region_bases(bed_path: str) -> int:
@@ -161,18 +232,20 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
     out.write(HEADER + "\n")
     results = []
     for path in bams:
-        with open(path, "rb") as fh:
-            data = fh.read()
-        handle = open_bam(data)
+        # lazy native handle: the compressed file is mmapped and only the
+        # decode window is ever inflated, so peak RSS is O(window + n)
+        # regardless of file size — matching the reference's streaming
+        # record loop (covstats.go:122-220) instead of round 1's eager
+        # whole-file inflate
+        handle = open_bam_file(path, lazy=True)
         names = ",".join(handle.header.sample_names()) or \
             "<no-read-groups>"
-        if getattr(handle, "native", False):
-            cols = handle.read_columns()
-        else:
-            # python fallback: decode only what the sampling loop needs
-            rdr = BamReader(data)
-            cols = rdr.read_columns(max_records=skip + 4 * n)
-        st = bam_stats(cols, n, skip)
+        acc = BamStatsAccumulator(n, skip)
+        for cols in handle.stream_columns():
+            acc.update(cols)
+            if acc.done:
+                break
+        st = acc.finalize()
 
         genome_bases = sum(handle.header.ref_lens)
         mapped = 0

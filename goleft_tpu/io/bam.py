@@ -256,6 +256,8 @@ class BamReader:
         if len(szb) < 4:
             return None
         (block_size,) = struct.unpack("<i", szb)
+        if block_size < 32:
+            raise ValueError("bam: malformed record geometry")
         buf = self._r.read(block_size)
         if len(buf) < block_size:
             raise ValueError("bam: truncated record")
@@ -284,9 +286,13 @@ class BamReader:
             if len(szb) < 4:
                 break
             (block_size,) = struct.unpack("<i", szb)
+            if block_size < 32:
+                raise ValueError("bam: malformed record geometry")
             buf = self._r.read(block_size)
             (rtid, pos, l_rn, mapq, _bin, n_cig, flag, l_seq
              ) = struct.unpack_from("<iiBBHHHi", buf, 0)
+            if 32 + l_rn + 4 * n_cig > block_size:
+                raise ValueError("bam: malformed record geometry")
             if tid is not None:
                 if rtid > tid or rtid < 0:
                     break  # sorted BAM: past the target chromosome
@@ -311,7 +317,13 @@ class BamReader:
             mapqs.append(mapq)
             flags.append(flag)
             tlens.append(tlen)
-            rlens.append(l_seq)
+            # reference covstats measures read length from the CIGAR query
+            # length (covstats.go rec.Cigar.Lengths()); BAM l_seq matches it
+            # except when SEQ is omitted ('*', l_seq=0) — fall back then
+            if l_seq > 0:
+                rlens.append(l_seq)
+            else:
+                rlens.append(int(np.sum(oplen * _CONSUMES_QUERY[opc])))
             mposs.append(mpos)
             singlem.append(n_cig == 1 and (cig[0] & 0xF) == 0)
             # aligned blocks
@@ -342,6 +354,19 @@ class BamReader:
             np.asarray(seg_e, dtype=np.int32),
             np.asarray(seg_r, dtype=np.int32),
         )
+
+
+def _cols_from_decode(out: dict) -> "ReadColumns":
+    """Native bam_decode output dict → ReadColumns (shared by the one-shot
+    and streaming paths so the column wiring can't drift apart)."""
+    return ReadColumns(
+        out["tid"], out["pos"], out["end"], out["mapq"],
+        out["flag"], out["tlen"], out["read_len"],
+        out["mate_pos"], out["single_m"].astype(bool),
+        out["tid"][out["seg_read"]] if out["n_reads"] else
+        np.zeros(0, np.int32),
+        out["seg_start"], out["seg_end"], out["seg_read"],
+    )
 
 
 def _parse_header_buf(buf) -> tuple[BamHeader, int]:
@@ -485,14 +510,63 @@ class BamFile:
             else:
                 offset = self._body_start
             out = self._decode(offset, tid, start, end)
-        return ReadColumns(
-            out["tid"], out["pos"], out["end"], out["mapq"],
-            out["flag"], out["tlen"], out["read_len"],
-            out["mate_pos"], out["single_m"].astype(bool),
-            out["tid"][out["seg_read"]] if out["n_reads"] else
-            np.zeros(0, np.int32),
-            out["seg_start"], out["seg_end"], out["seg_read"],
-        )
+        return _cols_from_decode(out)
+
+    def stream_columns(self, window_bytes: int = 1 << 24):
+        """Yield ReadColumns chunks over the whole record stream in order.
+
+        Lazy mode inflates only the current BGZF block window, so peak host
+        memory is O(window), not O(file) — the reference's streaming loop
+        (covstats/covstats.go:122-220) has the same bound. Eager mode just
+        walks the resident body in window-sized decode steps.
+        """
+        from . import native
+
+        if not self.native:
+            raise RuntimeError("stream_columns requires the native library")
+        to_cols = _cols_from_decode
+
+        if not self.lazy:
+            off = self._body_start
+            total = len(self.body)
+            while off < total:
+                lim = min(off + window_bytes, total)
+                out = native.bam_decode(self.body[:lim], off, -1, 0, -1)
+                if out["n_reads"]:
+                    yield to_cols(out)
+                if out["consumed"] == 0:
+                    if lim >= total:
+                        break  # truncated tail / EOF
+                    window_bytes *= 2  # record larger than the window
+                    continue
+                off += out["consumed"]
+            return
+
+        nb = len(self._co)
+        u_off = self._body_start  # absolute uncompressed cursor
+        while u_off < self._total:
+            b0 = int(np.searchsorted(self._uo, u_off, side="right")) - 1
+            b0 = max(b0, 0)
+            in_block = u_off - int(self._uo[b0])
+            b1 = int(np.searchsorted(
+                self._uo, int(self._uo[b0]) + in_block + window_bytes,
+                side="left",
+            ))
+            b1 = min(max(b1, b0 + 1), nb)
+            c0 = int(self._co[b0])
+            c_end = int(self._co[b1]) if b1 < nb else len(self._comp)
+            cap = (int(self._uo[b1]) if b1 < nb else self._total) \
+                - int(self._uo[b0])
+            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap)
+            out = native.bam_decode(body, in_block, -1, 0, -1)
+            if out["n_reads"]:
+                yield to_cols(out)
+            if out["consumed"] == 0:
+                if b1 >= nb:
+                    break  # truncated tail / EOF
+                window_bytes *= 2  # record larger than the window
+                continue
+            u_off += out["consumed"]
 
     def _read_lazy(self, tid, start, end, voffset, end_voffset):
         from . import native
@@ -544,6 +618,17 @@ class _PyBamAdapter:
         if voffset is not None:
             rdr.seek_virtual(voffset)
         return rdr.read_columns(tid=tid, start=start, end=end)
+
+    def stream_columns(self, window_bytes: int = 1 << 24,
+                       chunk_records: int = 1 << 18):
+        """Chunked sequential decode; loops to EOF (not a fixed record
+        cap), so consumers see the same stream the native path yields."""
+        rdr = BamReader(self._data)
+        while True:
+            cols = rdr.read_columns(max_records=chunk_records)
+            if cols.n_reads == 0:
+                return
+            yield cols
 
 
 def read_header_only(path: str, initial: int = 1 << 20) -> BamHeader:

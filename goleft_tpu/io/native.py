@@ -156,9 +156,20 @@ _ERRS = {
     -8: "corrupt block header geometry",
 }
 
+# bam_decode has its own error space (fastio.cpp bam_decode header)
+_BAM_ERRS = {
+    -1: "truncated record stream",
+    -2: "capacity exceeded",
+    -9: "malformed BAM record geometry",
+}
+
 
 def _err(code) -> str:
     return _ERRS.get(int(code), f"error {code}")
+
+
+def _bam_err(code) -> str:
+    return _BAM_ERRS.get(int(code), f"error {code}")
 
 
 def bam_decode(body: np.ndarray, offset: int, target_tid: int,
@@ -215,7 +226,7 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
             cap_reads *= 2
             continue
         if nr < 0:
-            raise ValueError(f"bam_decode error {nr}")
+            raise ValueError(f"bam_decode: {_bam_err(nr)}")
         ns = int(n_segs.value)
         out = {k: v[: (ns if k.startswith("seg_") else nr)]
                for k, v in a.items()}

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.dtypes import preferred_float
+
 SLOTS = 70
 SLOTS_MID = 2.0 / 3.0
 MAX_CN = 8.0
@@ -131,7 +133,11 @@ def get_cn(depths: jax.Array, valid: jax.Array, ploidy: int = PLOIDY
         vals = jnp.sort(jnp.where(nz, d, jnp.inf))
         base = jnp.where(p_lo > 0.3, lows, 0)
         m = k - base
-        idx = base + (m.astype(jnp.float32) * 0.4).astype(jnp.int32)
+        # reference index: int(float64(m)*0.4) — exactly (2m)//5 for every
+        # representable m (0.4 rounds up in binary, so the product can only
+        # sit just above an exact multiple), computed in integers so TPU
+        # (no f64) matches the f64 semantics bit-for-bit
+        idx = base + (m * 2) // 5
         med = jnp.where(
             m > 0,
             jnp.float32(ploidy) * vals[jnp.clip(idx, 0, d.shape[0] - 1)],
@@ -167,6 +173,11 @@ def normalize_across_samples(
     pad = jnp.zeros((n_samples, 3), raw.dtype)
     raw_p = jnp.concatenate([raw, pad], axis=1)
 
+    # the reference accumulates the neighborhood mean in float64
+    # (indexcov.go:560-581); honor that wherever the backend has f64
+    # (CPU/x64 — where bit-parity is tested), degrading to f32 on TPU
+    acc_t = preferred_float()
+
     def step(carry, j):
         prev3 = carry  # (n_samples, 3): processed j-3, j-2, j-1
         col = raw[:, j]
@@ -174,15 +185,18 @@ def normalize_across_samples(
         valid_jm1 = (j > 0) & valid_j  # len > j implies len > j-1
         valid_jp1 = lengths - 1 > j
         m_sum = (
-            jnp.where(valid_j, col, 0.0).sum()
-            + jnp.where(valid_jm1, prev3[:, 2], 0.0).sum()
-            + jnp.where(valid_jp1, raw_p[:, j + 1], 0.0).sum()
+            jnp.where(valid_j, col, 0.0).astype(acc_t).sum()
+            + jnp.where(valid_jm1, prev3[:, 2], 0.0).astype(acc_t).sum()
+            + jnp.where(valid_jp1, raw_p[:, j + 1], 0.0).astype(acc_t).sum()
         )
         n = (
             valid_j.sum() + valid_jm1.sum() + valid_jp1.sum()
-        ).astype(jnp.float32)
-        m = m_sum / jnp.maximum(n, 1.0)
-        skip = (n.astype(jnp.int32) < 3 * n_samples - 4) | (m < 0.1)
+        ).astype(acc_t)
+        m_acc = m_sum / jnp.maximum(n, 1.0)
+        # skip test happens on the f64 mean (indexcov.go:581-584); the
+        # divisions below use float32(m) like the reference
+        skip = (n.astype(jnp.int32) < 3 * n_samples - 4) | (m_acc < 0.1)
+        m = m_acc.astype(raw.dtype)
 
         scaled = jnp.where(valid_j, col / m, col)
         do_smooth = valid_j & (j > 2) & (j < lengths - 3)
