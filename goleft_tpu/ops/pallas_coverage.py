@@ -133,9 +133,13 @@ def bucket_endpoints(seg_start: np.ndarray, seg_end: np.ndarray,
         raise ValueError(f"p_cap {p_cap} < densest tile {max_n}")
     st = np.full((n_tiles, p_cap), SENTINEL, dtype=np.int32)
     et = np.full((n_tiles, p_cap), SENTINEL, dtype=np.int32)
-    for t in range(n_tiles):
-        a, b = s_off[t], s_off[t + 1]
-        st[t, : b - a] = ss[a:b]
-        a, b = e_off[t], e_off[t + 1]
-        et[t, : b - a] = ee[a:b]
+    # vectorized scatter: each sorted endpoint's tile is value//TILE and
+    # its slot is its rank within the tile (position minus the tile's
+    # searchsorted offset) — no per-tile Python loop
+    if len(ss):
+        qs = ss // TILE
+        st[qs, np.arange(len(ss)) - s_off[qs]] = ss
+    if len(ee):
+        qe = ee // TILE
+        et[qe, np.arange(len(ee)) - e_off[qe]] = ee
     return st, et, n_tiles

@@ -157,3 +157,37 @@ def test_file_key(tmp_path):
     p.write_text("hello")
     k1 = file_key(str(p))
     assert k1[1] == 5
+
+
+def test_run_sharded_bounded_in_flight():
+    """No more than max_in_flight shards are ever submitted ahead of the
+    consumer, so unconsumed results can't pile up (VERDICT weak #5)."""
+    import threading
+
+    started = []
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            started.append(i)
+        return i * i
+
+    tasks = [(i,) for i in range(20)]
+    gen = run_sharded(tasks, work, processes=2, max_in_flight=3)
+    consumed = 0
+    for res in gen:
+        assert res.error is None
+        # everything submitted so far is bounded by consumed + window
+        # (+1 for the head the generator just handed over)
+        with lock:
+            n_started = len(started)
+        assert n_started <= consumed + 3 + 1, (n_started, consumed)
+        consumed += 1
+    assert consumed == 20
+    assert sorted(started) == list(range(20))
+
+
+def test_run_sharded_unordered_bounded():
+    out = list(run_sharded([(i,) for i in range(17)], lambda i: i + 1,
+                           processes=3, ordered=False, max_in_flight=2))
+    assert sorted(r.value for r in out) == list(range(1, 18))
