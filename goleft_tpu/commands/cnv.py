@@ -13,29 +13,42 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .cohortdepth import run_cohortdepth
-from .emdepth_cmd import run_emdepth
+import numpy as np
+
+from ..utils.dtypes import preferred_float
+from .cohortdepth import cohort_matrix_blocks
+from .emdepth_cmd import call_cnvs
 
 
 def run_cnv(bams, reference=None, fai=None, window: int = 1000,
             mapq: int = 1, chrom: str = "", processes: int = 8,
             out=None, matrix_out=None):
     out = out or sys.stdout
-    import os
-    import tempfile
-
-    # stream the matrix straight to a temp TSV (one resident copy, not a
-    # StringIO + file round-trip)
-    with tempfile.NamedTemporaryFile("w", suffix=".tsv",
-                                     delete=False) as tf:
-        run_cohortdepth(bams, reference=reference, fai=fai,
-                        window=window, mapq=mapq, chrom=chrom,
-                        processes=processes, out=tf)
-        path = tf.name
-    try:
-        return run_emdepth(path, out=out, matrix_out=matrix_out)
-    finally:
-        os.unlink(path)
+    names, n_win, blocks = cohort_matrix_blocks(
+        bams, reference=reference, fai=fai, window=window, mapq=mapq,
+        chrom=chrom, processes=processes,
+    )
+    if n_win == 0:
+        return []
+    # stream blocks into ONE preallocated matrix — the EM needs the
+    # global per-sample median so the matrix materializes once, but as
+    # numbers, not ASCII (round 1 wrote a temp TSV and re-parsed it),
+    # and each device block is dropped as soon as it's copied in
+    depths = np.empty((n_win, len(names)), dtype=preferred_float())
+    starts = np.empty(n_win, dtype=np.int64)
+    ends = np.empty(n_win, dtype=np.int64)
+    chroms = np.empty(n_win, dtype=object)
+    row = 0
+    for c, st, en, v in blocks:
+        k = len(st)
+        chroms[row : row + k] = c
+        starts[row : row + k] = st
+        ends[row : row + k] = en
+        depths[row : row + k] = v.T  # (n_windows, samples)
+        row += k
+    assert row == n_win, (row, n_win)
+    return call_cnvs(chroms, starts, ends, depths, names, out=out,
+                     matrix_out=matrix_out)
 
 
 def main(argv=None):

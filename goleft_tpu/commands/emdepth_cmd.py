@@ -63,8 +63,16 @@ def _batched_em(depths: np.ndarray):
 
 def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
                 matrix_out: str | None = None):
+    return call_cnvs(*read_matrix(matrix_path), out=out,
+                     normalize=normalize, matrix_out=matrix_out)
+
+
+def call_cnvs(chroms, starts, ends, depths, samples, out=None,
+              normalize: bool = True, matrix_out: str | None = None):
+    """EM copy-number calls from in-memory matrix arrays (the device
+    pipeline's native feed — ``cnv`` passes cohortdepth's blocks here
+    directly, no text round-trip)."""
     out = out or sys.stdout
-    chroms, starts, ends, depths, samples = read_matrix(matrix_path)
     if len(depths) == 0:
         return
     if normalize:

@@ -27,16 +27,24 @@ def build_cohort_step(mesh: Mesh, shard_len: int, window: int):
     def step(seg_s, seg_e, keep):
         depth, wsums = coverage(seg_s, seg_e, keep)
         wmeans = wsums / window  # (S, n_win)
-        # per-sample scaling (indexcov-style mean-normalization; medians
-        # stay in the host indexcov path where int64 exactness matters)
-        scale = jnp.maximum(wmeans.mean(axis=1, keepdims=True), 1e-6)
-        scaled = wmeans / scale
+        # The SHIPPING normalization — identical to what `cnv` runs
+        # (commands/emdepth_cmd.py::call_cnvs, per the emdepth contract
+        # that inputs are pre-normalized comparable depths,
+        # emdepth/emdepth.go:117-138): round-half-up integer window means
+        # (the depthwed matrix values), each sample scaled to its global
+        # median, rescaled by the cohort median-of-medians. The genome
+        # axis is sharded, so the medians are cross-shard reductions XLA
+        # lowers onto ICI.
+        vals = jnp.floor(wmeans + 0.5)
+        med = jnp.median(vals, axis=1)  # per-sample global median
+        med = jnp.where(med == 0, 1.0, med)
+        scaled = vals / med[:, None] * jnp.median(med)
         # reshard: EM wants (windows, samples) with windows on 'seq'
         wm = jax.lax.with_sharding_constraint(
             scaled.T, NamedSharding(mesh, P("seq", "data"))
         )
-        lambdas = em_depth_batch(wm * 30.0)  # EM at ~30x pseudo-depth
-        cn = cn_batch(lambdas, wm * 30.0)
+        lambdas = em_depth_batch(wm)
+        cn = cn_batch(lambdas, wm)
         return {
             "depth": depth,
             "wmeans": wmeans,

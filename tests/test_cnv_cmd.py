@@ -51,3 +51,40 @@ def test_cnv_finds_planted_deletion(tmp_path):
         f"p{i}" for i in range(8)
     )
     assert len(rows) == ref_len // 2000 + 1
+
+
+def test_cnv_array_path_matches_text_path(tmp_path):
+    """cnv's on-device/in-memory matrix path is byte-identical to the
+    round-1 cohortdepth→TSV→emdepth text pipeline it replaced."""
+    from goleft_tpu.commands.cohortdepth import run_cohortdepth
+    from goleft_tpu.commands.emdepth_cmd import run_emdepth
+
+    rng = np.random.default_rng(7)
+    ref_len = 60_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(6):
+        starts = np.sort(rng.integers(0, ref_len - 100, size=2500))
+        if i == 2:
+            keep = ~((starts >= 20_000) & (starts < 30_000)
+                     & (rng.random(len(starts)) < 0.6))
+            starts = starts[keep]
+        reads = [(0, int(s), "100M", 60, 0) for s in starts]
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:q{i}\n")
+        p = str(tmp_path / f"q{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,), header_text=hdr)
+        bams.append(p)
+
+    tsv = str(tmp_path / "m.tsv")
+    with open(tsv, "w") as fh:
+        run_cohortdepth(bams, reference=fa, window=1000, out=fh)
+    text_out = io.StringIO()
+    run_emdepth(tsv, out=text_out)
+
+    arr_out = io.StringIO()
+    run_cnv(bams, reference=fa, window=1000, out=arr_out)
+    assert arr_out.getvalue() == text_out.getvalue()
+    assert len(arr_out.getvalue().splitlines()) > 1

@@ -1,0 +1,59 @@
+"""Fused cohort step: numeric parity with the shipping (host) pipeline.
+
+Round-1 VERDICT weak #2: the fused step used mean-normalization and a
+hard-coded 30x pseudo-depth. It now runs the same normalization as
+`cnv`/`call_cnvs` (integer round-half-up window means, per-sample global
+median scaling, cohort median-of-medians rescale) — this test pins the
+fused device program's lambdas/CN against the host emdepth path fed the
+identically-normalized matrix.
+"""
+
+import numpy as np
+
+from goleft_tpu.models import emdepth as em
+from goleft_tpu.parallel.cohort_pipeline import build_cohort_step
+from goleft_tpu.parallel.mesh import make_mesh
+from goleft_tpu.parallel.sharded_coverage import partition_segments
+
+
+def test_fused_step_matches_host_normalize_and_em():
+    rng = np.random.default_rng(4)
+    n_seq = 4
+    shard_len, window = 2048, 256
+    L = n_seq * shard_len
+    S = 8
+    n = 3000
+    starts = np.sort(rng.integers(0, L - 150, size=(S, n))).astype(np.int32)
+    ends = (starts + 150).astype(np.int32)
+    # plant a deletion-like dropout in sample 5
+    keep = np.ones((S, n), dtype=bool)
+    mid = (starts[5] > L // 3) & (starts[5] < L // 2)
+    keep[5] = ~(mid & (rng.random(n) < 0.6))
+
+    mesh = make_mesh(8, prefer_seq=n_seq)
+    step = build_cohort_step(mesh, shard_len, window)
+    seg_s, seg_e, kp = partition_segments(starts, ends, keep, n_seq,
+                                          shard_len)
+    out = step(seg_s, seg_e, kp)
+
+    # host reference: same rounding + normalization, host-chunked EM
+    depth = np.zeros((S, L), dtype=np.int64)
+    for b in range(S):
+        for s, e in zip(starts[b][keep[b]], ends[b][keep[b]]):
+            depth[b, s:min(e, L)] += 1
+    wmeans = depth.reshape(S, -1, window).mean(axis=2)
+    np.testing.assert_allclose(np.asarray(out["wmeans"]), wmeans,
+                               rtol=1e-6)
+    vals = np.floor(wmeans + 0.5)
+    med = np.median(vals, axis=1)
+    med[med == 0] = 1.0
+    scaled = vals / med[:, None] * np.median(med)
+    wm = scaled.T  # (windows, samples)
+    lam_host = np.asarray(em.em_depth_batch(wm))
+    cn_host = np.asarray(em.cn_batch(lam_host, wm))
+    np.testing.assert_allclose(np.asarray(out["lambdas"]), lam_host,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out["cn"]), cn_host)
+    # the planted dropout shows up as CN < 2 for sample 5
+    win_lo, win_hi = (L // 3) // window + 1, (L // 2) // window - 1
+    assert np.median(cn_host[win_lo:win_hi, 5]) < 2
