@@ -1,32 +1,35 @@
-"""Benchmark: windowed-depth throughput on the real chip.
+"""Benchmark: end-to-end cohort depth throughput (the product metric).
 
 Prints ONE JSON line:
-  {"metric": "depth_gbases_per_sec_per_chip", "value": N, "unit":
+  {"metric": "cohort_depth_e2e_gbases_per_sec", "value": N, "unit":
    "Gbases/s", "vs_baseline": N, ...}
 
-The workload mirrors BASELINE.md config 1/2 (30x coverage, 250bp
-windows, MAPQ filter): a 10Mb genome shard at 30x (150bp reads → ~2M
-aligned segments) through the fused device pipeline
-(scatter-add → cumsum → window sums + callable classes), steady-state
-over several iterations with fresh segment data each run.
+The headline is the FULL cohortdepth CLI path on fabricated BAMs at
+BASELINE.md config-3 scale (50-sample low-pass cohort → sites × samples
+matrix): file open + BAI load + fused C++ decode/window-reduce +
+matrix formatting, warm wall-clock, with a stage-time breakdown in
+BENCH_details.json. The design fact this measures: per-read data never
+crosses the host↔device link — the host reduces reads to window sums
+(hierarchical reduction) and the device consumes only (windows ×
+samples) matrices for the cohort math, so e2e throughput is
+link-bandwidth-independent.
 
-vs_baseline is measured on the same machine against the single-core
-numpy equivalent of the per-base pipeline — the honest stand-in for the
-reference's CPU path (samtools text decode + Go windower,
-depth/depth.go:282-325), which cannot run here. The reference's true
-text pipeline is strictly slower than the numpy vector version, so the
-reported speedup is a lower bound.
+vs_baseline compares against the single-core numpy equivalent of the
+windowing math charged NO decode work — strictly more generous than the
+reference's real CPU path (samtools text decode + Go windower,
+depth/depth.go:282-325), so the reported speedup is a lower bound.
 
-``--suite`` additionally times the cohort-scale workloads from
-BASELINE.md configs 3-5 (indexcov normalization over 500 synthetic
-index-size arrays, batched EM over a 2504-sample depth matrix) and
-writes them to BENCH_details.json (stdout still carries exactly one
-line).
+The device-resident kernel rate and the segment-path e2e (including
+host→device transfer of packed endpoints) are reported alongside in
+``config`` — on hosts with real PCIe (not this dev tunnel) the segment
+path is how the multi-chip mesh is fed.
 
-``--cohort`` runs the end-to-end many-BAM cohort benchmark (fabricated
-BAMs → cohortdepth matrix, cold and warm wall-clock).
+``--suite`` additionally times BASELINE.md configs 4-5 (indexcov
+normalization over cohort index-size arrays, batched EM over a
+2504-sample matrix) into BENCH_details.json (stdout still carries
+exactly one line).
 
-Usage: python bench.py [--quick] [--suite] [--cohort]
+Usage: python bench.py [--quick] [--suite]
 """
 
 from __future__ import annotations
@@ -130,24 +133,33 @@ def bench_suite(quick: bool) -> dict:
     return out
 
 
-def bench_cohort(n_samples: int = 100) -> dict:
-    """End-to-end 100-BAM cohort wall-clock (BASELINE.md speedup target):
-    fabricate one ~3x BAM, replicate it n_samples times, run cohortdepth
-    (decode + device-batched depth matrix) and compare against the
-    numpy-equivalent per-sample loop."""
+def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
+                 coverage: int = 4) -> dict:
+    """End-to-end cohort wall-clock (BASELINE.md config 3: 50-sample
+    low-pass cohort → sites × samples matrix): fabricate one BAM,
+    replicate it n_samples times, run the full cohortdepth CLI path
+    (open + BAI load + fused C++ decode/window-reduce + matrix
+    formatting) with a stage-time breakdown, and compare against the
+    single-core numpy kernel (which is charged NO decode work — a
+    baseline strictly more generous than the reference's samtools-text
+    path)."""
+    import io as _io
     import shutil
     import tempfile
     import time as _t
 
-    from goleft_tpu.commands.cohortdepth import run_cohortdepth
+    from goleft_tpu.commands.cohortdepth import (
+        cohort_matrix_blocks, run_cohortdepth,
+    )
+    from goleft_tpu.io import native
     from goleft_tpu.io.bam import BamWriter
     from goleft_tpu.io.bai import build_bai, write_bai
 
-    ref_len = 2_000_000
-    n_reads = ref_len * 3 // 100  # ~3x at 100bp
+    read_len = 100
+    n_reads = ref_len * coverage // read_len
     d = tempfile.mkdtemp(prefix="goleft_cohort_")
     rng = np.random.default_rng(0)
-    starts = np.sort(rng.integers(0, ref_len - 100, size=n_reads))
+    starts = np.sort(rng.integers(0, ref_len - read_len, size=n_reads))
     base = f"{d}/s000.bam"
     with open(base, "wb") as fh:
         with BamWriter(
@@ -156,12 +168,12 @@ def bench_cohort(n_samples: int = 100) -> dict:
             level=1,
         ) as w:
             for i, s in enumerate(starts):
-                w.write_record(0, int(s), [(100, 0)], mapq=60,
+                w.write_record(0, int(s), [(read_len, 0)], mapq=60,
                                name=f"r{i}")
     write_bai(build_bai(base), base + ".bai")
     # hand-crafted .fai declaring the full contig length; the stub fasta
     # is never read (cohortdepth only needs lengths) and deliberately is
-    # NOT a real 2Mbp sequence — do not regenerate the .fai from it
+    # NOT a real sequence — do not regenerate the .fai from it
     with open(f"{d}/ref.fa", "w") as fh:
         fh.write(">chr1\n" + "A" * 60 + "\n")
     with open(f"{d}/ref.fa.fai", "w") as fh:
@@ -177,35 +189,56 @@ def bench_cohort(n_samples: int = 100) -> dict:
         def write(self, *_):
             pass
 
+    fai = f"{d}/ref.fa.fai"
     t0 = _t.perf_counter()
-    run_cohortdepth(bams, fai=f"{d}/ref.fa.fai", window=500,
-                    out=_Null())
+    run_cohortdepth(bams, fai=fai, window=500, out=_Null())
     cold = _t.perf_counter() - t0
-    # second run: XLA compile cache warm — the steady-state number a
-    # many-shard whole-genome run amortizes to
+    # steady state (caches warm — what a whole-genome run amortizes to)
     t0 = _t.perf_counter()
-    run_cohortdepth(bams, fai=f"{d}/ref.fa.fai", window=500,
-                    out=_Null())
+    run_cohortdepth(bams, fai=fai, window=500, out=_Null())
     wall = _t.perf_counter() - t0
 
-    # numpy per-sample equivalent of the device math (decode excluded on
-    # both sides would favor numpy; include one decode-free numpy pass
-    # per sample for the kernel comparison)
+    # stage breakdown: open+index, fused decode+reduce, formatting
+    t0 = _t.perf_counter()
+    names, _, blocks = cohort_matrix_blocks(bams, fai=fai, window=500)
+    t_load = _t.perf_counter() - t0
+    kept = []
+    t0 = _t.perf_counter()
+    for blk in blocks:
+        kept.append(blk)
+    t_reduce = _t.perf_counter() - t0
+    t0 = _t.perf_counter()
+    if native.get_lib() is not None:
+        for c, st, en, vals in kept:
+            native.format_matrix_rows(c, st, en, vals)
+    t_format = _t.perf_counter() - t0
+
+    # numpy per-sample equivalent of the windowing math, decode-free
     seg_s = starts.astype(np.int32)
-    seg_e = (seg_s + 100).astype(np.int32)
+    seg_e = (seg_s + read_len).astype(np.int32)
     keep = np.ones(len(seg_s), bool)
     t0 = _t.perf_counter()
     numpy_pipeline(seg_s, seg_e, keep, ref_len, 500)
     np_one = _t.perf_counter() - t0
     shutil.rmtree(d, ignore_errors=True)
+    gbases = n_samples * ref_len / 1e9
     return {
-        "samples": n_samples, "ref_bp": ref_len,
-        "wall_seconds_warm": round(wall, 2),
-        "wall_seconds_cold": round(cold, 2),
-        "gbases_per_sec": round(n_samples * ref_len / wall / 1e9, 4),
+        "samples": n_samples, "ref_bp": ref_len, "coverage": coverage,
+        "wall_seconds_warm": round(wall, 3),
+        "wall_seconds_cold": round(cold, 3),
+        "gbases_per_sec": round(gbases / wall, 4),
+        "stage_seconds": {
+            "open_and_index": round(t_load, 3),
+            "decode_window_reduce": round(t_reduce, 3),
+            "format_matrix": round(t_format, 3),
+        },
         "numpy_kernel_only_seconds": round(np_one * n_samples, 2),
-        "note": "end-to-end incl. host decode + matrix write; cold "
-                "includes one-time XLA compiles",
+        "numpy_kernel_gbases_per_sec": round(
+            gbases / (np_one * n_samples), 4
+        ),
+        "note": "end-to-end incl. open, BAI load, fused C++ "
+                "decode+window-reduce, matrix formatting; numpy baseline "
+                "is charged no decode work (generous)",
     }
 
 
@@ -249,13 +282,42 @@ def main(argv=None):
     dt = time.perf_counter() - t0
     gbps = length * iters / dt / 1e9
 
-    # end-to-end including fresh host→device transfer each iteration
+    # segment-path e2e, unpacked wire (9 bytes/segment): fresh
+    # host→device transfer + compute each iteration
     t0 = time.perf_counter()
     for i in range(iters):
         out = run(works[(i % iters) + 1])
     jax.block_until_ready(out)
     e2e_dt = time.perf_counter() - t0
     e2e_gbps = length * iters / e2e_dt / 1e9
+
+    # segment-path e2e, packed wire (u16 delta+length, 4 bytes/segment):
+    # host packing + transfer + compute — wins when host cores outnumber
+    # the link, loses on a single-core host with a fast link
+    from goleft_tpu.ops.coverage import bucket_size, pack_segments_u16
+    from goleft_tpu.ops.depth_pipeline import shard_depth_pipeline_packed
+
+    def run_packed(w):
+        seg_s, seg_e, keep = w
+        d, l, base, n_ent = pack_segments_u16(seg_s, seg_e, keep)
+        b = bucket_size(max(n_ent, 1))
+        dd = np.zeros(b, np.uint16)
+        ll = np.zeros(b, np.uint16)
+        dd[:n_ent] = d
+        ll[:n_ent] = l
+        return shard_depth_pipeline_packed(
+            dd, ll, base, np.int32(0), np.int32(0), np.int32(length),
+            np.int32(2500), np.int32(4), np.int32(0),
+            length=length, window=window,
+        )
+
+    jax.block_until_ready(run_packed(works[0]))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = run_packed(works[(i % iters) + 1])
+    jax.block_until_ready(out)
+    packed_dt = time.perf_counter() - t0
+    packed_gbps = length * iters / packed_dt / 1e9
 
     # single-core numpy baseline (1 iteration is enough; it's slow)
     seg_s, seg_e, keep = works[0]
@@ -264,11 +326,17 @@ def main(argv=None):
     np_dt = time.perf_counter() - t0
     np_gbps = length / np_dt / 1e9
 
-    details = {}
+    # the headline number IS the end-to-end product path (round-1
+    # VERDICT: the kernel rate is not the product) — BASELINE config-3
+    # scale by default, a small cohort with --quick
+    if quick:
+        cohort = bench_cohort(20, 2_000_000, 3)
+    else:
+        cohort = bench_cohort(50, 10_000_000, 4)
+
+    details = {"cohort_e2e": cohort}
     if "--suite" in argv:
-        details = bench_suite(quick)
-    if "--cohort" in argv:
-        details["cohort_e2e"] = bench_cohort(20 if quick else 100)
+        details.update(bench_suite(quick))
     if details:
         # merge with any existing entries so --cohort alone doesn't wipe
         # --suite results (and vice versa)
@@ -286,21 +354,33 @@ def main(argv=None):
 
     dev = jax.devices()[0]
     print(json.dumps({
-        "metric": "depth_gbases_per_sec_per_chip",
-        "value": round(gbps, 4),
+        "metric": "cohort_depth_e2e_gbases_per_sec",
+        "value": cohort["gbases_per_sec"],
         "unit": "Gbases/s",
-        "vs_baseline": round(gbps / np_gbps, 2),
+        "vs_baseline": round(
+            cohort["gbases_per_sec"]
+            / cohort["numpy_kernel_gbases_per_sec"], 2
+        ),
         "baseline": {
-            "what": "single-core numpy scatter+cumsum+window pipeline "
-                    "(lower bound on speedup vs reference's samtools-"
-                    "text path)",
-            "gbases_per_sec": round(np_gbps, 4),
+            "what": "single-core numpy scatter+cumsum+window pipeline, "
+                    "charged NO decode work (strictly more generous "
+                    "than the reference's samtools-text path); ours "
+                    "includes open+decode+reduce+format end to end",
+            "gbases_per_sec": cohort["numpy_kernel_gbases_per_sec"],
         },
         "config": {
-            "shard_bp": length, "window": window, "coverage": coverage,
-            "read_len": read_len, "iters": iters,
+            "cohort": {k: cohort[k] for k in
+                       ("samples", "ref_bp", "coverage",
+                        "wall_seconds_warm", "stage_seconds")},
+            "window": window,
             "device": str(dev), "platform": dev.platform,
-            "e2e_gbases_per_sec_incl_transfer": round(e2e_gbps, 4),
+            "kernel_device_resident_gbases_per_sec": round(gbps, 4),
+            "kernel_e2e_incl_transfer_gbases_per_sec": round(e2e_gbps, 4),
+            "kernel_e2e_packed_wire_gbases_per_sec": round(
+                packed_gbps, 4
+            ),
+            "kernel_shard_bp": length, "kernel_coverage": coverage,
+            "kernel_read_len": read_len, "kernel_iters": iters,
         },
     }))
 

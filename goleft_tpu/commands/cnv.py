@@ -22,11 +22,11 @@ from .emdepth_cmd import call_cnvs
 
 def run_cnv(bams, reference=None, fai=None, window: int = 1000,
             mapq: int = 1, chrom: str = "", processes: int = 8,
-            out=None, matrix_out=None):
+            out=None, matrix_out=None, engine: str = "auto"):
     out = out or sys.stdout
     names, n_win, blocks = cohort_matrix_blocks(
         bams, reference=reference, fai=fai, window=window, mapq=mapq,
-        chrom=chrom, processes=processes,
+        chrom=chrom, processes=processes, engine=engine,
     )
     if n_win == 0:
         return []

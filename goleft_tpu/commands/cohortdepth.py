@@ -49,6 +49,7 @@ def cohort_matrix_blocks(
     mapq: int = 1,
     chrom: str = "",
     processes: int = 8,
+    engine: str = "auto",
 ):
     """(sample_names, total_windows, block generator) for the cohort
     depth matrix.
@@ -59,9 +60,25 @@ def cohort_matrix_blocks(
     formats them; ``cnv`` consumes the arrays directly (no temp-TSV hop,
     round-1 VERDICT weak #2). ``total_windows`` (the sum of block widths,
     known up front from the regions) lets consumers preallocate.
+
+    ``engine``:
+      - "hybrid" (the "auto" default when the native library is up):
+        fused C++ decode + window reduction per (sample, shard) on
+        GIL-free threads — nothing per-read crosses the host↔device
+        link; the device consumes only the resulting (windows × samples)
+        matrix for the cohort math downstream. This hierarchical
+        reduction makes cohort e2e link-bandwidth-independent.
+      - "device": ship segment endpoints and run the vmapped
+        scatter+cumsum pipeline on the chip (the multi-chip sample-
+        sharded path; also the fallback without native io).
+    The engines produce identical matrices (tested) whenever
+    window × depth_cap < 2**24 — the device path sums windows in f32
+    (exact ints below 2**24; see depth_pipeline), the hybrid path in
+    int64. Beyond that bound the hybrid values are the exact ones.
     """
     import concurrent.futures as cf
     import os
+    import threading
 
     handles = []
     bais = []
@@ -103,6 +120,15 @@ def cohort_matrix_blocks(
     ]
     S = len(handles)
 
+    if engine == "auto":
+        engine = "hybrid" if all(
+            getattr(h, "native", False) for h in handles
+        ) else "device"
+    if engine == "hybrid" and not all(
+        getattr(h, "native", False) for h in handles
+    ):
+        raise SystemExit("cohortdepth: engine=hybrid needs the native io")
+
     # multi-chip: shard the sample axis across all devices (data
     # parallelism — XLA partitions the vmapped pipeline, no collectives
     # needed); single chip runs the same code unsharded
@@ -131,6 +157,57 @@ def cohort_matrix_blocks(
             ex.submit(decode, (h, b, tm.get(c, -1), s, e))
             for h, b, tm in zip(handles, bais, tid_maps)
         ]
+
+    # hybrid engine: fused C++ decode+reduce per (sample, region); one
+    # thread-local delta scratch per worker
+    _tl = threading.local()
+
+    def reduce_task(h, bai, tid, s, e, w0, length_r):
+        n_win_r = length_r // window
+        if tid < 0:
+            return np.zeros(n_win_r, np.int64)
+        voff = query_voffset(bai, tid, s)
+        if voff is None:
+            return np.zeros(n_win_r, np.int64)
+        scratch = getattr(_tl, "buf", None)
+        if scratch is None or len(scratch) < length_r + 1:
+            # zeroed by contract; bam_window_reduce re-zeroes on use
+            _tl.buf = scratch = np.zeros(length_r + 1, np.int32)
+        holder = getattr(_tl, "ibuf", None)
+        if holder is None:
+            _tl.ibuf = holder = [None]  # grown by window_reduce
+        return h.window_reduce(
+            tid, s, e, w0, length_r, window, int(cap), mapq, 0x704,
+            voffset=voff, end_voffset=query_voffset(bai, tid, e),
+            delta_scratch=scratch, inflate_buf=holder,
+        )
+
+    def submit_reduces(ex, c, s, e):
+        w0 = s // window * window
+        length_r = ((e - w0) + window - 1) // window * window
+        return [
+            ex.submit(reduce_task, h, b, tm.get(c, -1), s, e, w0,
+                      length_r)
+            for h, b, tm in zip(handles, bais, tid_maps)
+        ]
+
+    def emit_block(c, s, e, sums):
+        """Shared window-mean → round-half-up int conversion: the one
+        place that defines the matrix's values for BOTH engines."""
+        starts, ends, _, _ = window_bounds(s, e, window)
+        spans = (ends - starts).astype(np.float64)
+        means = sums[:, : len(starts)] / spans[None, :]
+        vals = (0.5 + means).astype(np.int64)
+        return c, starts, ends, vals
+
+    def blocks_hybrid():
+        with cf.ThreadPoolExecutor(max_workers=processes) as ex:
+            pending = submit_reduces(ex, *regions[0])
+            for ri, (c, s, e) in enumerate(regions):
+                sums = np.stack([f.result() for f in pending])
+                if ri + 1 < len(regions):
+                    pending = submit_reduces(ex, *regions[ri + 1])
+                yield emit_block(c, s, e, sums)
 
     def blocks():
         with cf.ThreadPoolExecutor(max_workers=processes) as ex:
@@ -162,17 +239,14 @@ def cohort_matrix_blocks(
                     *args, np.int32(w0), np.int32(s),
                     np.int32(e), cap, length, window,
                 ))[:S]
-                starts, ends, _, _ = window_bounds(s, e, window)
-                spans = (ends - starts).astype(np.float64)
-                means = sums[:, : len(starts)] / spans[None, :]
-                vals = (0.5 + means).astype(np.int64)
-                yield c, starts, ends, vals
+                yield emit_block(c, s, e, sums)
 
     total_windows = sum(
         (e - s // window * window + window - 1) // window
         for _, s, e in regions
     )
-    return names, total_windows, blocks()
+    gen = blocks_hybrid() if engine == "hybrid" else blocks()
+    return names, total_windows, gen
 
 
 def run_cohortdepth(
@@ -184,20 +258,28 @@ def run_cohortdepth(
     chrom: str = "",
     processes: int = 8,
     out=None,
+    engine: str = "auto",
 ):
     out = out or sys.stdout
     names, _, blocks = cohort_matrix_blocks(
         bams, reference=reference, fai=fai, window=window, mapq=mapq,
-        chrom=chrom, processes=processes,
+        chrom=chrom, processes=processes, engine=engine,
     )
+    from ..io import native
+
     out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
+    use_native_fmt = native.get_lib() is not None
     for c, starts, ends, vals in blocks:
-        lines = [
-            f"{c}\t{starts[i]}\t{ends[i]}\t"
-            + "\t".join(str(v) for v in vals[:, i]) + "\n"
-            for i in range(len(starts))
-        ]
-        out.write("".join(lines))
+        if use_native_fmt:
+            buf = native.format_matrix_rows(c, starts, ends, vals)
+            out.write(buf.decode("ascii"))
+        else:
+            lines = [
+                f"{c}\t{starts[i]}\t{ends[i]}\t"
+                + "\t".join(str(v) for v in vals[:, i]) + "\n"
+                for i in range(len(starts))
+            ]
+            out.write("".join(lines))
 
 
 def main(argv=None):
@@ -212,11 +294,17 @@ def main(argv=None):
     p.add_argument("-r", "--reference", default=None)
     p.add_argument("--fai", default=None)
     p.add_argument("-p", "--processes", type=int, default=8)
+    p.add_argument("--engine", choices=("auto", "hybrid", "device"),
+                   default="auto",
+                   help="hybrid: fused C++ host reduction (default when "
+                        "native io is available); device: per-read "
+                        "segments to the chip")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
     run_cohortdepth(
         a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
         mapq=a.mapq, chrom=a.chrom, processes=a.processes,
+        engine=a.engine,
     )
 
 

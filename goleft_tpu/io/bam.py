@@ -568,6 +568,67 @@ class BamFile:
                 continue
             u_off += out["consumed"]
 
+    def window_reduce(self, tid: int, start: int, end: int,
+                      w0: int, length: int, window: int,
+                      depth_cap: int, min_mapq: int, flag_mask: int,
+                      voffset: int | None = None,
+                      end_voffset: int | None = None,
+                      delta_scratch=None,
+                      inflate_buf=None) -> np.ndarray:
+        """Host-fused decode + per-window depth sums for one region.
+
+        Returns int64 window sums over [w0, w0+length) — the O(windows)
+        product that crosses to the device, instead of O(reads) segment
+        endpoints (shard_depth_pipeline's exact semantics; see
+        csrc/fastio.cpp::bam_window_reduce). Releases the GIL throughout,
+        so per-sample reductions scale across decode threads.
+
+        ``delta_scratch`` (zeroed int32, reusable) and ``inflate_buf``
+        (a one-element list holder, grown in place) let hot loops avoid
+        re-allocating tens of MB per shard.
+        """
+        from . import native
+
+        if not self.native:
+            raise RuntimeError("window_reduce requires the native library")
+        args = (tid, start, end, w0, length, window, depth_cap,
+                min_mapq, flag_mask)
+        if not self.lazy:
+            offset = self.voffset_to_offset(voffset) \
+                if voffset is not None else self._body_start
+            out = native.bam_window_reduce(
+                self.body, offset, *args, delta_scratch=delta_scratch)
+            return out["wsums"]
+        nb = len(self._co)
+        if voffset is not None:
+            b0 = self._block_of(voffset)
+            in_block = voffset & 0xFFFF
+        else:
+            b0 = 0
+            in_block = self._body_start
+        b1 = nb if end_voffset is None else min(
+            self._block_of(end_voffset) + 4, nb
+        )
+        while True:
+            c0 = int(self._co[b0])
+            c_end = int(self._co[b1]) if b1 < nb else len(self._comp)
+            cap = (int(self._uo[b1]) if b1 < nb else self._total) - int(
+                self._uo[b0]
+            )
+            obuf = None
+            if inflate_buf is not None:
+                if inflate_buf[0] is None or len(inflate_buf[0]) < cap:
+                    inflate_buf[0] = np.empty(max(cap, 1 << 24), np.uint8)
+                obuf = inflate_buf[0]
+            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap,
+                                             out=obuf)
+            out = native.bam_window_reduce(
+                body, in_block, *args, delta_scratch=delta_scratch)
+            mid_stop = in_block + out["consumed"] < len(body)
+            if (out["done"] and mid_stop) or b1 >= nb:
+                return out["wsums"]
+            b1 = min(b1 + max(b1 - b0, 64), nb)
+
     def _read_lazy(self, tid, start, end, voffset, end_voffset):
         from . import native
 

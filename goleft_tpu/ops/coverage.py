@@ -36,6 +36,49 @@ def bucket_size(n: int, minimum: int = 1024) -> int:
     return b
 
 
+def pack_segments_u16(seg_start: np.ndarray, seg_end: np.ndarray,
+                      keep: np.ndarray):
+    """Packed wire format for host→device segment transfer: 4 bytes per
+    segment (u16 start-delta + u16 length) instead of 9 (two i32 + bool).
+
+    Host applies the keep filter and sorts; the device reconstructs
+    absolute endpoints with one cumsum (shard_depth_pipeline_packed).
+    Gaps > 65535 insert filler entries (delta=65535, len=0) and padding
+    is (0, 0) — zero-length entries contribute nothing. Returns
+    (deltas u16, lens u16, base i32, n_entries) — arrays are unpadded;
+    callers bucket-pad with zeros. Falls back to None when any segment
+    is ≥ 65536 bases (ultra-long reads ride the unpacked path).
+    """
+    s = seg_start[keep].astype(np.int64)
+    e = seg_end[keep].astype(np.int64)
+    if len(s) == 0:
+        return (np.zeros(0, np.uint16), np.zeros(0, np.uint16),
+                np.int32(0), 0)
+    order = None
+    if np.any(s[:-1] > s[1:]):
+        order = np.argsort(s, kind="stable")
+        s, e = s[order], e[order]
+    lens = e - s
+    if int(lens.max()) > 0xFFFF:
+        return None
+    base = int(s[0])
+    deltas = np.empty(len(s), np.int64)
+    deltas[0] = 0
+    np.subtract(s[1:], s[:-1], out=deltas[1:])
+    q = deltas // 0xFFFF  # fillers of 65535 each
+    nq = int(q.sum())
+    if nq == 0:
+        return (deltas.astype(np.uint16), lens.astype(np.uint16),
+                np.int32(base), len(s))
+    total = len(s) + nq
+    out_d = np.full(total, 0xFFFF, np.uint16)
+    out_l = np.zeros(total, np.uint16)
+    last = np.cumsum(q + 1) - 1
+    out_d[last] = (deltas % 0xFFFF).astype(np.uint16)
+    out_l[last] = lens.astype(np.uint16)
+    return out_d, out_l, np.int32(base), total
+
+
 @functools.partial(jax.jit, static_argnames=("length",))
 def depth_from_segments(
     seg_start: jax.Array,

@@ -16,26 +16,9 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("length", "window"))
-def shard_depth_pipeline(
-    seg_start: jax.Array,
-    seg_end: jax.Array,
-    keep: jax.Array,
-    w0: jax.Array,
-    region_start: jax.Array,
-    region_end: jax.Array,
-    depth_cap: jax.Array,
-    min_cov: jax.Array,
-    max_mean_depth: jax.Array,
-    length: int,
-    window: int,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (window_sums f64, per-base classes i8, per-base depth i32)
-    over [w0, w0+length); bases outside [region_start, region_end) are
-    zeroed (samtools -r only counts in-region bases).
-
-    length must be a multiple of window and ≥ region_end - w0.
-    """
+def _pipeline_body(seg_start, seg_end, keep, w0, region_start,
+                   region_end, depth_cap, min_cov, max_mean_depth,
+                   length, window):
     s = jnp.clip(jnp.maximum(seg_start, region_start) - w0, 0, length)
     e = jnp.clip(jnp.minimum(seg_end, region_end) - w0, 0, length)
     s = jnp.where(keep, s, length)
@@ -66,3 +49,55 @@ def shard_depth_pipeline(
         ),
     ).astype(jnp.int8)
     return window_sums, cls, depth
+
+
+@functools.partial(jax.jit, static_argnames=("length", "window"))
+def shard_depth_pipeline(
+    seg_start: jax.Array,
+    seg_end: jax.Array,
+    keep: jax.Array,
+    w0: jax.Array,
+    region_start: jax.Array,
+    region_end: jax.Array,
+    depth_cap: jax.Array,
+    min_cov: jax.Array,
+    max_mean_depth: jax.Array,
+    length: int,
+    window: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (window_sums f32, per-base classes i8, per-base depth i32)
+    over [w0, w0+length); bases outside [region_start, region_end) are
+    zeroed (samtools -r only counts in-region bases).
+
+    length must be a multiple of window and ≥ region_end - w0.
+    """
+    return _pipeline_body(seg_start, seg_end, keep, w0, region_start,
+                          region_end, depth_cap, min_cov,
+                          max_mean_depth, length, window)
+
+
+@functools.partial(jax.jit, static_argnames=("length", "window"))
+def shard_depth_pipeline_packed(
+    deltas: jax.Array,
+    lens: jax.Array,
+    base: jax.Array,
+    w0: jax.Array,
+    region_start: jax.Array,
+    region_end: jax.Array,
+    depth_cap: jax.Array,
+    min_cov: jax.Array,
+    max_mean_depth: jax.Array,
+    length: int,
+    window: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Same pipeline fed by the packed u16 wire format (4 bytes/segment
+    instead of 9: sorted start deltas + lengths, see
+    ops/coverage.py::pack_segments_u16) — host→device traffic halves and
+    the absolute endpoints are reconstructed on device with one cumsum.
+    Zero-length entries are padding/gap fillers (keep=False).
+    """
+    seg_start = base + jnp.cumsum(deltas.astype(jnp.int32))
+    lens32 = lens.astype(jnp.int32)
+    return _pipeline_body(seg_start, seg_start + lens32, lens32 > 0,
+                          w0, region_start, region_end, depth_cap,
+                          min_cov, max_mean_depth, length, window)

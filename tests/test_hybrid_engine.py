@@ -1,0 +1,142 @@
+"""Hybrid (C++ host decode+reduce) engine vs the device segment path.
+
+The hybrid engine is the e2e-throughput design: per-read data never
+crosses the host↔device link; only (windows × samples) matrices do.
+These tests pin (a) bam_window_reduce against the jitted
+shard_depth_pipeline on identical decoded segments, and (b) the full
+cohortdepth matrix for engine=hybrid vs engine=device, byte-identical.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import native
+from goleft_tpu.io.bam import BamFile
+from goleft_tpu.commands.cohortdepth import run_cohortdepth
+from goleft_tpu.ops.depth_pipeline import shard_depth_pipeline
+
+from helpers import write_bam_and_bai, write_fasta, random_reads
+from goleft_tpu.io.fai import write_fai
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("rs,re_", [(0, 100_000), (13_777, 61_003)])
+def test_window_reduce_matches_device_pipeline(tmp_path, rs, re_):
+    rng = np.random.default_rng(21)
+    reads = []
+    # mixed CIGARs, mapqs, flags incl. skipped dup/secondary records
+    for s in np.sort(rng.integers(0, 99_000, size=3000)):
+        cig = rng.choice(["100M", "40M20D40M", "30M10N60M", "10S80M",
+                          "50M2I48M"])
+        mq = int(rng.integers(0, 61))
+        fl = int(rng.choice([0, 0, 0, 0x400, 0x100, 0x200]))
+        reads.append((0, int(s), cig, mq, fl))
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(100_000,))
+    bf = BamFile.from_file(p, lazy=True)
+
+    window = 250
+    w0 = rs // window * window
+    length = ((re_ - w0) + window - 1) // window * window
+    mapq_min, flag_mask, cap = 20, 0x704, 2500
+
+    got = bf.window_reduce(0, rs, re_, w0, length, window, cap,
+                           mapq_min, flag_mask)
+
+    cols = bf.read_columns(tid=0, start=rs, end=re_)
+    ok = (cols.mapq >= mapq_min) & ((cols.flag & flag_mask) == 0)
+    keep = ok[cols.seg_read]
+    want = np.asarray(shard_depth_pipeline(
+        cols.seg_start, cols.seg_end, keep,
+        np.int32(w0), np.int32(rs), np.int32(re_),
+        np.int32(cap), np.int32(4), np.int32(0),
+        length=length, window=window,
+    )[0]).astype(np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+def test_cohortdepth_engines_identical(tmp_path):
+    rng = np.random.default_rng(22)
+    ref_len = 80_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(5):
+        reads = random_reads(rng, 2500, 0, ref_len)
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:h{i}\n")
+        p = str(tmp_path / f"h{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,), header_text=hdr)
+        bams.append(p)
+    outs = {}
+    for eng in ("hybrid", "device"):
+        buf = io.StringIO()
+        run_cohortdepth(bams, reference=fa, window=500, out=buf,
+                        engine=eng, mapq=10)
+        outs[eng] = buf.getvalue()
+    assert outs["hybrid"] == outs["device"]
+    assert len(outs["hybrid"].splitlines()) == ref_len // 500 + 1
+
+
+@needs_native
+def test_format_matrix_rows_matches_python():
+    rng = np.random.default_rng(30)
+    n_rows, n_cols = 137, 7
+    starts = np.arange(n_rows, dtype=np.int64) * 500
+    ends = starts + 500
+    vals = rng.integers(0, 10**12, size=(n_cols, n_rows)).astype(np.int64)
+    vals[0, 0] = 0
+    got = native.format_matrix_rows("chr10_random", starts, ends, vals)
+    want = "".join(
+        f"chr10_random\t{starts[i]}\t{ends[i]}\t"
+        + "\t".join(str(v) for v in vals[:, i]) + "\n"
+        for i in range(n_rows)
+    ).encode()
+    assert got == want
+
+
+def test_packed_pipeline_matches_unpacked():
+    """u16 delta+length wire format reconstructs identical results,
+    including >65535 gaps (filler entries) and keep-filtering."""
+    import jax
+    from goleft_tpu.ops.coverage import bucket_size, pack_segments_u16
+    from goleft_tpu.ops.depth_pipeline import (
+        shard_depth_pipeline, shard_depth_pipeline_packed,
+    )
+
+    rng = np.random.default_rng(31)
+    length, window = 1_024_000, 250
+    n = 4000
+    # sparse: forces gaps far beyond 65535
+    s = np.sort(rng.integers(0, length - 200, size=n)).astype(np.int32)
+    e = (s + rng.integers(1, 300, size=n)).astype(np.int32)
+    keep = rng.random(n) < 0.7
+    scalars = (np.int32(0), np.int32(1000), np.int32(length - 777),
+               np.int32(2500), np.int32(4), np.int32(0))
+    b = bucket_size(n)
+    ss = np.zeros(b, np.int32); ee = np.zeros(b, np.int32)
+    kk = np.zeros(b, bool)
+    ss[:n], ee[:n], kk[:n] = s, e, keep
+    want = shard_depth_pipeline(ss, ee, kk, *scalars,
+                                length=length, window=window)
+    d, l, base, n_ent = pack_segments_u16(s, e, keep)
+    assert n_ent >= keep.sum()  # fillers present
+    bp = bucket_size(max(n_ent, 1))
+    dd = np.zeros(bp, np.uint16); ll = np.zeros(bp, np.uint16)
+    dd[:n_ent] = d; ll[:n_ent] = l
+    got = shard_depth_pipeline_packed(dd, ll, base, *scalars,
+                                      length=length, window=window)
+    for g, w, nm in zip(got, want, ("sums", "cls", "depth")):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), nm)
+
+    # ultra-long segment -> packer declines (caller falls back)
+    e2 = e.copy(); e2[5] = s[5] + 100_000
+    assert pack_segments_u16(s, e2, np.ones(n, bool)) is None
