@@ -88,6 +88,8 @@ def cohort_matrix_blocks(
         # lazy mmap-backed handles: residency scales with the shard
         # being decoded, not sum-of-BAM-sizes
         h = open_bam_file(b, lazy=True)
+        if getattr(h, "is_cram", False):
+            return h, None, get_short_name(b)
         bai_p = b + ".bai" if os.path.exists(b + ".bai") else \
             b[:-4] + ".bai"
         return h, read_bai(bai_p), get_short_name(b)
@@ -146,6 +148,8 @@ def cohort_matrix_blocks(
         h, bai, tid, s, e = args
         if tid < 0:
             return ReadColumns.empty()
+        if bai is None:  # CRAM: .crai-driven access inside the handle
+            return h.read_columns(tid=tid, start=s, end=e)
         voff = query_voffset(bai, tid, s)
         if voff is None:
             return ReadColumns.empty()

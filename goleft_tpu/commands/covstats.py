@@ -249,14 +249,18 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
 
         genome_bases = sum(handle.header.ref_lens)
         mapped = 0
-        try:
-            import os
+        # mapped totals come from the .bai; the reference does the same
+        # and only for ".bam" paths (covstats.go:238-249), so CRAM input
+        # reports coverage 0.00 there too — deliberate parity
+        if not getattr(handle, "is_cram", False):
+            try:
+                import os
 
-            bai_path = path + ".bai" if os.path.exists(path + ".bai") \
-                else path[:-4] + ".bai"
-            mapped = read_bai(bai_path).mapped_total
-        except (OSError, ValueError):
-            pass
+                bai_path = path + ".bai" if os.path.exists(path + ".bai") \
+                    else path[:-4] + ".bai"
+                mapped = read_bai(bai_path).mapped_total
+            except (OSError, ValueError):
+                pass
         if regions:
             genome_bases = region_bases(regions)
         coverage = ((1 - st["prop_bad"]) * mapped * st["read_len_mean"]
@@ -284,7 +288,9 @@ def main(argv=None):
     p.add_argument("-r", "--regions", default=None,
                    help="optional bed of target regions")
     p.add_argument("-f", "--fasta", default=None,
-                   help="fasta (reserved for cram support)")
+                   help="reference fasta (accepted for reference-CLI "
+                        "parity; CRAM decode here never reconstructs "
+                        "bases, so it is not required)")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
     run_covstats(a.bams, n=a.n, regions=a.regions)

@@ -78,10 +78,13 @@ def _decode_shard(bam, bai, tid: int, start: int, end: int) -> ReadColumns:
     ``bam`` is an open_bam() handle: the native C++ decoder when
     available (lazy handles inflate only the shard's block range,
     GIL-free), else the pure-Python streaming reader. The BAI linear
-    index bounds the block window on both sides.
+    index bounds the block window on both sides; CRAM handles (bai is
+    None) do their own .crai-driven container selection.
     """
     if tid < 0:
         return ReadColumns.empty()
+    if bai is None:
+        return bam.read_columns(tid=tid, start=start, end=end)
     voff = query_voffset(bai, tid, start)
     if voff is None:
         return ReadColumns.empty()
@@ -209,8 +212,11 @@ def run_depth(
 ) -> tuple[str, str]:
     handle = open_bam_file(bam, lazy=True)
     hdr = handle.header
-    bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
-                   else bam[:-4] + ".bai")
+    if getattr(handle, "is_cram", False):
+        bai = None  # CRAM random access rides the .crai inside the handle
+    else:
+        bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
+                       else bam[:-4] + ".bai")
     fai_path = fai or (reference + ".fai" if reference else None)
     if bed is None:
         if fai_path is None:

@@ -25,7 +25,6 @@ import sys
 import numpy as np
 
 from ..io.bai import read_bai
-from ..io.bam import BamReader
 from ..io.bgzf import BgzfWriter
 from ..io.crai import read_crai
 from ..io.fai import read_fai
@@ -47,6 +46,10 @@ class SampleIndex:
 
     def __init__(self, path: str):
         self.path = path
+        if path.endswith(".cram"):
+            # reference behavior: .cram rides its companion .crai
+            # (indexcov.go:471-525 readIndex on rdr path + ".crai")
+            path = path + ".crai"
         if path.endswith(".crai"):
             self.sizes = read_crai(path).sizes()
             self.mapped = 0
@@ -74,9 +77,9 @@ def get_short_name(path: str) -> str:
     else derived from the filename (indexcov.go:213-246)."""
     if not path.endswith((".crai", ".bai")):
         try:
-            from ..io.bam import read_header_only
+            from ..io.bam import read_alignment_header
 
-            names = read_header_only(path).sample_names()
+            names = read_alignment_header(path).sample_names()
             if len(names) > 1:
                 raise ValueError(f"more than one RG SM for {path}")
             if names:
@@ -107,7 +110,9 @@ def references(
             raise SystemExit(
                 "indexcov: --fai is required when only index files are given"
             )
-        h = BamReader.from_file(path).header
+        from ..io.bam import read_alignment_header
+
+        h = read_alignment_header(path)
         refs = [(i, n, l)
                 for i, (n, l) in enumerate(zip(h.ref_names, h.ref_lens))]
     if chrom:

@@ -84,8 +84,12 @@ def run_multidepth(
     for b in bams:
         blobs.append(open_bam_file(b, lazy=True))
         hdr = blobs[-1].header
-        bai_p = b + ".bai" if os.path.exists(b + ".bai") else b[:-4] + ".bai"
-        bais.append(read_bai(bai_p))
+        if getattr(blobs[-1], "is_cram", False):
+            bais.append(None)  # CRAM region access rides its .crai
+        else:
+            bai_p = b + ".bai" if os.path.exists(b + ".bai") \
+                else b[:-4] + ".bai"
+            bais.append(read_bai(bai_p))
         names.append(get_short_name(b))
         if tid is None:
             if chrom not in hdr.ref_names:

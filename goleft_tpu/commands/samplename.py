@@ -1,23 +1,24 @@
-"""samplename: print unique SM tags from a BAM's @RG header lines.
+"""samplename: print unique SM tags from a BAM/CRAM's @RG header lines.
 
-Reference: samplename/samplename.go:14-68.
+Reference: samplename/samplename.go:14-68 (CRAM accepted like the
+reference's biogo reader handles either container).
 """
 
 from __future__ import annotations
 
 import argparse
 
-from ..io.bam import BamReader
+from ..io.bam import read_alignment_header
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(
         "goleft-tpu samplename",
-        description="report the sample name(s) in a bam file",
+        description="report the sample name(s) in a bam/cram file",
     )
     p.add_argument("bam")
     a = p.parse_args(argv)
-    names = BamReader.from_file(a.bam).header.sample_names()
+    names = read_alignment_header(a.bam).sample_names()
     for n in names:
         print(n)
     if not names:

@@ -212,8 +212,8 @@ class BamReader:
     def __init__(self, data: bytes):
         if data[:4] == b"CRAM":
             raise ValueError(
-                "CRAM decoding is not supported — pass the .crai to "
-                "indexcov/indexsplit, or convert to BAM for depth tools"
+                "BamReader got CRAM bytes — open with io.cram.CramFile "
+                "(open_bam_file routes automatically)"
             )
         self._r = BgzfReader(data)
         magic = self._r.read(4)
@@ -412,8 +412,8 @@ class BamFile:
 
         if bytes(data[:4]) == b"CRAM":
             raise ValueError(
-                "CRAM decoding is not supported — pass the .crai to "
-                "indexcov/indexsplit, or convert to BAM for depth tools"
+                "BamFile got CRAM bytes — open with io.cram.CramFile "
+                "(open_bam_file routes automatically)"
             )
         scan = None
         try:
@@ -599,37 +599,24 @@ class BamFile:
             out = native.bam_window_reduce(
                 self.body, offset, *args, delta_scratch=delta_scratch)
             return out["wsums"]
-        nb = len(self._co)
-        if voffset is not None:
-            b0 = self._block_of(voffset)
-            in_block = voffset & 0xFFFF
-        else:
-            b0 = 0
-            in_block = self._body_start
-        b1 = nb if end_voffset is None else min(
-            self._block_of(end_voffset) + 4, nb
+        out = self._lazy_scan(
+            voffset, end_voffset,
+            lambda body, in_block: native.bam_window_reduce(
+                body, in_block, *args, delta_scratch=delta_scratch),
+            inflate_buf=inflate_buf,
         )
-        while True:
-            c0 = int(self._co[b0])
-            c_end = int(self._co[b1]) if b1 < nb else len(self._comp)
-            cap = (int(self._uo[b1]) if b1 < nb else self._total) - int(
-                self._uo[b0]
-            )
-            obuf = None
-            if inflate_buf is not None:
-                if inflate_buf[0] is None or len(inflate_buf[0]) < cap:
-                    inflate_buf[0] = np.empty(max(cap, 1 << 24), np.uint8)
-                obuf = inflate_buf[0]
-            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap,
-                                             out=obuf)
-            out = native.bam_window_reduce(
-                body, in_block, *args, delta_scratch=delta_scratch)
-            mid_stop = in_block + out["consumed"] < len(body)
-            if (out["done"] and mid_stop) or b1 >= nb:
-                return out["wsums"]
-            b1 = min(b1 + max(b1 - b0, 64), nb)
+        return out["wsums"]
 
-    def _read_lazy(self, tid, start, end, voffset, end_voffset):
+    def _lazy_scan(self, voffset, end_voffset, decode_fn,
+                   inflate_buf=None):
+        """Inflate a BGZF block window and run ``decode_fn(body,
+        in_block)``, growing the window until the decode reports a clean
+        stop. Shared by the columnar and window-reduce lazy paths.
+
+        A stop strictly inside the window is a genuine region break;
+        consuming the whole window is ambiguous (the window may end
+        exactly on a record boundary) — extend to be sure.
+        """
         from . import native
 
         nb = len(self._co)
@@ -648,19 +635,30 @@ class BamFile:
             cap = (int(self._uo[b1]) if b1 < nb else self._total) - int(
                 self._uo[b0]
             )
-            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap)
-            out = native.bam_decode(
-                body, in_block,
-                -1 if tid is None else tid, start,
-                -1 if end is None else end,
-            )
-            # a stop strictly inside the window is a genuine region
-            # break; consuming the whole window is ambiguous (the window
-            # may end exactly on a record boundary) — extend to be sure
+            obuf = None
+            if inflate_buf is not None:
+                if inflate_buf[0] is None or len(inflate_buf[0]) < cap:
+                    inflate_buf[0] = np.empty(max(cap, 1 << 24), np.uint8)
+                obuf = inflate_buf[0]
+            body = native.bgzf_inflate_range(self._comp, c0, c_end, cap,
+                                             out=obuf)
+            out = decode_fn(body, in_block)
             mid_stop = in_block + out["consumed"] < len(body)
             if (out["done"] and mid_stop) or b1 >= nb:
                 return out
             b1 = min(b1 + max(b1 - b0, 64), nb)
+
+    def _read_lazy(self, tid, start, end, voffset, end_voffset):
+        from . import native
+
+        return self._lazy_scan(
+            voffset, end_voffset,
+            lambda body, in_block: native.bam_decode(
+                body, in_block,
+                -1 if tid is None else tid, start,
+                -1 if end is None else end,
+            ),
+        )
 
 
 class _PyBamAdapter:
@@ -723,20 +721,34 @@ def open_bam(data, lazy: bool = False):
     return _PyBamAdapter(data)
 
 
+def read_alignment_header(path: str) -> BamHeader:
+    """Header of a BAM or CRAM file (magic-dispatched)."""
+    with open(path, "rb") as fh:
+        magic = fh.read(4)
+    if magic == b"CRAM":
+        from .cram import CramFile
+
+        return CramFile.from_file(path).header
+    return read_header_only(path)
+
+
 def open_bam_file(path: str, lazy: bool = True):
     """Open from disk; lazy native handles mmap the compressed file so
     host residency stays proportional to the regions actually decoded,
-    not the file (or its ~4x inflated body)."""
+    not the file (or its ~4x inflated body). CRAM files route to the
+    clean-room CRAM 3.0 decoder (io/cram.py), which presents the same
+    read_columns/stream_columns surface."""
     from . import native
 
     with open(path, "rb") as fh:
         magic = fh.read(4)
     if magic == b"CRAM":
-        raise SystemExit(
-            f"{path}: CRAM decoding is not supported — for index-based "
-            "coverage QC pass the .crai to indexcov/indexsplit, or "
-            "convert to BAM for the depth tools"
-        )
+        from .cram import CramFile
+
+        try:
+            return CramFile.from_file(path)
+        except ValueError as e:
+            raise SystemExit(f"{path}: CRAM open failed: {e}") from e
     if lazy and native.get_lib() is not None:
         try:
             return BamFile.from_file(path, lazy=True)

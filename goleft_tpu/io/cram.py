@@ -1,0 +1,1482 @@
+"""CRAM 3.0 container/slice codec, clean-room from the CRAM specification.
+
+Round 1 refused CRAM alignment decode (the reference accepts CRAM
+everywhere via samtools/biogo: covstats/covstats.go:229 smoove
+shared.NewReader, depth/depth.go:45 samtools, indexcov/indexcov.go:359-371
+CRAM headers). This module decodes CRAM 3.0 records into the same
+columnar ``ReadColumns`` feed the BAM path produces, so depth / covstats
+/ cohortdepth accept .cram inputs.
+
+Scope (everything the depth tools need):
+  - file definition, containers, blocks (raw/gzip/bzip2/lzma/rANS-4x8)
+  - compression header: preservation map (RN/AP/RR/SM/TD), data-series
+    and tag encoding maps
+  - codecs: EXTERNAL, HUFFMAN (canonical, incl. the common 0-bit
+    single-symbol case), BETA, GAMMA, BYTE_ARRAY_LEN, BYTE_ARRAY_STOP
+  - slice decode: BF/CF/RI/RL/AP(delta)/RG/RN/mate/TL+tags/features/
+    MQ/QS with ref-span reconstruction from features (S/I/i/D/N/H/P)
+  - .crai-driven random access (container offsets per region)
+
+Bases themselves are not reconstructed (depth counts alignment spans,
+never sequence), so reference-based decoding (RR) only needs feature
+bookkeeping — no FASTA round trip. A fixture writer (CramWriter) and a
+rANS-4x8 order-0 encoder live alongside so the test suite can fabricate
+hermetic .cram files and round-trip the decoder without copying any
+reference test data.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+CRAM_MAGIC = b"CRAM"
+
+# block compression methods
+M_RAW, M_GZIP, M_BZIP2, M_LZMA, M_RANS = 0, 1, 2, 3, 4
+# block content types
+CT_FILE_HEADER, CT_COMP_HEADER, CT_SLICE_HEADER = 0, 1, 2
+CT_EXTERNAL, CT_CORE = 4, 5
+
+# CRAM record flags (CF)
+CF_QS_STORED = 0x1
+CF_DETACHED = 0x2
+CF_MATE_DOWNSTREAM = 0x4
+CF_NO_SEQ = 0x8
+
+# BAM flag bits reconstructed from MF
+MF_MATE_REVERSE = 0x1
+MF_MATE_UNMAPPED = 0x2
+BAM_MREVERSE = 0x20
+BAM_MUNMAP = 0x8
+
+
+# ---------------------------------------------------------------- itf8
+
+def read_itf8(buf: memoryview, pos: int) -> tuple[int, int]:
+    b0 = buf[pos]
+    if b0 < 0x80:
+        return b0, pos + 1
+    if b0 < 0xC0:
+        return ((b0 & 0x7F) << 8) | buf[pos + 1], pos + 2
+    if b0 < 0xE0:
+        return ((b0 & 0x3F) << 16) | (buf[pos + 1] << 8) | buf[pos + 2], \
+            pos + 3
+    if b0 < 0xF0:
+        return ((b0 & 0x1F) << 24) | (buf[pos + 1] << 16) | \
+            (buf[pos + 2] << 8) | buf[pos + 3], pos + 4
+    v = ((b0 & 0x0F) << 28) | (buf[pos + 1] << 20) | \
+        (buf[pos + 2] << 12) | (buf[pos + 3] << 4) | (buf[pos + 4] & 0x0F)
+    # interpret as signed 32-bit
+    if v & 0x80000000:
+        v -= 1 << 32
+    return v, pos + 5
+
+
+def write_itf8(v: int) -> bytes:
+    v &= 0xFFFFFFFF
+    if v < 0x80:
+        return bytes([v])
+    if v < 0x4000:
+        return bytes([0x80 | (v >> 8), v & 0xFF])
+    if v < 0x200000:
+        return bytes([0xC0 | (v >> 16), (v >> 8) & 0xFF, v & 0xFF])
+    if v < 0x10000000:
+        return bytes([0xE0 | (v >> 24), (v >> 16) & 0xFF, (v >> 8) & 0xFF,
+                      v & 0xFF])
+    return bytes([0xF0 | ((v >> 28) & 0x0F), (v >> 20) & 0xFF,
+                  (v >> 12) & 0xFF, (v >> 4) & 0xFF, v & 0x0F])
+
+
+def read_ltf8(buf: memoryview, pos: int) -> tuple[int, int]:
+    b0 = buf[pos]
+    n_extra = 0
+    mask = 0x80
+    while n_extra < 8 and (b0 & mask):
+        n_extra += 1
+        mask >>= 1
+    if n_extra == 0:
+        return b0, pos + 1
+    if n_extra < 8:
+        v = b0 & (0xFF >> (n_extra + 1))
+    else:
+        v = 0
+    for i in range(n_extra):
+        v = (v << 8) | buf[pos + 1 + i]
+    if n_extra == 8 and v & (1 << 63):
+        v -= 1 << 64
+    return v, pos + 1 + n_extra
+
+
+def write_ltf8(v: int) -> bytes:
+    v &= (1 << 64) - 1
+    if v < 0x80:
+        return bytes([v])
+    for n in range(1, 8):  # n extra bytes; (7 - n) value bits in byte 0
+        if v < (1 << (7 + 7 * n)):
+            prefix = (0xFF << (8 - n)) & 0xFF
+            body = v.to_bytes(n + 1, "big")
+            return bytes([prefix | body[0]]) + body[1:]
+    return bytes([0xFF]) + v.to_bytes(8, "big")
+
+
+# --------------------------------------------------------- rANS 4x8
+
+RANS_LOW = 1 << 23
+TF_SHIFT = 12
+TOTFREQ = 1 << TF_SHIFT
+
+
+def _read_u7(buf, pos):
+    """rANS frequency value: 1 byte (<128) or 2 bytes (0x80|hi, lo)."""
+    b0 = buf[pos]
+    if b0 < 0x80:
+        return b0, pos + 1
+    return ((b0 & 0x7F) << 8) | buf[pos + 1], pos + 2
+
+
+def _write_u7(v: int) -> bytes:
+    if v < 0x80:
+        return bytes([v])
+    return bytes([0x80 | (v >> 8), v & 0xFF])
+
+
+def _read_freqs0(buf, pos):
+    freqs = np.zeros(256, dtype=np.int64)
+    sym = buf[pos]
+    pos += 1
+    last_sym = sym
+    rle = 0
+    while True:
+        f, pos = _read_u7(buf, pos)
+        freqs[sym] = f
+        if rle > 0:
+            rle -= 1
+            sym += 1
+        else:
+            sym = buf[pos]
+            pos += 1
+            # unmasked comparison: last_sym 255 must NOT treat the 0x00
+            # terminator as an adjacent-run marker (255 + 1 = 256 != 0)
+            if sym == last_sym + 1:
+                rle = buf[pos]
+                pos += 1
+            last_sym = sym
+        if sym == 0 and rle == 0:
+            break
+    return freqs, pos
+
+
+def _rans_decode_0(buf, pos, out_len):
+    freqs, pos = _read_freqs0(buf, pos)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    # symbol lookup table over the 4096 range
+    lut = np.zeros(TOTFREQ, dtype=np.uint8)
+    for s in np.nonzero(freqs)[0]:
+        lut[cum[s]:cum[s + 1]] = s
+    R = list(struct.unpack_from("<4I", buf, pos))
+    pos += 16
+    out = bytearray(out_len)
+    n = len(buf)
+    for i in range(out_len):
+        j = i & 3
+        x = R[j]
+        m = x & (TOTFREQ - 1)
+        s = lut[m]
+        out[i] = s
+        x = int(freqs[s]) * (x >> TF_SHIFT) + m - int(cum[s])
+        while x < RANS_LOW and pos < n:
+            x = (x << 8) | buf[pos]
+            pos += 1
+        R[j] = x
+    return bytes(out)
+
+
+def _rans_decode_1(buf, pos, out_len):
+    # outer RLE over contexts, inner order-0 tables
+    freqs = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    ctx = buf[pos]
+    pos += 1
+    last_ctx = ctx
+    rle = 0
+    luts = {}
+    while True:
+        f, pos = _read_freqs0(buf, pos)
+        freqs[ctx] = f
+        np.cumsum(f, out=cums[ctx][1:])
+        lut = np.zeros(TOTFREQ, dtype=np.uint8)
+        for s in np.nonzero(f)[0]:
+            lut[cums[ctx][s]:cums[ctx][s + 1]] = s
+        luts[ctx] = lut
+        if rle > 0:
+            rle -= 1
+            ctx += 1
+        else:
+            ctx = buf[pos]
+            pos += 1
+            if ctx == last_ctx + 1:  # unmasked: see _read_freqs0
+                rle = buf[pos]
+                pos += 1
+            last_ctx = ctx
+        if ctx == 0 and rle == 0:
+            break
+    R = list(struct.unpack_from("<4I", buf, pos))
+    pos += 16
+    out = bytearray(out_len)
+    n = len(buf)
+    F = out_len >> 2
+    last = [0, 0, 0, 0]
+    idx = [j * F for j in range(4)]
+    ends = [F, 2 * F, 3 * F, out_len]
+    i = 0
+    while True:
+        done = True
+        for j in range(4):
+            if idx[j] >= ends[j]:
+                continue
+            done = False
+            x = R[j]
+            c = last[j]
+            m = x & (TOTFREQ - 1)
+            s = luts[c][m] if c in luts else 0
+            out[idx[j]] = s
+            x = int(freqs[c][s]) * (x >> TF_SHIFT) + m - int(cums[c][s])
+            while x < RANS_LOW and pos < n:
+                x = (x << 8) | buf[pos]
+                pos += 1
+            R[j] = x
+            last[j] = s
+            idx[j] += 1
+        i += 1
+        if done:
+            break
+    return bytes(out)
+
+
+def rans_decode(data: bytes) -> bytes:
+    buf = memoryview(data)
+    order = buf[0]
+    # compressed size u32, uncompressed size u32
+    out_len = struct.unpack_from("<I", buf, 5)[0]
+    if out_len == 0:
+        return b""
+    if order == 0:
+        return _rans_decode_0(buf, 9, out_len)
+    if order == 1:
+        return _rans_decode_1(buf, 9, out_len)
+    raise ValueError(f"cram: unknown rANS order {order}")
+
+
+def rans_encode_0(data: bytes) -> bytes:
+    """Order-0 rANS 4x8 encoder (for fixtures + decoder round-trips)."""
+    if len(data) == 0:
+        return b"\x00" + struct.pack("<II", 0, 0)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    freqs = np.bincount(arr, minlength=256).astype(np.int64)
+    # normalize to TOTFREQ, keeping every present symbol >= 1
+    present = freqs > 0
+    norm = np.maximum((freqs * TOTFREQ) // len(arr), present.astype(np.int64))
+    # fix rounding so the total is exactly TOTFREQ
+    diff = TOTFREQ - int(norm.sum())
+    big = int(np.argmax(norm))
+    norm[big] += diff
+    if norm[big] <= 0:
+        raise ValueError("rans: degenerate distribution")
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(norm, out=cum[1:])
+
+    # frequency table serialization (RLE over symbols)
+    table = bytearray()
+    syms = np.nonzero(present)[0]
+    i = 0
+    while i < len(syms):
+        run = 0
+        while (i + run + 1 < len(syms)
+               and syms[i + run + 1] == syms[i + run] + 1):
+            run += 1
+        table.append(int(syms[i]))
+        table += _write_u7(int(norm[syms[i]]))
+        if run:
+            # adjacent-symbol RLE: marker byte (sym+1) then the count of
+            # FURTHER consecutive symbols after it, then their freqs
+            table.append(int(syms[i] + 1))
+            table.append(run - 1)
+            for k in range(1, run + 1):
+                table += _write_u7(int(norm[syms[i + k]]))
+        i += run + 1
+    table.append(0)
+
+    # encode backwards with 4 interleaved states
+    R = [RANS_LOW] * 4
+    payload = bytearray()
+    for i in range(len(arr) - 1, -1, -1):
+        s = int(arr[i])
+        j = i & 3
+        f = int(norm[s])
+        x = R[j]
+        x_max = ((RANS_LOW >> TF_SHIFT) << 8) * f
+        while x >= x_max:
+            payload.append(x & 0xFF)
+            x >>= 8
+        R[j] = ((x // f) << TF_SHIFT) + (x % f) + int(cum[s])
+    states = b"".join(struct.pack("<I", R[j]) for j in range(4))
+    body = bytes(table) + states + bytes(reversed(payload))
+    return b"\x00" + struct.pack("<II", len(body), len(arr)) + body
+
+
+# ------------------------------------------------------------- blocks
+
+def _decompress(method: int, data: bytes, raw_size: int) -> bytes:
+    if method == M_RAW:
+        return data
+    if method == M_GZIP:
+        return gzip.decompress(data)
+    if method == M_BZIP2:
+        import bz2
+
+        return bz2.decompress(data)
+    if method == M_LZMA:
+        import lzma
+
+        return lzma.decompress(data)
+    if method == M_RANS:
+        return rans_decode(data)
+    raise ValueError(f"cram: unsupported block compression method {method}")
+
+
+@dataclass
+class Block:
+    method: int
+    content_type: int
+    content_id: int
+    data: bytes  # uncompressed
+
+
+def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
+    method = buf[pos]
+    ctype = buf[pos + 1]
+    pos += 2
+    cid, pos = read_itf8(buf, pos)
+    csize, pos = read_itf8(buf, pos)
+    rsize, pos = read_itf8(buf, pos)
+    raw = bytes(buf[pos:pos + csize])
+    pos += csize
+    want_crc = struct.unpack_from("<I", buf, pos)[0]
+    pos += 4
+    got_crc = zlib.crc32(
+        bytes([method, ctype]) + write_itf8(cid) + write_itf8(csize)
+        + write_itf8(rsize) + raw
+    )
+    if got_crc != want_crc:
+        raise ValueError("cram: block CRC mismatch")
+    data = _decompress(method, raw, rsize)
+    if len(data) != rsize:
+        raise ValueError("cram: block size mismatch after decompression")
+    return Block(method, ctype, cid, data), pos
+
+
+def write_block(method: int, ctype: int, cid: int, data: bytes) -> bytes:
+    if method == M_GZIP:
+        comp = gzip.compress(data, 6)
+    elif method == M_RANS:
+        comp = rans_encode_0(data)
+    else:
+        comp = data
+    head = bytes([method, ctype]) + write_itf8(cid) + \
+        write_itf8(len(comp)) + write_itf8(len(data))
+    return head + comp + struct.pack("<I", zlib.crc32(head + comp))
+
+
+# ------------------------------------------------------- encodings
+
+E_NULL, E_EXTERNAL, E_GOLOMB, E_HUFFMAN = 0, 1, 2, 3
+E_BYTE_ARRAY_LEN, E_BYTE_ARRAY_STOP, E_BETA = 4, 5, 6
+E_SUBEXP, E_GOLOMB_RICE, E_GAMMA = 7, 8, 9
+
+
+class BitReader:
+    """MSB-first reader over the core block."""
+
+    __slots__ = ("data", "byte", "bit")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.byte = 0
+        self.bit = 0
+
+    def read(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            b = (self.data[self.byte] >> (7 - self.bit)) & 1
+            v = (v << 1) | b
+            self.bit += 1
+            if self.bit == 8:
+                self.bit = 0
+                self.byte += 1
+        return v
+
+    def read_unary(self) -> int:
+        n = 0
+        while True:
+            b = (self.data[self.byte] >> (7 - self.bit)) & 1
+            self.bit += 1
+            if self.bit == 8:
+                self.bit = 0
+                self.byte += 1
+            if b:
+                return n
+            n += 1
+
+
+@dataclass
+class Encoding:
+    codec: int
+    params: dict = field(default_factory=dict)
+
+    @staticmethod
+    def parse(buf: memoryview, pos: int) -> tuple["Encoding", int]:
+        codec, pos = read_itf8(buf, pos)
+        size, pos = read_itf8(buf, pos)
+        end = pos + size
+        p: dict = {}
+        if codec == E_EXTERNAL:
+            p["id"], pos = read_itf8(buf, pos)
+        elif codec == E_HUFFMAN:
+            n, pos = read_itf8(buf, pos)
+            alphabet = []
+            for _ in range(n):
+                v, pos = read_itf8(buf, pos)
+                alphabet.append(v)
+            n2, pos = read_itf8(buf, pos)
+            lens = []
+            for _ in range(n2):
+                v, pos = read_itf8(buf, pos)
+                lens.append(v)
+            p["alphabet"], p["lengths"] = alphabet, lens
+        elif codec == E_BYTE_ARRAY_LEN:
+            p["len_enc"], pos = Encoding.parse(buf, pos)
+            p["val_enc"], pos = Encoding.parse(buf, pos)
+        elif codec == E_BYTE_ARRAY_STOP:
+            p["stop"] = buf[pos]
+            pos += 1
+            p["id"], pos = read_itf8(buf, pos)
+        elif codec == E_BETA:
+            p["offset"], pos = read_itf8(buf, pos)
+            p["length"], pos = read_itf8(buf, pos)
+        elif codec == E_GAMMA:
+            p["offset"], pos = read_itf8(buf, pos)
+        elif codec == E_NULL:
+            pass
+        else:
+            raise ValueError(f"cram: unsupported codec id {codec}")
+        return Encoding(codec, p), end
+
+    def serialize(self) -> bytes:
+        body = b""
+        if self.codec == E_EXTERNAL:
+            body = write_itf8(self.params["id"])
+        elif self.codec == E_HUFFMAN:
+            a, ls = self.params["alphabet"], self.params["lengths"]
+            body = write_itf8(len(a)) + b"".join(write_itf8(x) for x in a)
+            body += write_itf8(len(ls)) + b"".join(write_itf8(x) for x in ls)
+        elif self.codec == E_BYTE_ARRAY_LEN:
+            body = self.params["len_enc"].serialize() + \
+                self.params["val_enc"].serialize()
+        elif self.codec == E_BYTE_ARRAY_STOP:
+            body = bytes([self.params["stop"]]) + \
+                write_itf8(self.params["id"])
+        elif self.codec == E_BETA:
+            body = write_itf8(self.params["offset"]) + \
+                write_itf8(self.params["length"])
+        elif self.codec == E_GAMMA:
+            body = write_itf8(self.params["offset"])
+        return write_itf8(self.codec) + write_itf8(len(body)) + body
+
+
+class _ExternalStream:
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes):
+        self.data = memoryview(data)
+        self.pos = 0
+
+    def itf8(self) -> int:
+        v, self.pos = read_itf8(self.data, self.pos)
+        return v
+
+    def byte(self) -> int:
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def until(self, stop: int) -> bytes:
+        start = self.pos
+        data = self.data
+        p = self.pos
+        n = len(data)
+        while p < n and data[p] != stop:
+            p += 1
+        out = bytes(data[start:p])
+        self.pos = p + 1  # skip the stop byte
+        return out
+
+    def take(self, n: int) -> bytes:
+        out = bytes(self.data[self.pos:self.pos + n])
+        self.pos += n
+        return out
+
+
+class Decoder:
+    """One data series decoder bound to core/external streams."""
+
+    def __init__(self, enc: Encoding, core: BitReader,
+                 externals: dict[int, _ExternalStream]):
+        self.enc = enc
+        self.core = core
+        self.ext = externals
+        if enc.codec == E_HUFFMAN:
+            self._build_huffman()
+        elif enc.codec == E_BYTE_ARRAY_LEN:
+            self.len_dec = Decoder(enc.params["len_enc"], core, externals)
+            self.val_dec = Decoder(enc.params["val_enc"], core, externals)
+
+    def _build_huffman(self):
+        alphabet = self.enc.params["alphabet"]
+        lengths = self.enc.params["lengths"]
+        if len(alphabet) == 1:
+            self.hf_single = alphabet[0]
+            return
+        self.hf_single = None
+        # canonical codes: sort by (length, order of appearance)
+        order = sorted(range(len(alphabet)), key=lambda i: (lengths[i], i))
+        code = 0
+        prev_len = lengths[order[0]]
+        table = {}
+        for i in order:
+            code <<= lengths[i] - prev_len
+            prev_len = lengths[i]
+            table[(lengths[i], code)] = alphabet[i]
+            code += 1
+        self.hf_table = table
+        self.hf_maxlen = max(lengths)
+
+    def read_int(self) -> int:
+        c = self.enc.codec
+        if c == E_EXTERNAL:
+            return self.ext[self.enc.params["id"]].itf8()
+        if c == E_HUFFMAN:
+            if self.hf_single is not None:
+                return self.hf_single
+            ln = 0
+            code = 0
+            while ln <= self.hf_maxlen:
+                code = (code << 1) | self.core.read(1)
+                ln += 1
+                if (ln, code) in self.hf_table:
+                    return self.hf_table[(ln, code)]
+            raise ValueError("cram: bad huffman code")
+        if c == E_BETA:
+            return self.core.read(self.enc.params["length"]) - \
+                self.enc.params["offset"]
+        if c == E_GAMMA:
+            n = self.core.read_unary()
+            v = (1 << n) | (self.core.read(n) if n else 0)
+            return v - self.enc.params["offset"]
+        raise ValueError(f"cram: codec {c} cannot decode ints")
+
+    def read_byte(self) -> int:
+        c = self.enc.codec
+        if c == E_EXTERNAL:
+            return self.ext[self.enc.params["id"]].byte()
+        return self.read_int() & 0xFF
+
+    def read_bytes(self) -> bytes:
+        c = self.enc.codec
+        if c == E_BYTE_ARRAY_STOP:
+            return self.ext[self.enc.params["id"]].until(
+                self.enc.params["stop"]
+            )
+        if c == E_BYTE_ARRAY_LEN:
+            n = self.len_dec.read_int()
+            if self.val_dec.enc.codec == E_EXTERNAL:
+                return self.val_dec.ext[
+                    self.val_dec.enc.params["id"]
+                ].take(n)
+            return bytes(self.val_dec.read_byte() for _ in range(n))
+        raise ValueError(f"cram: codec {c} cannot decode byte arrays")
+
+    def read_bytes_n(self, n: int) -> bytes:
+        """n bytes for fixed-length series (QS, unmapped bases)."""
+        if self.enc.codec == E_EXTERNAL:
+            return self.ext[self.enc.params["id"]].take(n)
+        return bytes(self.read_byte() for _ in range(n))
+
+
+# --------------------------------------------- compression header
+
+# feature codes → which extra series they read
+FEATURE_EXTRA = {
+    ord("B"): ("BA", "QS1"),  # base + qual
+    ord("X"): ("BS",),        # substitution code
+    ord("I"): ("IN",),        # insertion bytes
+    ord("S"): ("SC",),        # soft clip bytes
+    ord("H"): ("HC",),        # hard clip len
+    ord("P"): ("PD",),        # pad len
+    ord("D"): ("DL",),        # deletion len
+    ord("N"): ("RS",),        # ref skip len
+    ord("i"): ("BA",),        # single inserted base
+    ord("b"): ("BB",),        # bases array
+    ord("q"): ("QQ",),        # quals array
+    ord("Q"): ("QS1",),       # single qual
+    ord("E"): (),
+}
+
+# in-read length the feature consumes (query) / reference length
+_Q_CONSUME = {ord("S"), ord("I"), ord("i")}
+_R_CONSUME = {ord("D"), ord("N")}
+# features that add a CIGAR op (break the single-M shape) even though
+# they consume neither query nor reference
+_STRUCTURAL = _Q_CONSUME | _R_CONSUME | {ord("H"), ord("P")}
+
+
+@dataclass
+class CompressionHeader:
+    rn_included: bool = True
+    ap_delta: bool = True
+    ref_required: bool = True
+    sub_matrix: bytes = b"\x00" * 5
+    tag_dict: list[list[tuple[str, str]]] = field(default_factory=list)
+    encodings: dict[str, Encoding] = field(default_factory=dict)
+    tag_encodings: dict[int, Encoding] = field(default_factory=dict)
+
+    @staticmethod
+    def parse(data: bytes) -> "CompressionHeader":
+        buf = memoryview(data)
+        ch = CompressionHeader()
+        pos = 0
+        # preservation map
+        _size, pos = read_itf8(buf, pos)
+        nmap, pos = read_itf8(buf, pos)
+        for _ in range(nmap):
+            key = bytes(buf[pos:pos + 2]).decode()
+            pos += 2
+            if key == "RN":
+                ch.rn_included = bool(buf[pos])
+                pos += 1
+            elif key == "AP":
+                ch.ap_delta = bool(buf[pos])
+                pos += 1
+            elif key == "RR":
+                ch.ref_required = bool(buf[pos])
+                pos += 1
+            elif key == "SM":
+                ch.sub_matrix = bytes(buf[pos:pos + 5])
+                pos += 5
+            elif key == "TD":
+                blob_len, pos = read_itf8(buf, pos)
+                blob = bytes(buf[pos:pos + blob_len])
+                pos += blob_len
+                ch.tag_dict = []
+                for line in blob.split(b"\x00")[:-1] if blob else []:
+                    tags = []
+                    for i in range(0, len(line), 3):
+                        tags.append((line[i:i + 2].decode(),
+                                     chr(line[i + 2])))
+                    ch.tag_dict.append(tags)
+                if not ch.tag_dict:
+                    ch.tag_dict = [[]]
+            else:
+                raise ValueError(f"cram: unknown preservation key {key}")
+        # data series encodings
+        _size, pos = read_itf8(buf, pos)
+        n, pos = read_itf8(buf, pos)
+        for _ in range(n):
+            key = bytes(buf[pos:pos + 2]).decode()
+            pos += 2
+            enc, pos = Encoding.parse(buf, pos)
+            ch.encodings[key] = enc
+        # tag encodings
+        _size, pos = read_itf8(buf, pos)
+        n, pos = read_itf8(buf, pos)
+        for _ in range(n):
+            key, pos = read_itf8(buf, pos)
+            enc, pos = Encoding.parse(buf, pos)
+            ch.tag_encodings[key] = enc
+        return ch
+
+    def serialize(self) -> bytes:
+        pres = bytearray()
+        entries = [
+            (b"RN", bytes([1 if self.rn_included else 0])),
+            (b"AP", bytes([1 if self.ap_delta else 0])),
+            (b"RR", bytes([1 if self.ref_required else 0])),
+            (b"SM", self.sub_matrix),
+        ]
+        blob = b""
+        for line in self.tag_dict:
+            for tag, typ in line:
+                blob += tag.encode() + typ.encode()
+            blob += b"\x00"
+        entries.append((b"TD", write_itf8(len(blob)) + blob))
+        body = write_itf8(len(entries))
+        for k, v in entries:
+            body += k + v
+        out = write_itf8(len(body)) + body
+        body = write_itf8(len(self.encodings))
+        for k, enc in self.encodings.items():
+            body += k.encode() + enc.serialize()
+        out += write_itf8(len(body)) + body
+        body = write_itf8(len(self.tag_encodings))
+        for k, enc in self.tag_encodings.items():
+            body += write_itf8(k) + enc.serialize()
+        out += write_itf8(len(body)) + body
+        return bytes(out)
+
+
+# ----------------------------------------------------------- slices
+
+@dataclass
+class SliceHeader:
+    ref_id: int
+    start: int
+    span: int
+    n_records: int
+    counter: int
+    n_blocks: int
+    content_ids: list[int]
+    embedded_ref_id: int
+    md5: bytes
+
+    @staticmethod
+    def parse(data: bytes) -> "SliceHeader":
+        buf = memoryview(data)
+        pos = 0
+        ref_id, pos = read_itf8(buf, pos)
+        start, pos = read_itf8(buf, pos)
+        span, pos = read_itf8(buf, pos)
+        nrec, pos = read_itf8(buf, pos)
+        counter, pos = read_ltf8(buf, pos)
+        nblocks, pos = read_itf8(buf, pos)
+        ncids, pos = read_itf8(buf, pos)
+        cids = []
+        for _ in range(ncids):
+            v, pos = read_itf8(buf, pos)
+            cids.append(v)
+        emb, pos = read_itf8(buf, pos)
+        md5 = bytes(buf[pos:pos + 16])
+        return SliceHeader(ref_id, start, span, nrec, counter, nblocks,
+                           cids, emb, md5)
+
+    def serialize(self) -> bytes:
+        out = write_itf8(self.ref_id) + write_itf8(self.start) + \
+            write_itf8(self.span) + write_itf8(self.n_records) + \
+            write_ltf8(self.counter) + write_itf8(self.n_blocks) + \
+            write_itf8(len(self.content_ids))
+        for c in self.content_ids:
+            out += write_itf8(c)
+        out += write_itf8(self.embedded_ref_id) + self.md5
+        return out
+
+
+@dataclass
+class CramRecord:
+    bf: int
+    cf: int
+    ref_id: int
+    read_len: int
+    pos: int  # 1-based alignment position
+    mapq: int
+    mate_ref: int
+    mate_pos: int
+    tlen: int
+    name: bytes
+    features: list[tuple[int, int, int]]  # (code, in-read pos, length)
+
+    @property
+    def flag(self) -> int:
+        f = self.bf
+        return f
+
+    def ref_end(self) -> int:
+        """1-based exclusive-ish: pos + ref-consumed length."""
+        q_only = sum(ln for c, _, ln in self.features if c in _Q_CONSUME)
+        r_only = sum(ln for c, _, ln in self.features if c in _R_CONSUME)
+        return self.pos + self.read_len - q_only + r_only
+
+    def aligned_blocks(self) -> list[tuple[int, int]]:
+        """0-based [start, end) M-run blocks (depth counts these)."""
+        ref = self.pos - 1
+        prev_q = 1
+        blocks = []
+        for code, fp, ln in sorted(self.features, key=lambda t: t[1]):
+            if code in _Q_CONSUME:
+                m = fp - prev_q
+                if m > 0:
+                    blocks.append((ref, ref + m))
+                    ref += m
+                prev_q = fp + ln
+            elif code in _R_CONSUME:
+                m = fp - prev_q
+                if m > 0:
+                    blocks.append((ref, ref + m))
+                    ref += m
+                ref += ln
+                prev_q = fp
+        m = self.read_len - prev_q + 1
+        if m > 0:
+            blocks.append((ref, ref + m))
+        return blocks
+
+    def single_m(self) -> bool:
+        return not self.features
+
+
+def decode_slice(comp: CompressionHeader, sl: SliceHeader,
+                 core: bytes, externals: dict[int, bytes],
+                 ) -> list[CramRecord]:
+    br = BitReader(core)
+    streams = {cid: _ExternalStream(d) for cid, d in externals.items()}
+
+    decs: dict[str, Decoder] = {}
+
+    def dec(key: str) -> Decoder:
+        d = decs.get(key)
+        if d is None:
+            enc = comp.encodings.get(key)
+            if enc is None:
+                raise ValueError(f"cram: no encoding for series {key}")
+            d = Decoder(enc, br, streams)
+            decs[key] = d
+        return d
+
+    tag_decs: dict[int, Decoder] = {}
+    records = []
+    nf_links: list[int | None] = []
+    prev_pos = sl.start
+    for _ in range(sl.n_records):
+        bf = dec("BF").read_int()
+        cf = dec("CF").read_int()
+        ref_id = sl.ref_id
+        if sl.ref_id == -2:
+            ref_id = dec("RI").read_int()
+        rl = dec("RL").read_int()
+        ap = dec("AP").read_int()
+        if comp.ap_delta:
+            pos = prev_pos + ap
+            prev_pos = pos
+        else:
+            pos = ap
+        dec("RG").read_int()
+        name = b""
+        if comp.rn_included:
+            name = dec("RN").read_bytes()
+        mate_ref, mate_pos, tlen = -1, -1, 0
+        nf: int | None = None
+        if cf & CF_DETACHED:
+            mf = dec("MF").read_int()
+            if not comp.rn_included:
+                name = dec("RN").read_bytes()
+            mate_ref = dec("NS").read_int()
+            mate_pos = dec("NP").read_int()
+            tlen = dec("TS").read_int()
+            bf |= (BAM_MREVERSE if mf & MF_MATE_REVERSE else 0)
+            bf |= (BAM_MUNMAP if mf & MF_MATE_UNMAPPED else 0)
+        elif cf & CF_MATE_DOWNSTREAM:
+            nf = dec("NF").read_int()
+        tl = dec("TL").read_int()
+        if comp.tag_dict and 0 <= tl < len(comp.tag_dict):
+            for tag, typ in comp.tag_dict[tl]:
+                key = (ord(tag[0]) << 16) | (ord(tag[1]) << 8) | ord(typ)
+                td = tag_decs.get(key)
+                if td is None:
+                    enc = comp.tag_encodings.get(key)
+                    if enc is None:
+                        raise ValueError(f"cram: no tag encoding {tag}")
+                    td = Decoder(enc, br, streams)
+                    tag_decs[key] = td
+                td.read_bytes()  # consume; values unused for depth
+        features: list[tuple[int, int, int]] = []
+        mapq = 0
+        if not (bf & 0x4):  # mapped
+            fn = dec("FN").read_int()
+            fpos = 0
+            for _ in range(fn):
+                fc = dec("FC").read_byte()
+                fpos += dec("FP").read_int()
+                ln = 0
+                if fc == ord("S"):
+                    ln = len(dec("SC").read_bytes())
+                elif fc == ord("I"):
+                    ln = len(dec("IN").read_bytes())
+                elif fc == ord("i"):
+                    dec("BA").read_byte()
+                    ln = 1
+                elif fc == ord("D"):
+                    ln = dec("DL").read_int()
+                elif fc == ord("N"):
+                    ln = dec("RS").read_int()
+                elif fc == ord("H"):
+                    dec("HC").read_int()
+                elif fc == ord("P"):
+                    dec("PD").read_int()
+                elif fc == ord("X"):
+                    dec("BS").read_byte()
+                elif fc == ord("B"):
+                    dec("BA").read_byte()
+                    dec("QS").read_byte()
+                elif fc == ord("Q"):
+                    dec("QS").read_byte()
+                elif fc == ord("b"):
+                    dec("BB").read_bytes()
+                elif fc == ord("q"):
+                    dec("QQ").read_bytes()
+                else:
+                    raise ValueError(f"cram: unknown feature {chr(fc)}")
+                if fc in _STRUCTURAL:
+                    features.append((fc, fpos, ln))
+            mapq = dec("MQ").read_int()
+            if cf & CF_QS_STORED:
+                dec("QS").read_bytes_n(rl)
+        else:
+            if not (cf & CF_NO_SEQ):
+                dec("BA").read_bytes_n(rl)
+            if cf & CF_QS_STORED:
+                dec("QS").read_bytes_n(rl)
+        records.append(CramRecord(bf, cf, ref_id, rl, pos, mapq,
+                                  mate_ref, mate_pos, tlen, name,
+                                  features))
+        nf_links.append(nf)
+    # resolve downstream mates (spec: mate = this + NF + 1, same slice)
+    for i, nf in enumerate(nf_links):
+        if nf is None:
+            continue
+        j = i + nf + 1
+        if j >= len(records):
+            continue
+        a, b = records[i], records[j]
+        for rec, other in ((a, b), (b, a)):
+            rec.mate_ref = other.ref_id
+            rec.mate_pos = other.pos
+            if other.bf & 0x10:
+                rec.bf |= BAM_MREVERSE
+            if other.bf & 0x4:
+                rec.bf |= BAM_MUNMAP
+        # BAM-rule template length: outermost span, + on the leftmost
+        lo = min(a.pos, b.pos)
+        hi = max(a.ref_end(), b.ref_end())
+        span = hi - lo
+        if a.pos <= b.pos:
+            a.tlen, b.tlen = span, -span
+        else:
+            a.tlen, b.tlen = -span, span
+    return records
+
+
+# -------------------------------------------------------- containers
+
+@dataclass
+class ContainerHeader:
+    length: int  # total byte size of the container's blocks
+    ref_id: int
+    start: int
+    span: int
+    n_records: int
+    counter: int
+    n_bases: int
+    n_blocks: int
+    landmarks: list[int]
+
+    @staticmethod
+    def parse(buf: memoryview, pos: int) -> tuple["ContainerHeader", int]:
+        (length,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        ref_id, pos = read_itf8(buf, pos)
+        start, pos = read_itf8(buf, pos)
+        span, pos = read_itf8(buf, pos)
+        nrec, pos = read_itf8(buf, pos)
+        counter, pos = read_ltf8(buf, pos)
+        nbases, pos = read_ltf8(buf, pos)
+        nblocks, pos = read_itf8(buf, pos)
+        nland, pos = read_itf8(buf, pos)
+        lands = []
+        for _ in range(nland):
+            v, pos = read_itf8(buf, pos)
+            lands.append(v)
+        pos += 4  # header crc32 (v3)
+        return ContainerHeader(length, ref_id, start, span, nrec, counter,
+                               nbases, nblocks, lands), pos
+
+    @staticmethod
+    def build(length, ref_id, start, span, nrec, counter, nbases,
+              nblocks, landmarks) -> bytes:
+        body = write_itf8(ref_id) + write_itf8(start) + \
+            write_itf8(span) + write_itf8(nrec) + write_ltf8(counter) + \
+            write_ltf8(nbases) + write_itf8(nblocks) + \
+            write_itf8(len(landmarks))
+        for v in landmarks:
+            body += write_itf8(v)
+        head = struct.pack("<i", length) + body
+        return head + struct.pack("<I", zlib.crc32(head))
+
+
+def _container_records(buf: memoryview, pos: int,
+                       hdr: ContainerHeader) -> list[CramRecord]:
+    """Decode every record in the container starting at its first block."""
+    end = pos + hdr.length
+    block, pos = read_block(buf, pos)
+    if block.content_type != CT_COMP_HEADER:
+        raise ValueError("cram: expected compression header block")
+    comp = CompressionHeader.parse(block.data)
+    records: list[CramRecord] = []
+    while pos < end:
+        sh_block, pos = read_block(buf, pos)
+        if sh_block.content_type != CT_SLICE_HEADER:
+            raise ValueError("cram: expected slice header block")
+        sl = SliceHeader.parse(sh_block.data)
+        core = b""
+        externals: dict[int, bytes] = {}
+        for _ in range(sl.n_blocks):
+            b, pos = read_block(buf, pos)
+            if b.content_type == CT_CORE:
+                core = b.data
+            elif b.content_type == CT_EXTERNAL:
+                externals[b.content_id] = b.data
+        records.extend(decode_slice(comp, sl, core, externals))
+    return records
+
+
+class CramFile:
+    """Decoded-CRAM handle with the BAM-handle surface the depth tools
+    use: ``.header`` (BamHeader), ``read_columns(tid, start, end)``,
+    ``stream_columns()``. Region access uses the .crai when present
+    (container offsets per (seq, start, span) — the same index
+    indexcov's QC path already parses)."""
+
+    native = False
+    lazy = True
+    is_cram = True
+
+    def __init__(self, data, crai_path: str | None = None):
+        from .bam import BamHeader
+
+        self._buf = memoryview(data) if not isinstance(data, memoryview) \
+            else data
+        buf = self._buf
+        if bytes(buf[:4]) != CRAM_MAGIC:
+            raise ValueError("not a CRAM file (bad magic)")
+        self.major, self.minor = buf[4], buf[5]
+        if self.major != 3:
+            raise ValueError(
+                f"cram: unsupported major version {self.major}"
+            )
+        pos = 26  # magic + version + 20-byte file id
+        hdr, pos = ContainerHeader.parse(buf, pos)
+        first_block, _ = read_block(buf, pos)
+        if first_block.content_type != CT_FILE_HEADER:
+            raise ValueError("cram: first container must hold SAM header")
+        text = _sam_header_text(first_block.data)
+        names, lens = [], []
+        for line in text.splitlines():
+            if line.startswith("@SQ"):
+                nm, ln = None, 0
+                for tok in line.split("\t")[1:]:
+                    if tok.startswith("SN:"):
+                        nm = tok[3:]
+                    elif tok.startswith("LN:"):
+                        ln = int(tok[3:])
+                if nm is not None:
+                    names.append(nm)
+                    lens.append(ln)
+        self.header = BamHeader(text, names, lens)
+        self._first_data_container = pos + hdr.length
+        self._crai = None
+        self._all_records = None  # no-.crai fallback decode cache
+        if crai_path:
+            self._crai = _load_crai_entries(crai_path)
+
+    @classmethod
+    def from_file(cls, path: str, lazy: bool = True) -> "CramFile":
+        import mmap
+        import os
+
+        with open(path, "rb") as fh:
+            mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        crai = path + ".crai"
+        return cls(memoryview(mm),
+                   crai_path=crai if os.path.exists(crai) else None)
+
+    def _iter_containers(self, offset: int | None = None):
+        buf = self._buf
+        pos = offset if offset is not None else self._first_data_container
+        n = len(buf)
+        while pos + 4 <= n:
+            hdr, body = ContainerHeader.parse(buf, pos)
+            if hdr.ref_id == -1 and hdr.n_records == 0:
+                if hdr.n_blocks <= 1:
+                    return  # EOF container
+                pos = body + hdr.length
+                continue  # unmapped-only container: skip (no positions)
+            yield hdr, body
+            pos = body + hdr.length
+
+    def records(self, offset: int | None = None):
+        for hdr, body in self._iter_containers(offset):
+            yield from _container_records(self._buf, body, hdr)
+
+    def _region_offsets(self, tid: int, start: int, end: int):
+        """Container offsets overlapping 0-based [start, end) from the
+        .crai (whose alignment starts are 1-based)."""
+        offs = []
+        for (seq, s, span, c_off) in self._crai:
+            if seq != tid or span <= 0:
+                continue
+            s0 = s - 1
+            if s0 < end and s0 + span > start:
+                offs.append(c_off)
+        return sorted(set(offs))
+
+    def read_columns(self, tid: int | None = None, start: int = 0,
+                     end: int | None = None, voffset=None,
+                     end_voffset=None):
+        """Decode records into ReadColumns (BAM-handle-compatible).
+
+        ``voffset``/``end_voffset`` are accepted for interface parity and
+        ignored — CRAM random access goes through the .crai instead.
+        """
+        recs: list[CramRecord] = []
+        e = end if end is not None else 1 << 60
+        if tid is not None and self._crai is not None:
+            seen = set()
+            for off in self._region_offsets(tid, start, e):
+                for hdr, body in self._iter_containers(off):
+                    if hdr.ref_id not in (-2, tid) or hdr.start > e:
+                        break
+                    if body in seen:
+                        break
+                    seen.add(body)
+                    recs.extend(_container_records(self._buf, body, hdr))
+                    break  # one container per crai offset
+        else:
+            # no .crai: decode the whole file ONCE and answer every
+            # region from the cache (a sharded whole-genome run would
+            # otherwise re-decode the file per region)
+            if self._all_records is None:
+                import logging
+
+                if tid is not None:
+                    logging.getLogger("goleft-tpu.cram").warning(
+                        "no .crai alongside CRAM — region queries fall "
+                        "back to one full-file decode held in memory"
+                    )
+                self._all_records = list(self.records())
+            recs = self._all_records
+        return _records_to_columns(recs, tid, start, e)
+
+    def stream_columns(self, window_bytes: int = 0, chunk_records: int = 0):
+        """Per-container column chunks (bounded by container size)."""
+        for hdr, body in self._iter_containers():
+            recs = _container_records(self._buf, body, hdr)
+            cols = _records_to_columns(recs, None, 0, 1 << 60)
+            if cols.n_reads:
+                yield cols
+
+
+def _sam_header_text(data: bytes) -> str:
+    # htslib prefixes the text with an int32 length; the spec allows the
+    # raw text (possibly NUL-padded) as well — accept both
+    if len(data) >= 4:
+        (n,) = struct.unpack_from("<i", data, 0)
+        if 0 <= n <= len(data) - 4:
+            return data[4:4 + n].decode(errors="replace")
+    return data.rstrip(b"\x00").decode(errors="replace")
+
+
+def _load_crai_entries(path: str):
+    entries = []
+    with gzip.open(path, "rt") as fh:
+        for line in fh:
+            t = line.split("\t")
+            if len(t) < 6:
+                continue
+            entries.append((int(t[0]), int(t[1]), int(t[2]), int(t[3])))
+    return entries
+
+
+def _records_to_columns(recs, tid, start, end):
+    from .bam import ReadColumns
+
+    tids, poss, ends, mapqs, flags, tlens, rlens = [], [], [], [], [], [], []
+    mposs, singlem = [], []
+    seg_t, seg_s, seg_e, seg_r = [], [], [], []
+    n = 0
+    for r in recs:
+        if r.bf & 0x4:
+            rpos, rend = r.pos - 1, r.pos - 1
+        else:
+            rpos, rend = r.pos - 1, r.ref_end() - 1
+        if tid is not None:
+            if r.ref_id != tid or rpos >= end or rend <= start:
+                continue
+        row = n
+        n += 1
+        tids.append(r.ref_id)
+        poss.append(rpos)
+        ends.append(rend)
+        mapqs.append(r.mapq)
+        flags.append(r.bf)
+        tlens.append(r.tlen)
+        rlens.append(r.read_len)
+        mposs.append(r.mate_pos - 1 if r.mate_pos > 0 else -1)
+        singlem.append(r.single_m() and not (r.bf & 0x4))
+        if not (r.bf & 0x4):
+            for bs, be in r.aligned_blocks():
+                seg_t.append(r.ref_id)
+                seg_s.append(bs)
+                seg_e.append(be)
+                seg_r.append(row)
+    return ReadColumns(
+        np.asarray(tids, dtype=np.int32),
+        np.asarray(poss, dtype=np.int32),
+        np.asarray(ends, dtype=np.int32),
+        np.asarray(mapqs, dtype=np.uint8),
+        np.asarray(flags, dtype=np.uint16),
+        np.asarray(tlens, dtype=np.int32),
+        np.asarray(rlens, dtype=np.int32),
+        np.asarray(mposs, dtype=np.int32),
+        np.asarray(singlem, dtype=bool),
+        np.asarray(seg_t, dtype=np.int32),
+        np.asarray(seg_s, dtype=np.int32),
+        np.asarray(seg_e, dtype=np.int32),
+        np.asarray(seg_r, dtype=np.int32),
+    )
+
+
+# -------------------------------------------------------------- writer
+
+# EOF container (CRAM 3.0 spec appendix: fixed marker bytes)
+EOF_CONTAINER = bytes([
+    0x0f, 0x00, 0x00, 0x00, 0xff, 0xff, 0xff, 0xff, 0x0f, 0xe0,
+    0x45, 0x4f, 0x46, 0x00, 0x00, 0x00, 0x00, 0x01, 0x00, 0x05,
+    0xbd, 0xd9, 0x4f, 0x00, 0x01, 0x00, 0x06, 0x06, 0x01, 0x00,
+    0x01, 0x00, 0x01, 0x00, 0xee, 0x63, 0x01, 0x4b,
+])
+
+# external block content ids for the fixture writer's series
+_W_IDS = {
+    "BF": 1, "CF": 2, "RL": 3, "AP": 4, "RG": 5, "RN": 6, "MF": 7,
+    "NS": 8, "NP": 9, "TS": 10, "TL": 11, "FN": 12, "FC": 13, "FP": 14,
+    "DL": 15, "RS": 16, "HC": 17, "PD": 18, "SC": 19, "IN": 20,
+    "BA": 21, "MQ": 22, "QS": 23, "BS": 24, "NF": 25, "RI": 26,
+}
+
+
+class CramWriter:
+    """Minimal spec-conformant CRAM 3.0 writer for hermetic fixtures.
+
+    One slice per container; every data series EXTERNAL in its own
+    block (ITF8 ints / stop-byte name arrays); detached mate info; no
+    tag values (one empty TD line). ``block_method`` picks the block
+    compression (gzip default; rans exercises the rANS decoder
+    round-trip). This is a test tool, not a production encoder — the
+    production direction CRAM→columns is what the reader implements.
+    """
+
+    def __init__(self, fh, header_text: str, ref_names: list[str],
+                 ref_lens: list[int], records_per_container: int = 10000,
+                 block_method: int = M_GZIP, ap_delta: bool = True):
+        self._fh = fh
+        self.ref_names = list(ref_names)
+        self._rpc = records_per_container
+        self._method = block_method
+        self._ap_delta = ap_delta
+        self._pending: list[dict] = []
+        self._counter = 0
+        self._offsets: list[tuple[int, int, int, int, int]] = []
+        fh.write(CRAM_MAGIC + bytes([3, 0]) + b"goleft-tpu-cram\x00\x00\x00\x00\x00")
+        sq = "".join(
+            f"@SQ\tSN:{n}\tLN:{ln}\n"
+            for n, ln in zip(ref_names, ref_lens)
+        )
+        text = (header_text if "@SQ" in header_text
+                else header_text + sq).encode()
+        blob = struct.pack("<i", len(text)) + text
+        block = write_block(M_RAW, CT_FILE_HEADER, 0, blob)
+        self._fh.write(ContainerHeader.build(
+            len(block), 0, 0, 0, 0, 0, 0, 1, [0]) + block)
+
+    def write_record(self, tid: int, pos0: int,
+                     cigar: list[tuple[int, int]], mapq: int = 60,
+                     flag: int = 0, name: str = "r", mate_tid: int = -1,
+                     mate_pos: int = -1, tlen: int = 0) -> None:
+        """pos0 is 0-based (BamWriter-compatible); CRAM stores 1-based."""
+        self._pending.append(dict(
+            tid=tid, pos=pos0 + 1, cigar=cigar, mapq=mapq, flag=flag,
+            name=name, mate_tid=mate_tid, mate_pos=mate_pos + 1,
+            tlen=tlen,
+        ))
+        if len(self._pending) >= self._rpc or (
+            len(self._pending) > 1
+            and self._pending[-2]["tid"] != tid
+        ):
+            # flush everything before a tid change (single-ref slices)
+            tail = []
+            while self._pending and self._pending[-1]["tid"] != \
+                    self._pending[0]["tid"]:
+                tail.append(self._pending.pop())
+            self._flush()
+            self._pending = list(reversed(tail))
+
+    def _flush(self) -> None:
+        recs = self._pending
+        if not recs:
+            return
+        self._pending = []
+        ids = _W_IDS
+        ints: dict[str, list[int]] = {k: [] for k in ids}
+        names = bytearray()
+        sc_bytes = bytearray()
+        in_bytes = bytearray()
+        ref_id = recs[0]["tid"]
+        first_pos = recs[0]["pos"]
+        prev = first_pos
+        max_end = first_pos
+        for r in recs:
+            q_len = sum(ln for ln, op in r["cigar"]
+                        if op in (0, 1, 4, 7, 8))  # M I S = X
+            bf = r["flag"] & ~(BAM_MREVERSE | BAM_MUNMAP)
+            cf = CF_DETACHED | CF_NO_SEQ
+            ints["BF"].append(bf)
+            ints["CF"].append(cf)
+            ints["RL"].append(q_len)
+            if self._ap_delta:
+                ints["AP"].append(r["pos"] - prev)
+                prev = r["pos"]
+            else:
+                ints["AP"].append(r["pos"])
+            ints["RG"].append(-1)
+            names += r["name"].encode() + b"\t"
+            mf = ((MF_MATE_REVERSE if r["flag"] & BAM_MREVERSE else 0)
+                  | (MF_MATE_UNMAPPED if r["flag"] & BAM_MUNMAP else 0))
+            ints["MF"].append(mf)
+            ints["NS"].append(r["mate_tid"])
+            ints["NP"].append(r["mate_pos"])
+            ints["TS"].append(r["tlen"])
+            ints["TL"].append(0)
+            if not (r["flag"] & 0x4):
+                feats = []
+                qp = 1
+                for ln, op in r["cigar"]:
+                    if op == 0 or op == 7 or op == 8:  # M/=/X
+                        qp += ln
+                    elif op == 4:  # S
+                        feats.append((ord("S"), qp, ln))
+                        qp += ln
+                    elif op == 1:  # I
+                        feats.append((ord("I"), qp, ln))
+                        qp += ln
+                    elif op == 2:  # D
+                        feats.append((ord("D"), qp, ln))
+                    elif op == 3:  # N
+                        feats.append((ord("N"), qp, ln))
+                    elif op == 5:  # H
+                        feats.append((ord("H"), qp, ln))
+                    elif op == 6:  # P
+                        feats.append((ord("P"), qp, ln))
+                ints["FN"].append(len(feats))
+                fprev = 0
+                for code, fp, ln in feats:
+                    ints["FC"].append(code)
+                    ints["FP"].append(fp - fprev)
+                    fprev = fp
+                    if code == ord("S"):
+                        sc_bytes += b"N" * ln + b"\x00"
+                    elif code == ord("I"):
+                        in_bytes += b"N" * ln + b"\x00"
+                    elif code == ord("D"):
+                        ints["DL"].append(ln)
+                    elif code == ord("N"):
+                        ints["RS"].append(ln)
+                    elif code == ord("H"):
+                        ints["HC"].append(ln)
+                    elif code == ord("P"):
+                        ints["PD"].append(ln)
+                ints["MQ"].append(r["mapq"])
+                ref_len = sum(ln for ln, op in r["cigar"]
+                              if op in (0, 2, 3, 7, 8))
+                max_end = max(max_end, r["pos"] + ref_len)
+        span = max_end - first_pos
+
+        comp = CompressionHeader(
+            rn_included=True, ap_delta=self._ap_delta, ref_required=False,
+            tag_dict=[[]],
+        )
+        for key, cid in ids.items():
+            if key == "RN":
+                comp.encodings[key] = Encoding(
+                    E_BYTE_ARRAY_STOP, {"stop": 0x09, "id": cid})
+            elif key in ("SC", "IN"):
+                comp.encodings[key] = Encoding(
+                    E_BYTE_ARRAY_STOP, {"stop": 0x00, "id": cid})
+            else:
+                comp.encodings[key] = Encoding(E_EXTERNAL, {"id": cid})
+
+        ext_payload: dict[int, bytes] = {}
+        for key, cid in ids.items():
+            if key == "RN":
+                ext_payload[cid] = bytes(names)
+            elif key == "SC":
+                ext_payload[cid] = bytes(sc_bytes)
+            elif key == "IN":
+                ext_payload[cid] = bytes(in_bytes)
+            else:
+                ext_payload[cid] = b"".join(
+                    write_itf8(v) for v in ints[key]
+                )
+        used = [cid for cid, payload in ext_payload.items() if payload]
+
+        sl = SliceHeader(
+            ref_id, first_pos, span, len(recs), self._counter,
+            1 + len(used), list(used), -1, b"\x00" * 16,
+        )
+        blocks = write_block(M_RAW, CT_SLICE_HEADER, 0, sl.serialize())
+        blocks += write_block(M_RAW, CT_CORE, 0, b"")
+        for cid in used:
+            blocks += write_block(self._method, CT_EXTERNAL, cid,
+                                  ext_payload[cid])
+        comp_block = write_block(M_RAW, CT_COMP_HEADER, 0,
+                                 comp.serialize())
+        body = comp_block + blocks
+        container_off = self._fh.tell()
+        n_bases = sum(ints["RL"])
+        self._fh.write(ContainerHeader.build(
+            len(body), ref_id, first_pos, span, len(recs),
+            self._counter, n_bases, 2 + len(used), [len(comp_block)],
+        ))
+        self._fh.write(body)
+        self._offsets.append(
+            (ref_id, first_pos, span, container_off, len(comp_block))
+        )
+        self._counter += len(recs)
+
+    def close(self) -> None:
+        self._flush()
+        self._fh.write(EOF_CONTAINER)
+
+    def write_crai(self, path: str) -> None:
+        """Companion .crai (gzipped 6-column TSV, spec appendix)."""
+        with gzip.open(path, "wt") as fh:
+            for (seq, start, span, c_off, slice_off) in self._offsets:
+                fh.write(f"{seq}\t{start}\t{span}\t{c_off}\t"
+                         f"{slice_off}\t0\n")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
