@@ -359,6 +359,7 @@ class Block:
 
 
 def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
+    start = pos
     method = buf[pos]
     ctype = buf[pos + 1]
     pos += 2
@@ -368,11 +369,10 @@ def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
     raw = bytes(buf[pos:pos + csize])
     pos += csize
     want_crc = struct.unpack_from("<I", buf, pos)[0]
+    # CRC covers the block's bytes exactly as stored (a spec-legal
+    # non-minimal ITF8 must not be re-canonicalized before checking)
+    got_crc = zlib.crc32(bytes(buf[start:pos]))
     pos += 4
-    got_crc = zlib.crc32(
-        bytes([method, ctype]) + write_itf8(cid) + write_itf8(csize)
-        + write_itf8(rsize) + raw
-    )
     if got_crc != want_crc:
         raise ValueError("cram: block CRC mismatch")
     data = _decompress(method, raw, rsize)
@@ -553,8 +553,11 @@ class Decoder:
             self.hf_single = alphabet[0]
             return
         self.hf_single = None
-        # canonical codes: sort by (length, order of appearance)
-        order = sorted(range(len(alphabet)), key=lambda i: (lengths[i], i))
+        # canonical codes: sort by (code length, symbol value) — the
+        # spec/htslib tie-break; appearance order would swap codes for
+        # equal-length symbols listed out of order
+        order = sorted(range(len(alphabet)),
+                       key=lambda i: (lengths[i], alphabet[i]))
         code = 0
         prev_len = lengths[order[0]]
         table = {}
@@ -619,23 +622,6 @@ class Decoder:
 
 
 # --------------------------------------------- compression header
-
-# feature codes → which extra series they read
-FEATURE_EXTRA = {
-    ord("B"): ("BA", "QS1"),  # base + qual
-    ord("X"): ("BS",),        # substitution code
-    ord("I"): ("IN",),        # insertion bytes
-    ord("S"): ("SC",),        # soft clip bytes
-    ord("H"): ("HC",),        # hard clip len
-    ord("P"): ("PD",),        # pad len
-    ord("D"): ("DL",),        # deletion len
-    ord("N"): ("RS",),        # ref skip len
-    ord("i"): ("BA",),        # single inserted base
-    ord("b"): ("BB",),        # bases array
-    ord("q"): ("QQ",),        # quals array
-    ord("Q"): ("QS1",),       # single qual
-    ord("E"): (),
-}
 
 # in-read length the feature consumes (query) / reference length
 _Q_CONSUME = {ord("S"), ord("I"), ord("i")}
@@ -890,7 +876,10 @@ def decode_slice(comp: CompressionHeader, sl: SliceHeader,
         elif cf & CF_MATE_DOWNSTREAM:
             nf = dec("NF").read_int()
         tl = dec("TL").read_int()
-        if comp.tag_dict and 0 <= tl < len(comp.tag_dict):
+        if not (0 <= tl < max(len(comp.tag_dict), 1)):
+            # a bad index would silently desync every shared stream
+            raise ValueError(f"cram: tag-line index {tl} out of range")
+        if comp.tag_dict:
             for tag, typ in comp.tag_dict[tl]:
                 key = (ord(tag[0]) << 16) | (ord(tag[1]) << 8) | ord(typ)
                 td = tag_decs.get(key)
@@ -1094,9 +1083,12 @@ class CramFile:
                     names.append(nm)
                     lens.append(ln)
         self.header = BamHeader(text, names, lens)
+        import threading
+
         self._first_data_container = pos + hdr.length
         self._crai = None
         self._all_records = None  # no-.crai fallback decode cache
+        self._cache_lock = threading.Lock()
         if crai_path:
             self._crai = _load_crai_entries(crai_path)
 
@@ -1165,16 +1157,19 @@ class CramFile:
         else:
             # no .crai: decode the whole file ONCE and answer every
             # region from the cache (a sharded whole-genome run would
-            # otherwise re-decode the file per region)
-            if self._all_records is None:
-                import logging
+            # otherwise re-decode the file per region); shard threads
+            # share the handle, so the fill is locked
+            with self._cache_lock:
+                if self._all_records is None:
+                    import logging
 
-                if tid is not None:
-                    logging.getLogger("goleft-tpu.cram").warning(
-                        "no .crai alongside CRAM — region queries fall "
-                        "back to one full-file decode held in memory"
-                    )
-                self._all_records = list(self.records())
+                    if tid is not None:
+                        logging.getLogger("goleft-tpu.cram").warning(
+                            "no .crai alongside CRAM — region queries "
+                            "fall back to one full-file decode held in "
+                            "memory"
+                        )
+                    self._all_records = list(self.records())
             recs = self._all_records
         return _records_to_columns(recs, tid, start, e)
 

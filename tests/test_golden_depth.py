@@ -1,0 +1,140 @@
+"""Hand-derived golden conformance for the depth pipeline.
+
+See tests/golden/README.md: the expected outputs were computed by hand
+from published samtools/goleft semantics (mate overlap double-counting,
+-d cap, N/D/S/I CIGAR handling, flag filters, window tiling/clipping,
+class runs) and committed as files — they provably did not come from
+goleft_tpu code. This test builds the documented read list, runs the
+full `depth` CLI path, and requires byte-identical bed files.
+"""
+
+import os
+
+import pytest
+
+from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.io.bai import build_bai, write_bai
+from goleft_tpu.io.bam import BamWriter, parse_cigar
+from goleft_tpu.io.fai import write_fai
+
+from helpers import write_fasta
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden")
+
+REF_LEN = 2000
+
+# the exact read list documented in tests/golden/README.md
+READS = [
+    ("r0", 0, "100M", 60, 0),
+    ("r1", 50, "100M", 60, 0),
+    ("r2", 50, "100M", 0, 0),
+    ("r3", 120, "30M10D30M", 60, 0),
+    ("r4", 200, "20M60N20M", 60, 0),
+    ("r5", 300, "10S50M", 60, 0),
+    ("r6", 400, "50M", 60, 0x400),
+    ("r7", 400, "50M", 60, 0x100),
+    ("r8", 450, "50M", 60, 0x1 | 0x2),
+    ("r9", 470, "50M", 60, 0x1 | 0x2),
+]
+PILE = [(f"p{i:04d}", 600, "10M", 60, 0) for i in range(2510)]
+TAIL = [
+    ("r10", 800, "40M5I40M", 60, 0),
+    ("r11", 900, "30M20S", 60, 0),
+    ("r12", 1000, "50M", 60, 0x200),
+    ("r13", 1100, "50M", 60, 0x4),
+]
+
+
+def _build_fixture(tmp_path):
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * REF_LEN})
+    write_fai(fa)
+    p = str(tmp_path / "g.bam")
+    hdr = f"@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr1\tLN:{REF_LEN}\n"
+    with open(p, "wb") as fh:
+        with BamWriter(fh, hdr, ["chr1"], [REF_LEN]) as w:
+            for name, pos, cig, mq, fl in READS + PILE + TAIL:
+                w.write_record(0, pos, parse_cigar(cig), mapq=mq,
+                               flag=fl, name=name)
+    write_bai(build_bai(p), p + ".bai")
+    return fa, p
+
+
+def test_depth_matches_hand_derived_golden(tmp_path):
+    fa, bam = _build_fixture(tmp_path)
+    dp, cp = run_depth(bam, str(tmp_path / "out"), reference=fa,
+                       window=100, min_cov=4, mapq=1)
+    for got_path, want_name in (
+        (dp, "depth_w100.depth.bed"),
+        (cp, "depth_w100.callable.bed"),
+    ):
+        got = open(got_path).read()
+        want = open(os.path.join(GOLDEN, want_name)).read()
+        assert got == want, f"{want_name} diverged:\n{got[:400]}"
+
+
+def test_depth_excessive_coverage_golden(tmp_path):
+    """maxmeandepth=100 → cap 2600 (pile uncapped at 2510) and the pile
+    region classifies EXCESSIVE; window mean becomes 251 (README §2)."""
+    fa, bam = _build_fixture(tmp_path)
+    dp, cp = run_depth(bam, str(tmp_path / "out2"), reference=fa,
+                       window=100, min_cov=4, mapq=1,
+                       max_mean_depth=100)
+    depth_lines = open(dp).read().splitlines()
+    assert depth_lines[6] == "chr1\t600\t700\t251"
+    want = open(os.path.join(GOLDEN, "depth_w100.depth.bed")
+                ).read().splitlines()
+    assert depth_lines[:6] == want[:6] and depth_lines[7:] == want[7:]
+    call_lines = open(cp).read().splitlines()
+    assert "chr1\t600\t610\tEXCESSIVE_COVERAGE" in call_lines
+    want_c = open(os.path.join(GOLDEN, "depth_w100.callable.bed")
+                  ).read().splitlines()
+    assert [l for l in call_lines if "600\t610" not in l] == \
+        [l for l in want_c if "600\t610" not in l]
+
+
+def test_depth_window83_spot_values(tmp_path):
+    """Non-dividing window: absolute-aligned tiling, clipped final span,
+    hand-computed %.4g means (README final section)."""
+    fa, bam = _build_fixture(tmp_path)
+    dp, _ = run_depth(bam, str(tmp_path / "out3"), reference=fa,
+                      window=83, min_cov=4, mapq=1)
+    rows = {}
+    prev_end = 0
+    for line in open(dp):
+        c, s, e, m = line.rstrip("\n").split("\t")
+        s, e = int(s), int(e)
+        assert s == prev_end, "windows must tile exactly"
+        prev_end = e
+        rows[(s, e)] = m
+    assert prev_end == REF_LEN
+    assert rows[(0, 83)] == "1.398"
+    assert rows[(83, 166)] == "1.446"
+    assert rows[(332, 415)] == "0.2169"
+    assert rows[(1992, 2000)] == "0"
+
+
+@pytest.mark.parametrize("via_cram", [False, True])
+def test_golden_survives_container_format(tmp_path, via_cram):
+    """The same golden holds when the identical reads arrive via CRAM."""
+    if not via_cram:
+        pytest.skip("BAM covered by test_depth_matches_hand_derived_golden")
+    from goleft_tpu.io.cram import CramWriter
+
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * REF_LEN})
+    write_fai(fa)
+    p = str(tmp_path / "g.cram")
+    hdr = "@HD\tVN:1.6\tSO:coordinate\n"
+    with open(p, "wb") as fh:
+        with CramWriter(fh, hdr, ["chr1"], [REF_LEN],
+                        records_per_container=800) as w:
+            for name, pos, cig, mq, fl in READS + PILE + TAIL:
+                w.write_record(0, pos, parse_cigar(cig), mapq=mq,
+                               flag=fl, name=name)
+        w.write_crai(p + ".crai")
+    dp, cp = run_depth(p, str(tmp_path / "outc"), reference=fa,
+                       window=100, min_cov=4, mapq=1)
+    assert open(dp).read() == open(
+        os.path.join(GOLDEN, "depth_w100.depth.bed")).read()
+    assert open(cp).read() == open(
+        os.path.join(GOLDEN, "depth_w100.callable.bed")).read()
