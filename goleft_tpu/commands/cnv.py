@@ -65,11 +65,14 @@ def main(argv=None):
     p.add_argument("-p", "--processes", type=int, default=8)
     p.add_argument("--matrix-out", default=None,
                    help="also write the per-window CN matrix here")
+    p.add_argument("--engine", choices=("auto", "hybrid", "device"),
+                   default="auto",
+                   help="cohort matrix engine (see cohortdepth --engine)")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
     run_cnv(a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
             mapq=a.mapq, chrom=a.chrom, processes=a.processes,
-            matrix_out=a.matrix_out)
+            matrix_out=a.matrix_out, engine=a.engine)
 
 
 if __name__ == "__main__":

@@ -275,10 +275,13 @@ def run_depth(
                         retries=1, cache=rc, ordered=True),
         ):
             if res.error is not None:
-                # reference behavior: failed shard reports, others keep
-                # going, nonzero exit at the end (depth/depth.go:395-399)
-                print(f"ERROR with shard {c}:{s}-{e}: {res.error}",
-                      file=sys.stderr)
+                # reference behavior: failed shard reports in red, others
+                # keep going, nonzero exit at the end
+                # (depth/depth.go:395-399, fatih/color banner)
+                msg = f"ERROR with shard {c}:{s}-{e}: {res.error}"
+                if sys.stderr.isatty():
+                    msg = f"\033[31m{msg}\033[0m"
+                print(msg, file=sys.stderr)
                 n_failed += 1
                 continue
             starts, ends, sums, cls = res.value
