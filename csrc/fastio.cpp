@@ -12,7 +12,9 @@
 // pure-Python codecs on any failure).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <locale.h>
 #include <zlib.h>
 
 // libdeflate (when present at build time) inflates BGZF blocks 2-3x
@@ -394,6 +396,70 @@ long format_matrix_rows(const char* chrom, long chrom_len,
             out[w++] = '\t';
             w += itoa_u(vals[c * n_rows + r], out + w);
         }
+        out[w++] = '\n';
+    }
+    return w;
+}
+
+// Format depth bed rows "chrom\tstart\tend\t%.4g\n" (matches Python's
+// f"{m:.4g}": printf %g semantics, pinned to the C numeric locale so a
+// host application's setlocale() can't change the decimal separator).
+// Returns bytes or -1.
+long format_depth_rows(const char* chrom, long chrom_len,
+                       const int64_t* starts, const int64_t* ends,
+                       const double* means, long n, char* out,
+                       long out_cap) {
+    static locale_t c_loc = (locale_t)0;
+    if (c_loc == (locale_t)0)
+        c_loc = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+    locale_t old = c_loc != (locale_t)0 ? uselocale(c_loc) : (locale_t)0;
+    long w = 0;
+    for (long r = 0; r < n; r++) {
+        if (w + chrom_len + 2 * 21 + 40 > out_cap) {
+            w = -1;
+            break;
+        }
+        memcpy(out + w, chrom, chrom_len);
+        w += chrom_len;
+        out[w++] = '\t';
+        w += itoa_u(starts[r], out + w);
+        out[w++] = '\t';
+        w += itoa_u(ends[r], out + w);
+        out[w++] = '\t';
+        w += snprintf(out + w, 40, "%.4g", means[r]);
+        out[w++] = '\n';
+    }
+    if (old != (locale_t)0)
+        uselocale(old);
+    return w;
+}
+
+// Format callable-class rows "chrom\tstart\tend\tNAME\n" for class ids
+// 0..3 (NO/LOW/CALLABLE/EXCESSIVE — ops/coverage.py CLASS_NAMES order).
+static const char* CLASS_NAMES_C[4] = {
+    "NO_COVERAGE", "LOW_COVERAGE", "CALLABLE", "EXCESSIVE_COVERAGE",
+};
+
+long format_class_rows(const char* chrom, long chrom_len,
+                       const int64_t* starts, const int64_t* ends,
+                       const uint8_t* cls, long n, char* out,
+                       long out_cap) {
+    for (long r = 0; r < n; r++)
+        if (cls[r] > 3) return -2;
+    long w = 0;
+    for (long r = 0; r < n; r++) {
+        const char* nm = CLASS_NAMES_C[cls[r]];
+        long nl = (long)strlen(nm);
+        if (w + chrom_len + 2 * 21 + nl + 4 > out_cap) return -1;
+        memcpy(out + w, chrom, chrom_len);
+        w += chrom_len;
+        out[w++] = '\t';
+        w += itoa_u(starts[r], out + w);
+        out[w++] = '\t';
+        w += itoa_u(ends[r], out + w);
+        out[w++] = '\t';
+        memcpy(out + w, nm, nl);
+        w += nl;
         out[w++] = '\n';
     }
     return w;

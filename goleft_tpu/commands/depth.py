@@ -40,7 +40,8 @@ from ..ops.coverage import (
     CLASS_NAMES,
 )
 from ..ops.depth_pipeline import (
-    shard_depth_pipeline, shard_depth_pipeline_packed,
+    shard_depth_pipeline_cls_packed,
+    shard_depth_pipeline_packed_cls_packed, unpack_cls_2bit,
 )
 from ..utils.xopen import xopen
 
@@ -146,7 +147,7 @@ class DepthEngine:
             ll = np.zeros(b, np.uint16)
             dd[:n_ent] = d
             ll[:n_ent] = l
-            sums, cls, _ = shard_depth_pipeline_packed(
+            sums, cls_p = shard_depth_pipeline_packed_cls_packed(
                 dd, ll, base, *scalars,
                 length=self.length, window=self.w_eff,
             )
@@ -159,14 +160,17 @@ class DepthEngine:
                 seg_s[:n] = cols.seg_start
                 seg_e[:n] = cols.seg_end
                 keep[:n] = kp
-            sums, cls, _ = shard_depth_pipeline(
+            sums, cls_p = shard_depth_pipeline_cls_packed(
                 seg_s, seg_e, keep, *scalars,
                 length=self.length, window=self.w_eff,
             )
         starts, ends, _, _ = window_bounds(start, end, self.window)
         n_win = len(starts)
         sums = np.asarray(sums)[:n_win]
-        cls = np.asarray(cls)[start - w0 : end - w0]
+        # classes come back 2-bit packed (1/4 the D2H bytes) and unpack
+        # on host with vectorized shifts
+        cls = unpack_cls_2bit(np.asarray(cls_p), self.length)
+        cls = cls[start - w0 : end - w0]
         return starts, ends, sums, cls
 
 
@@ -174,11 +178,20 @@ def write_shard_output(
     chrom: str, starts, ends, sums, cls, region_start: int,
     depth_out, call_out, fa: Faidx | None,
 ) -> None:
+    from ..io import native
+
     spans = ends - starts
     means = sums / spans
+    use_native = native.get_lib() is not None
     if fa is None:
-        for s, e, m in zip(starts, ends, means):
-            depth_out.write(f"{chrom}\t{s}\t{e}\t{m:.4g}\n")
+        if use_native:
+            depth_out.write(
+                native.format_depth_rows(chrom, starts, ends, means)
+                .decode("ascii")
+            )
+        else:
+            for s, e, m in zip(starts, ends, means):
+                depth_out.write(f"{chrom}\t{s}\t{e}\t{m:.4g}\n")
     else:
         for s, e, m in zip(starts, ends, means):
             st = fa.window_stats(chrom, int(s), int(e))
@@ -187,11 +200,19 @@ def write_shard_output(
                 f"\t{st['gc']:.3g}\t{st['cpg']:.3g}\t{st['masked']:.3g}\n"
             )
     rs, re_, rv = run_length_encode(cls)
-    for s, e, v in zip(rs, re_, rv):
+    if use_native:
         call_out.write(
-            f"{chrom}\t{s + region_start}\t{e + region_start}\t"
-            f"{CLASS_NAMES[v]}\n"
+            native.format_class_rows(
+                chrom, rs.astype(np.int64) + region_start,
+                re_.astype(np.int64) + region_start, rv,
+            ).decode("ascii")
         )
+    else:
+        for s, e, v in zip(rs, re_, rv):
+            call_out.write(
+                f"{chrom}\t{s + region_start}\t{e + region_start}\t"
+                f"{CLASS_NAMES[v]}\n"
+            )
 
 
 def run_depth(

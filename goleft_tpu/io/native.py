@@ -79,6 +79,8 @@ def get_lib() -> ctypes.CDLL | None:
         lib.bam_decode.restype = ctypes.c_long
         lib.bam_window_reduce.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
+        lib.format_depth_rows.restype = ctypes.c_long
+        lib.format_class_rows.restype = ctypes.c_long
         _lib = lib
         return _lib
 
@@ -270,6 +272,56 @@ def format_matrix_rows(chrom: str, starts: np.ndarray, ends: np.ndarray,
     )
     if w < 0:
         raise ValueError("format_matrix_rows: capacity exceeded")
+    return out[:w].tobytes()
+
+
+def format_depth_rows(chrom: str, starts: np.ndarray, ends: np.ndarray,
+                      means: np.ndarray) -> bytes | None:
+    """'chrom\\tstart\\tend\\t%.4g' rows; None without native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    means = np.ascontiguousarray(means, dtype=np.float64)
+    cb = chrom.encode()
+    n = len(starts)
+    cap = n * (len(cb) + 2 * 21 + 44) + 16
+    out = np.empty(cap, dtype=np.uint8)
+    w = lib.format_depth_rows(
+        ctypes.c_char_p(cb), ctypes.c_long(len(cb)),
+        _ptr(starts, ctypes.c_int64), _ptr(ends, ctypes.c_int64),
+        _ptr(means, ctypes.c_double), ctypes.c_long(n),
+        _ptr(out, ctypes.c_char), ctypes.c_long(cap),
+    )
+    if w < 0:
+        raise ValueError("format_depth_rows: capacity exceeded")
+    return out[:w].tobytes()
+
+
+def format_class_rows(chrom: str, starts: np.ndarray, ends: np.ndarray,
+                      cls: np.ndarray) -> bytes | None:
+    """'chrom\\tstart\\tend\\tCLASS_NAME' rows; None without native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    ends = np.ascontiguousarray(ends, dtype=np.int64)
+    cls = np.ascontiguousarray(cls, dtype=np.uint8)
+    cb = chrom.encode()
+    n = len(starts)
+    cap = n * (len(cb) + 2 * 21 + 24) + 16
+    out = np.empty(cap, dtype=np.uint8)
+    w = lib.format_class_rows(
+        ctypes.c_char_p(cb), ctypes.c_long(len(cb)),
+        _ptr(starts, ctypes.c_int64), _ptr(ends, ctypes.c_int64),
+        _ptr(cls, ctypes.c_uint8), ctypes.c_long(n),
+        _ptr(out, ctypes.c_char), ctypes.c_long(cap),
+    )
+    if w == -2:
+        raise ValueError("format_class_rows: class id out of range")
+    if w < 0:
+        raise ValueError("format_class_rows: capacity exceeded")
     return out[:w].tobytes()
 
 

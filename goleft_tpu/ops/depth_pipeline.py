@@ -76,6 +76,78 @@ def shard_depth_pipeline(
                           max_mean_depth, length, window)
 
 
+def _pack_cls_2bit(cls: jax.Array, length: int) -> jax.Array:
+    """int8 classes (values 0..3) → 2-bit packed uint8, little-end-first
+    within each byte — quarters the device→host transfer of the
+    per-base class array (the depth CLI's D2H bottleneck on slow links).
+    """
+    pad = (-length) % 4
+    if pad:
+        cls = jnp.concatenate([cls, jnp.zeros(pad, cls.dtype)])
+    c4 = cls.reshape(-1, 4).astype(jnp.uint8)
+    return (c4[:, 0] | (c4[:, 1] << 2) | (c4[:, 2] << 4)
+            | (c4[:, 3] << 6))
+
+
+def unpack_cls_2bit(packed: "np.ndarray", length: int):
+    """Host inverse of _pack_cls_2bit → int8 (length,)."""
+    import numpy as np
+
+    bits = (packed[:, None] >> np.array([0, 2, 4, 6], np.uint8)) & 3
+    return bits.reshape(-1)[:length].astype(np.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("length", "window"))
+def shard_depth_pipeline_cls_packed(
+    seg_start: jax.Array,
+    seg_end: jax.Array,
+    keep: jax.Array,
+    w0: jax.Array,
+    region_start: jax.Array,
+    region_end: jax.Array,
+    depth_cap: jax.Array,
+    min_cov: jax.Array,
+    max_mean_depth: jax.Array,
+    length: int,
+    window: int,
+) -> tuple[jax.Array, jax.Array]:
+    """(window_sums, 2-bit packed classes) — the depth CLI's fetch set."""
+    sums, cls, _ = _pipeline_body(seg_start, seg_end, keep, w0,
+                                  region_start, region_end, depth_cap,
+                                  min_cov, max_mean_depth, length, window)
+    return sums, _pack_cls_2bit(cls, length)
+
+
+def _unpack_wire(deltas, lens, base):
+    """u16 wire (sorted start deltas + lengths) → absolute endpoints +
+    keep mask; zero-length entries are padding/gap fillers."""
+    seg_start = base + jnp.cumsum(deltas.astype(jnp.int32))
+    lens32 = lens.astype(jnp.int32)
+    return seg_start, seg_start + lens32, lens32 > 0
+
+
+@functools.partial(jax.jit, static_argnames=("length", "window"))
+def shard_depth_pipeline_packed_cls_packed(
+    deltas: jax.Array,
+    lens: jax.Array,
+    base: jax.Array,
+    w0: jax.Array,
+    region_start: jax.Array,
+    region_end: jax.Array,
+    depth_cap: jax.Array,
+    min_cov: jax.Array,
+    max_mean_depth: jax.Array,
+    length: int,
+    window: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Packed u16 wire in, 2-bit packed classes out."""
+    s, e, keep = _unpack_wire(deltas, lens, base)
+    sums, cls, _ = _pipeline_body(s, e, keep, w0, region_start,
+                                  region_end, depth_cap, min_cov,
+                                  max_mean_depth, length, window)
+    return sums, _pack_cls_2bit(cls, length)
+
+
 @functools.partial(jax.jit, static_argnames=("length", "window"))
 def shard_depth_pipeline_packed(
     deltas: jax.Array,
@@ -94,10 +166,8 @@ def shard_depth_pipeline_packed(
     instead of 9: sorted start deltas + lengths, see
     ops/coverage.py::pack_segments_u16) — host→device traffic halves and
     the absolute endpoints are reconstructed on device with one cumsum.
-    Zero-length entries are padding/gap fillers (keep=False).
     """
-    seg_start = base + jnp.cumsum(deltas.astype(jnp.int32))
-    lens32 = lens.astype(jnp.int32)
-    return _pipeline_body(seg_start, seg_start + lens32, lens32 > 0,
-                          w0, region_start, region_end, depth_cap,
-                          min_cov, max_mean_depth, length, window)
+    s, e, keep = _unpack_wire(deltas, lens, base)
+    return _pipeline_body(s, e, keep, w0, region_start, region_end,
+                          depth_cap, min_cov, max_mean_depth, length,
+                          window)

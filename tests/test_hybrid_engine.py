@@ -140,3 +140,52 @@ def test_packed_pipeline_matches_unpacked():
     # ultra-long segment -> packer declines (caller falls back)
     e2 = e.copy(); e2[5] = s[5] + 100_000
     assert pack_segments_u16(s, e2, np.ones(n, bool)) is None
+
+
+@needs_native
+def test_native_depth_row_formatting_matches_python():
+    rng = np.random.default_rng(33)
+    n = 500
+    starts = (np.arange(n, dtype=np.int64)) * 83
+    ends = starts + 83
+    ends[-1] = starts[-1] + 7
+    # means spanning the %.4g regimes: 0, tiny, fractional, large, exp
+    means = np.concatenate([
+        np.zeros(20),
+        rng.random(200) * 5,
+        rng.random(200) * 5000,
+        10 ** rng.uniform(4, 9, size=70),
+        np.array([1e6, 0.1, 250.0, 1 / 3, 2500.0, 123456.789,
+                  0.000123456, 9.9995, 9999.5, 1234.5]),
+    ])
+    got = native.format_depth_rows("chrX", starts, ends, means)
+    want = "".join(
+        f"chrX\t{starts[i]}\t{ends[i]}\t{means[i]:.4g}\n"
+        for i in range(n)
+    ).encode()
+    assert got == want
+
+    cls = rng.integers(0, 4, size=40).astype(np.uint8)
+    cs = np.arange(40, dtype=np.int64) * 10
+    ce = cs + 10
+    from goleft_tpu.ops.coverage import CLASS_NAMES
+    gotc = native.format_class_rows("chr2", cs, ce, cls)
+    wantc = "".join(
+        f"chr2\t{cs[i]}\t{ce[i]}\t{CLASS_NAMES[cls[i]]}\n"
+        for i in range(40)
+    ).encode()
+    assert gotc == wantc
+
+
+def test_cls_2bit_pack_roundtrip():
+    import jax.numpy as jnp
+    from goleft_tpu.ops.depth_pipeline import (
+        _pack_cls_2bit, unpack_cls_2bit,
+    )
+
+    rng = np.random.default_rng(34)
+    for length in (4, 7, 1024, 8301):
+        cls = rng.integers(0, 4, size=length).astype(np.int8)
+        packed = np.asarray(_pack_cls_2bit(jnp.asarray(cls), length))
+        back = unpack_cls_2bit(packed, length)
+        np.testing.assert_array_equal(back, cls)
