@@ -409,9 +409,8 @@ long format_depth_rows(const char* chrom, long chrom_len,
                        const int64_t* starts, const int64_t* ends,
                        const double* means, long n, char* out,
                        long out_cap) {
-    static locale_t c_loc = (locale_t)0;
-    if (c_loc == (locale_t)0)
-        c_loc = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+    // magic static: thread-safe one-time init (callers run GIL-free)
+    static locale_t c_loc = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
     locale_t old = c_loc != (locale_t)0 ? uselocale(c_loc) : (locale_t)0;
     long w = 0;
     for (long r = 0; r < n; r++) {

@@ -21,6 +21,8 @@ from goleft_tpu.io.bam import BamFile, ReadColumns
 from helpers import write_bam, random_reads
 from test_covstats_oracle import make_cols, oracle_bam_stats
 
+pytestmark = pytest.mark.native_io
+
 needs_native = pytest.mark.skipif(
     native.get_lib() is None, reason="native toolchain unavailable"
 )

@@ -87,6 +87,7 @@ def test_cohortdepth_engines_identical(tmp_path):
 
 
 @needs_native
+@pytest.mark.native_io
 def test_format_matrix_rows_matches_python():
     rng = np.random.default_rng(30)
     n_rows, n_cols = 137, 7
@@ -143,6 +144,7 @@ def test_packed_pipeline_matches_unpacked():
 
 
 @needs_native
+@pytest.mark.native_io
 def test_native_depth_row_formatting_matches_python():
     rng = np.random.default_rng(33)
     n = 500
@@ -189,3 +191,55 @@ def test_cls_2bit_pack_roundtrip():
         packed = np.asarray(_pack_cls_2bit(jnp.asarray(cls), length))
         back = unpack_cls_2bit(packed, length)
         np.testing.assert_array_equal(back, cls)
+
+
+@needs_native
+def test_cohortdepth_engines_multichrom_divergent_dicts(tmp_path):
+    """Two chromosomes; one sample's header lacks chr2 entirely (per-
+    sample tid maps) — both engines must still agree byte-for-byte and
+    the chr2 column for the missing sample must be all zeros."""
+    rng = np.random.default_rng(41)
+    lens = {"chr1": 60_000, "chr2": 35_000}
+    fa = write_fasta(str(tmp_path / "r.fa"),
+                     {k: "A" * v for k, v in lens.items()})
+    write_fai(fa)
+    bams = []
+    for i in range(4):
+        if i == 2:  # chr1-only reference dictionary
+            reads = random_reads(rng, 1200, 0, lens["chr1"])
+            hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+                   f"@SQ\tSN:chr1\tLN:{lens['chr1']}\n"
+                   f"@RG\tID:r\tSM:m{i}\n")
+            p = str(tmp_path / f"m{i}.bam")
+            write_bam_and_bai(p, reads, ref_names=("chr1",),
+                              ref_lens=(lens["chr1"],), header_text=hdr)
+        else:
+            reads = random_reads(rng, 1200, 0, lens["chr1"]) + \
+                random_reads(rng, 600, 1, lens["chr2"])
+            hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+                   f"@SQ\tSN:chr1\tLN:{lens['chr1']}\n"
+                   f"@SQ\tSN:chr2\tLN:{lens['chr2']}\n"
+                   f"@RG\tID:r\tSM:m{i}\n")
+            p = str(tmp_path / f"m{i}.bam")
+            write_bam_and_bai(p, reads,
+                              ref_names=("chr1", "chr2"),
+                              ref_lens=(lens["chr1"], lens["chr2"]),
+                              header_text=hdr)
+        bams.append(p)
+    outs = {}
+    for eng in ("hybrid", "device"):
+        buf = io.StringIO()
+        run_cohortdepth(bams, reference=fa, window=500, out=buf,
+                        engine=eng)
+        outs[eng] = buf.getvalue()
+    assert outs["hybrid"] == outs["device"]
+    lines = outs["hybrid"].splitlines()
+    n_chr1 = lens["chr1"] // 500
+    n_chr2 = lens["chr2"] // 500
+    assert len(lines) == 1 + n_chr1 + n_chr2
+    # chr2 rows: sample m2's column (index 3+2) must be 0
+    for ln in lines[1 + n_chr1:]:
+        t = ln.split("\t")
+        assert t[0] == "chr2" and t[5] == "0", ln
+    # other samples have nonzero chr2 coverage somewhere
+    assert any(ln.split("\t")[4] != "0" for ln in lines[1 + n_chr1:])

@@ -8,6 +8,8 @@ from goleft_tpu.io.bam import BamFile, open_bam_file
 from goleft_tpu.io.bai import build_bai, query_voffset
 from helpers import write_bam_and_bai, random_reads
 
+pytestmark = pytest.mark.native_io
+
 needs_native = pytest.mark.skipif(
     native.get_lib() is None, reason="native toolchain unavailable"
 )

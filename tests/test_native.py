@@ -16,6 +16,7 @@ needs_native = pytest.mark.skipif(
 
 
 @needs_native
+@pytest.mark.native_io
 def test_bgzf_scan_and_inflate(tmp_path):
     rng = np.random.default_rng(0)
     p = str(tmp_path / "t.bam")
@@ -30,6 +31,7 @@ def test_bgzf_scan_and_inflate(tmp_path):
 
 
 @needs_native
+@pytest.mark.native_io
 def test_native_decode_matches_python(tmp_path):
     reads = [
         (0, 100, "100M", 60, 0),
@@ -52,6 +54,7 @@ def test_native_decode_matches_python(tmp_path):
 
 
 @needs_native
+@pytest.mark.native_io
 def test_native_region_decode(tmp_path):
     rng = np.random.default_rng(1)
     reads = random_reads(rng, 2000, 0, 200_000)
@@ -71,6 +74,7 @@ def test_native_region_decode(tmp_path):
     assert nat.n_reads > 0
 
 
+@pytest.mark.native_io
 def test_open_bam_fallback(tmp_path, monkeypatch):
     rng = np.random.default_rng(2)
     p = str(tmp_path / "t.bam")
@@ -110,3 +114,41 @@ def test_depth_cli_with_native(tmp_path):
     assert open(d1).read().replace("nat", "") == \
         open(d2).read().replace("pyf", "")
     assert open(c1).read() == open(c2).read()
+
+
+@needs_native
+@pytest.mark.native_io
+def test_window_reduce_numpy_oracle(tmp_path):
+    """Fused C++ decode+window-reduce vs a numpy transcription of the
+    same math (no jax — runs under the ASan target)."""
+    rng = np.random.default_rng(55)
+    L = 50_000
+    reads = []
+    for s in np.sort(rng.integers(0, L - 300, size=1500)):
+        cig = rng.choice(["100M", "40M20D40M", "10S90M", "25M5I70M"])
+        mq = int(rng.integers(0, 61))
+        fl = int(rng.choice([0, 0x400, 0x100]))
+        reads.append((0, int(s), cig, mq, fl))
+    p = str(tmp_path / "wr.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(L,))
+    bf = BamFile.from_file(p, lazy=True)
+    rs, re_, w0, window, cap, mapq = 7_003, 44_751, 7_000, 250, 30, 20
+    length = ((re_ - w0) + window - 1) // window * window
+    got = bf.window_reduce(0, rs, re_, w0, length, window, cap, mapq,
+                           0x704)
+    # numpy oracle over the pure-python decode
+    from goleft_tpu.io.bam import BamReader
+
+    cols = BamReader.from_file(p).read_columns(tid=0, start=rs, end=re_)
+    ok = (cols.mapq >= mapq) & ((cols.flag & 0x704) == 0)
+    keep = ok[cols.seg_read]
+    delta = np.zeros(length + 1, np.int64)
+    s = np.clip(np.maximum(cols.seg_start[keep], rs) - w0, 0, length)
+    e = np.clip(np.minimum(cols.seg_end[keep], re_) - w0, 0, length)
+    np.add.at(delta, s, 1)
+    np.add.at(delta, e, -1)
+    depth = np.minimum(np.cumsum(delta[:length]), cap)
+    pos = np.arange(length) + w0
+    depth = np.where((pos >= rs) & (pos < re_), depth, 0)
+    want = depth.reshape(-1, window).sum(axis=1)
+    np.testing.assert_array_equal(got, want)
