@@ -362,6 +362,61 @@ long bam_window_reduce(const uint8_t* body, long body_len, long offset,
     return nk;
 }
 
+// Scan a .bai: per reference, the bin-section byte range, linear-index
+// range, and stats-bin (0x924A) counts — without materializing per-bin
+// chunk lists (Python parses one reference's bins lazily if a region
+// query ever needs them; indexcov needs only intervals + stats, and the
+// pure-Python bin walk was ~0.7s per whole-genome index). Returns n_ref
+// or negative: -1 bad magic, -2 truncated, -3 over max_ref.
+long bai_scan(const uint8_t* data, long len, long max_ref,
+              int64_t* bins_start, int64_t* bins_end,
+              int64_t* n_intv_out, int64_t* intv_off,
+              int64_t* mapped, int64_t* unmapped) {
+    if (len < 8 || memcmp(data, "BAI\x01", 4) != 0) return -1;
+    long off = 4;
+    int32_t n_ref;
+    memcpy(&n_ref, data + off, 4);
+    off += 4;
+    if (n_ref < 0 || n_ref > max_ref) return -3;
+    for (long r = 0; r < n_ref; r++) {
+        if (off + 4 > len) return -2;
+        int32_t n_bin;
+        memcpy(&n_bin, data + off, 4);
+        off += 4;
+        if (n_bin < 0) return -2;
+        bins_start[r] = off;
+        mapped[r] = -1;
+        unmapped[r] = -1;
+        for (long b = 0; b < n_bin; b++) {
+            if (off + 8 > len) return -2;
+            uint32_t bno;
+            int32_t n_chunk;
+            memcpy(&bno, data + off, 4);
+            memcpy(&n_chunk, data + off + 4, 4);
+            off += 8;
+            if (n_chunk < 0 || off + 16L * n_chunk > len) return -2;
+            if (bno == 0x924A && n_chunk == 2) {
+                uint64_t m, u;
+                memcpy(&m, data + off + 16, 8);
+                memcpy(&u, data + off + 24, 8);
+                mapped[r] = (int64_t)m;
+                unmapped[r] = (int64_t)u;
+            }
+            off += 16L * n_chunk;
+        }
+        bins_end[r] = off;
+        if (off + 4 > len) return -2;
+        int32_t n_intv;
+        memcpy(&n_intv, data + off, 4);
+        off += 4;
+        if (n_intv < 0 || off + 8L * n_intv > len) return -2;
+        n_intv_out[r] = n_intv;
+        intv_off[r] = off;
+        off += 8L * n_intv;
+    }
+    return n_ref;
+}
+
 // Fast non-negative int64 → decimal; returns chars written.
 static inline long itoa_u(int64_t v, char* p) {
     char tmp[24];

@@ -27,12 +27,32 @@ TILE_SHIFT = 14
 STATS_DUMMY_BIN = 0x924A
 
 
-@dataclass
 class RefIndex:
-    bins: dict  # bin number -> list[(chunk_beg, chunk_end)] virtual offsets
-    intervals: np.ndarray  # uint64 linear-index voffsets
-    mapped: int  # -1 if no stats bin
-    unmapped: int
+    """One reference's index entries.
+
+    ``bins`` parse lazily: the region-query path (query_voffset) only
+    reads the linear index, and indexcov only needs intervals + stats —
+    eagerly materializing every bin's chunk list cost ~0.7s per
+    whole-genome .bai in Python (fatal at 500-index cohort scale).
+    """
+
+    __slots__ = ("intervals", "mapped", "unmapped", "_bins", "_raw")
+
+    def __init__(self, bins: dict | None, intervals: np.ndarray,
+                 mapped: int, unmapped: int, raw=None):
+        self.intervals = intervals  # uint64 linear-index voffsets
+        self.mapped = mapped  # -1 if no stats bin
+        self.unmapped = unmapped
+        self._bins = bins
+        self._raw = raw  # (data, start, end) byte range of the bin table
+
+    @property
+    def bins(self) -> dict:
+        """bin number -> list[(chunk_beg, chunk_end)] virtual offsets."""
+        if self._bins is None:
+            data, start, end = self._raw
+            self._bins = _parse_bins(data, start, end)[0]
+        return self._bins
 
 
 @dataclass
@@ -69,6 +89,26 @@ class BaiIndex:
         return r.mapped, r.unmapped
 
 
+def _parse_bins(data, start: int, end: int) -> tuple[dict, int, int]:
+    """Bin table bytes [start, end) → (bins dict, mapped, unmapped)."""
+    off = start
+    bins: dict = {}
+    mapped, unmapped = -1, -1
+    while off < end:
+        bno, n_chunk = struct.unpack_from("<Ii", data, off)
+        off += 8
+        chunks = np.frombuffer(
+            data, dtype="<u8", count=2 * n_chunk, offset=off
+        ).reshape(-1, 2)
+        off += 16 * n_chunk
+        if bno == STATS_DUMMY_BIN and n_chunk == 2:
+            mapped = int(chunks[1, 0])
+            unmapped = int(chunks[1, 1])
+        else:
+            bins[int(bno)] = [tuple(map(int, c)) for c in chunks]
+    return bins, mapped, unmapped
+
+
 def read_bai(path_or_bytes) -> BaiIndex:
     if isinstance(path_or_bytes, (bytes, bytearray)):
         data = bytes(path_or_bytes)
@@ -77,6 +117,34 @@ def read_bai(path_or_bytes) -> BaiIndex:
             data = fh.read()
     if data[:4] != BAI_MAGIC:
         raise ValueError("not a BAI file (bad magic)")
+
+    from . import native
+
+    # a negative scan result (truncated/corrupt) raises with a specific
+    # message — only lib-unavailability (None) falls back to pure Python
+    scan = native.bai_scan(data)
+    if scan is not None:
+        refs = []
+        last_end = 8
+        for r in range(len(scan["n_intv"])):
+            n_intv = int(scan["n_intv"][r])
+            ioff = int(scan["intv_off"][r])
+            intervals = np.frombuffer(
+                data, dtype="<u8", count=n_intv, offset=ioff
+            ).copy()
+            refs.append(RefIndex(
+                None, intervals, int(scan["mapped"][r]),
+                int(scan["unmapped"][r]),
+                raw=(data, int(scan["bins_start"][r]),
+                     int(scan["bins_end"][r])),
+            ))
+            last_end = ioff + 8 * n_intv
+        n_no_coor = 0
+        if last_end + 8 <= len(data):
+            (n_no_coor,) = struct.unpack_from("<Q", data, last_end)
+        return BaiIndex(refs, n_no_coor)
+
+    # pure-Python fallback: eager parse
     off = 4
     (n_ref,) = struct.unpack_from("<i", data, off)
     off += 4
@@ -84,20 +152,11 @@ def read_bai(path_or_bytes) -> BaiIndex:
     for _ in range(n_ref):
         (n_bin,) = struct.unpack_from("<i", data, off)
         off += 4
-        bins: dict = {}
-        mapped, unmapped = -1, -1
+        bins_start = off
         for _ in range(n_bin):
-            bno, n_chunk = struct.unpack_from("<Ii", data, off)
-            off += 8
-            chunks = np.frombuffer(
-                data, dtype="<u8", count=2 * n_chunk, offset=off
-            ).reshape(-1, 2)
-            off += 16 * n_chunk
-            if bno == STATS_DUMMY_BIN and n_chunk == 2:
-                mapped = int(chunks[1, 0])
-                unmapped = int(chunks[1, 1])
-            else:
-                bins[int(bno)] = [tuple(map(int, c)) for c in chunks]
+            _bno, n_chunk = struct.unpack_from("<Ii", data, off)
+            off += 8 + 16 * n_chunk
+        bins, mapped, unmapped = _parse_bins(data, bins_start, off)
         (n_intv,) = struct.unpack_from("<i", data, off)
         off += 4
         intervals = np.frombuffer(

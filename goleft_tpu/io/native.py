@@ -81,6 +81,7 @@ def get_lib() -> ctypes.CDLL | None:
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
+        lib.bai_scan.restype = ctypes.c_long
         _lib = lib
         return _lib
 
@@ -246,6 +247,34 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
         out["consumed"] = int(consumed.value)
         out["done"] = bool(done.value)
         return out
+
+
+def bai_scan(data):
+    """Single-pass .bai structure scan → dict of per-ref arrays
+    (bins_start, bins_end, n_intv, intv_off, mapped, unmapped), or None
+    without native. Negative returns raise with a specific message."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    if len(buf) < 8:
+        raise ValueError("bai: truncated or corrupt index (-2)")
+    # exact allocation: the header carries n_ref up front
+    max_ref = max(int(np.frombuffer(buf[4:8], "<i4")[0]), 0)
+    arrs = {k: np.empty(max_ref, np.int64)
+            for k in ("bins_start", "bins_end", "n_intv", "intv_off",
+                      "mapped", "unmapped")}
+    n = lib.bai_scan(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(max_ref),
+        *(_ptr(arrs[k], ctypes.c_int64)
+          for k in ("bins_start", "bins_end", "n_intv", "intv_off",
+                    "mapped", "unmapped")),
+    )
+    if n == -1:
+        raise ValueError("not a BAI file (bad magic)")
+    if n < 0:
+        raise ValueError(f"bai: truncated or corrupt index ({n})")
+    return {k: v[:n] for k, v in arrs.items()}
 
 
 def format_matrix_rows(chrom: str, starts: np.ndarray, ends: np.ndarray,

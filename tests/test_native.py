@@ -152,3 +152,30 @@ def test_window_reduce_numpy_oracle(tmp_path):
     depth = np.where((pos >= rs) & (pos < re_), depth, 0)
     want = depth.reshape(-1, window).sum(axis=1)
     np.testing.assert_array_equal(got, want)
+
+
+@needs_native
+@pytest.mark.native_io
+def test_bai_scan_matches_python_parse(tmp_path, monkeypatch):
+    """Native structure scan + lazy bins == eager pure-Python parse."""
+    rng = np.random.default_rng(66)
+    reads = random_reads(rng, 3000, 0, 90_000) + \
+        random_reads(rng, 800, 1, 45_000)
+    p = str(tmp_path / "b.bam")
+    write_bam_and_bai(p, reads)
+    from goleft_tpu.io.bai import read_bai
+
+    fast = read_bai(p + ".bai")
+    monkeypatch.setenv("GOLEFT_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    slow = read_bai(p + ".bai")
+    monkeypatch.setattr(native, "_tried", False)
+
+    assert len(fast.refs) == len(slow.refs)
+    assert fast.n_no_coor == slow.n_no_coor
+    for rf, rs in zip(fast.refs, slow.refs):
+        np.testing.assert_array_equal(rf.intervals, rs.intervals)
+        assert rf.mapped == rs.mapped
+        assert rf.unmapped == rs.unmapped
+        assert rf.bins == rs.bins  # triggers the lazy parse
