@@ -488,6 +488,91 @@ long format_depth_rows(const char* chrom, long chrom_len,
     return w;
 }
 
+// Float matrix rows "chrom\tstart\tend\t%.{prec}g...\n" with a validity
+// mask (invalid cells print "0" — shorter samples' missing tail bins,
+// indexcov.go:678-680). vals/valid are (n_cols, n_rows) col-major like
+// format_matrix_rows. Byte-identical to numpy's np.char.mod("%.3g").
+long format_float_matrix_rows(const char* chrom, long chrom_len,
+                              const int64_t* starts, const int64_t* ends,
+                              const double* vals, const uint8_t* valid,
+                              long n_rows, long n_cols, int prec,
+                              char* out, long out_cap) {
+    if (prec > 17) prec = 17;  // "%.17g" worst case fits the 33B budget
+    static locale_t c_loc3 = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+    locale_t old = c_loc3 != (locale_t)0 ? uselocale(c_loc3)
+                                         : (locale_t)0;
+    long w = 0;
+    for (long r = 0; r < n_rows; r++) {
+        if (w + chrom_len + 2 * 21 + n_cols * 34 + 2 > out_cap) {
+            w = -1;
+            break;
+        }
+        memcpy(out + w, chrom, chrom_len);
+        w += chrom_len;
+        out[w++] = '\t';
+        w += itoa_u(starts[r], out + w);
+        out[w++] = '\t';
+        w += itoa_u(ends[r], out + w);
+        for (long c = 0; c < n_cols; c++) {
+            out[w++] = '\t';
+            if (valid[c * n_rows + r])
+                w += snprintf(out + w, 33, "%.*g", prec,
+                              vals[c * n_rows + r]);
+            else
+                out[w++] = '0';
+        }
+        out[w++] = '\n';
+    }
+    if (old != (locale_t)0)
+        uselocale(old);
+    return w;
+}
+
+// Serialize chart point pairs as JSON: [{"x":..,"y":..},...] with %.*g
+// values (C locale). Non-finite values emit null (valid JSON; chart.js
+// skips them). The pure-Python path (round() per point + json.dumps)
+// costs ~7ns/char at whole-genome chart sizes — this is the report
+// writer's hot loop. Returns bytes written or -1 on capacity.
+long format_xy_json(const double* xs, const double* ys, long n,
+                    int xprec, int yprec, char* out, long out_cap) {
+    if (xprec > 17) xprec = 17;  // "%.17g" fits the 32B point budget
+    if (yprec > 17) yprec = 17;
+    static locale_t c_loc2 = newlocale(LC_NUMERIC_MASK, "C", (locale_t)0);
+    locale_t old = c_loc2 != (locale_t)0 ? uselocale(c_loc2)
+                                         : (locale_t)0;
+    long w = 0;
+    out[w++] = '[';
+    for (long i = 0; i < n; i++) {
+        if (w + 2 * 32 + 16 > out_cap) {
+            w = -1;
+            break;
+        }
+        if (i) out[w++] = ',';
+        memcpy(out + w, "{\"x\":", 5);
+        w += 5;
+        double x = xs[i], y = ys[i];
+        if (x == x && x - x == 0.0)
+            w += snprintf(out + w, 32, "%.*g", xprec, x);
+        else {
+            memcpy(out + w, "null", 4);
+            w += 4;
+        }
+        memcpy(out + w, ",\"y\":", 5);
+        w += 5;
+        if (y == y && y - y == 0.0)
+            w += snprintf(out + w, 32, "%.*g", yprec, y);
+        else {
+            memcpy(out + w, "null", 4);
+            w += 4;
+        }
+        out[w++] = '}';
+    }
+    if (w >= 0) out[w++] = ']';
+    if (old != (locale_t)0)
+        uselocale(old);
+    return w;
+}
+
 // Format callable-class rows "chrom\tstart\tend\tNAME\n" for class ids
 // 0..3 (NO/LOW/CALLABLE/EXCESSIVE — ops/coverage.py CLASS_NAMES order).
 static const char* CLASS_NAMES_C[4] = {

@@ -211,18 +211,37 @@ def run_indexcov(
             mat = np.asarray(ops.normalize_across_samples(mat, lengths))
             mat = np.where(valid, mat, 0.0)
 
-        counts = np.asarray(ops.counts_at_depth(mat, valid))
+        # one fused device call + ONE fetch per chromosome (ROC,
+        # counters, CN together — per-transfer latency dominates on
+        # slow links); empty chromosomes contribute nothing
+        rocs = chrom_counters = chrom_cn = None
+        if longest > 0:
+            packed = np.asarray(
+                ops.chrom_qc(mat, valid, np.int32(longest))
+            )
+            rocs, chrom_counters, chrom_cn = ops.unpack_chrom_qc(
+                packed, n_samples
+            )
 
         # bed.gz rows: longest sample defines row count; shorter samples
         # print 0 (indexcov.go:678-680, depthsFor :1038-1048).
-        # np.char.mod formats the whole block at C speed — the Python
-        # f-string loop dominated large-cohort runs.
+        # C++ formats the whole block (byte-identical to np.char.mod
+        # "%.3g", which itself replaced the Python f-string loop);
         # chunked so a big cohort's formatted block stays bounded in RAM
+        from ..io import native
+
+        use_native_fmt = native.get_lib() is not None
         for lo in range(0, longest, 2048):
             hi = min(lo + 2048, longest)
+            idx = np.arange(lo, hi, dtype=np.int64)
+            if use_native_fmt:
+                bed.write(native.format_float_matrix_rows(
+                    ref_name, idx * TILE, (idx + 1) * TILE,
+                    mat[:, lo:hi], valid[:, lo:hi],
+                ))
+                continue
             block = np.char.mod("%.3g", mat[:, lo:hi].T)
             block[~valid[:, lo:hi].T] = "0"
-            idx = np.arange(lo, hi, dtype=np.int64)
             starts_col = np.char.mod("%d", idx * TILE)
             ends_col = np.char.mod("%d", (idx + 1) * TILE)
             rows_txt = [
@@ -234,7 +253,7 @@ def run_indexcov(
 
         if is_sex:
             if longest > 0:
-                sexes[ref_name] = np.asarray(ops.get_cn(mat, valid))
+                sexes[ref_name] = chrom_cn
         else:
             # cap at MaxCN before quantization (indexcov.go:694-698);
             # missing tail bins quantize to 0
@@ -242,12 +261,11 @@ def run_indexcov(
             q = ops.quantize_depths(capped)
             q[~valid] = 0
             pca_blocks.append(q[:, :max(longest, 0)])
-            c = ops.bin_counters(mat, valid, np.int32(longest))
-            for k in counters:
-                counters[k] += np.asarray(c[k], dtype=np.int64)
+            if chrom_counters is not None:
+                for k in counters:
+                    counters[k] += chrom_counters[k]
 
         if longest > 0:
-            rocs = np.asarray(ops.counts_roc(counts))
             for i in range(ops.SLOTS):
                 cov = i / (ops.SLOTS * ops.SLOTS_MID)
                 roc_fh.write(

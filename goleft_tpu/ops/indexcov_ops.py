@@ -252,6 +252,46 @@ def pca_project(mat: jax.Array, k: int = 5) -> tuple[jax.Array, jax.Array]:
     return proj, frac[:k]
 
 
+@jax.jit
+def chrom_qc(depths: jax.Array, valid: jax.Array,
+             longest: jax.Array) -> jax.Array:
+    """One fused per-chromosome QC program returning ONE packed f32
+    vector: [rocs (S·SLOTS)] [in|out|hi|low (4·S)] [cn (S)].
+
+    The per-call device→host latency of a slow link dominates when ROC,
+    counters, and CN fetch separately (~6 round trips per chromosome);
+    this packs everything the host needs into a single transfer. All
+    values are integers (or f32 already) well under 2**24, so the f32
+    packing is exact.
+    """
+    counts = counts_at_depth(depths, valid)
+    rocs = counts_roc(counts)
+    cnt = bin_counters(depths, valid, longest)
+    cn = get_cn(depths, valid)
+    return jnp.concatenate([
+        rocs.ravel(),
+        cnt["in"].astype(jnp.float32),
+        cnt["out"].astype(jnp.float32),
+        cnt["hi"].astype(jnp.float32),
+        cnt["low"].astype(jnp.float32),
+        cn.astype(jnp.float32),
+    ])
+
+
+def unpack_chrom_qc(packed: np.ndarray, n_samples: int):
+    """Host split of chrom_qc's packed vector →
+    (rocs (S, SLOTS) f32, counters dict of int64 (S,), cn f32 (S,))."""
+    S = n_samples
+    rocs = packed[: S * SLOTS].reshape(S, SLOTS)
+    off = S * SLOTS
+    cnt = {}
+    for k in ("in", "out", "hi", "low"):
+        cnt[k] = packed[off:off + S].astype(np.int64)
+        off += S
+    cn = packed[off:off + S]
+    return rocs, cnt, cn
+
+
 def update_slopes(rocs: np.ndarray, scalar: float) -> np.ndarray:
     """Per-sample ROC drop between 1±0.15 scaled depth, chromosome-length
     weighted (indexcov.go:739-750). rocs: (n_samples, SLOTS)."""

@@ -54,25 +54,42 @@ def line_chart(
 
     series entries: {"label", "x": list, "y": list, optional "color"}.
     """
-    datasets = []
+    from ..io import native
+
+    dataset_parts = []
     for i, s in enumerate(series):
-        data = [
-            {"x": round(float(x), 4), "y": round(float(y), 4)}
-            for x, y in zip(s["x"], s["y"])
-        ]
-        datasets.append(
-            {
-                "label": s["label"],
-                "data": data,
-                "fill": False,
-                "pointRadius": 0,
-                "borderWidth": s.get("width", 0.75),
-                "borderColor": s.get("color", _color(i)),
-                "backgroundColor": s.get("color", _color(i)),
-                "steppedLine": stepped,
-                "pointHitRadius": 6,
-            }
-        )
+        meta = {
+            "label": s["label"],
+            "fill": False,
+            "pointRadius": 0,
+            "borderWidth": s.get("width", 0.75),
+            "borderColor": s.get("color", _color(i)),
+            "backgroundColor": s.get("color", _color(i)),
+            "steppedLine": stepped,
+            "pointHitRadius": 6,
+        }
+        # point serialization is the report writer's hot loop at
+        # whole-genome sizes — C++ formats the pair array directly; the
+        # Python fallback emits the SAME bytes (%.10g/%.5g, null for
+        # non-finite — json.dumps would write invalid NaN literals)
+        b = native.format_xy_json(s["x"], s["y"])
+        if b is not None:
+            data_json = b.decode("ascii")
+        else:
+            import math
+
+            def _pt(v, prec):
+                v = float(v)
+                return format(v, f".{prec}g") if math.isfinite(v) \
+                    else "null"
+
+            data_json = "[" + ",".join(
+                f'{{"x":{_pt(x, 10)},"y":{_pt(y, 5)}}}'
+                for x, y in zip(s["x"], s["y"])
+            ) + "]"
+        mjson = json.dumps(meta)
+        dataset_parts.append(mjson[:-1] + ',"data":' + data_json + "}")
+    datasets_json = "[" + ",".join(dataset_parts) + "]"
     opts = {
         "responsive": False,
         "animation": False,
@@ -104,7 +121,7 @@ def line_chart(
     )
     js = (
         f'new Chart(document.getElementById("{chart_id}").getContext("2d"),'
-        f'{{"type":"line","data":{{"datasets":{json.dumps(datasets)}}},'
+        f'{{"type":"line","data":{{"datasets":{datasets_json}}},'
         f'"options":{json.dumps(opts)}}});'
     )
     return div, js

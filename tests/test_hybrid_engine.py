@@ -243,3 +243,23 @@ def test_cohortdepth_engines_multichrom_divergent_dicts(tmp_path):
         assert t[0] == "chr2" and t[5] == "0", ln
     # other samples have nonzero chr2 coverage somewhere
     assert any(ln.split("\t")[4] != "0" for ln in lines[1 + n_chr1:])
+
+
+@needs_native
+@pytest.mark.native_io
+def test_format_xy_json_valid_and_close():
+    import json as _json
+
+    rng = np.random.default_rng(77)
+    x = np.concatenate([rng.uniform(0, 2.5e8, 500), [0.0, 1e-7, 3.0]])
+    y = np.concatenate([rng.uniform(0, 50, 500), [np.nan, np.inf, 2.5]])
+    out = native.format_xy_json(x, y)
+    pts = _json.loads(out)
+    assert len(pts) == len(x)
+    for i, p in enumerate(pts):
+        assert abs(p["x"] - x[i]) <= max(1e-9 * abs(x[i]), 1e-9)
+        if np.isfinite(y[i]):
+            # %.5g: half-step in the 5th significant digit
+            assert abs(p["y"] - y[i]) <= max(5.1e-5 * abs(y[i]), 1e-9)
+        else:
+            assert p["y"] is None
