@@ -266,14 +266,16 @@ def run_indexcov(
                     counters[k] += chrom_counters[k]
 
         if longest > 0:
-            for i in range(ops.SLOTS):
-                cov = i / (ops.SLOTS * ops.SLOTS_MID)
-                roc_fh.write(
-                    f"{ref_name}\t{cov:.2f}\t"
-                    + "\t".join("%.2f" % rocs[k, i]
-                                for k in range(n_samples))
-                    + "\n"
-                )
+            # one vectorized format pass for the whole ROC block
+            cov_col = np.char.mod(
+                "%.2f", np.arange(ops.SLOTS) / (ops.SLOTS * ops.SLOTS_MID)
+            )
+            cells = np.char.mod("%.2f", rocs.T)  # (SLOTS, S)
+            roc_fh.write("".join(
+                ref_name + "\t" + cov_col[i] + "\t"
+                + "\t".join(cells[i]) + "\n"
+                for i in range(ops.SLOTS)
+            ))
             if (include_gl or not ref_name.startswith("GL")) and longest > 2:
                 if not is_sex and longest > 100:
                     slopes += ops.update_slopes(rocs, ref_len / 1e6)

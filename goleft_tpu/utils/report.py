@@ -211,6 +211,13 @@ def save_png(path: str, series: list[dict], xlabel: str, ylabel: str,
     for i, s in enumerate(series):
         x = s["x"][::subsample]
         y = s["y"][::subsample]
+        # a 480-pixel-wide panel cannot show more than ~2500 distinct
+        # steps; cap the vertex count so whole-genome renders don't pay
+        # matplotlib path costs for invisible detail
+        if len(x) > 2500:
+            step = (len(x) + 2499) // 2500
+            x = x[::step]
+            y = y[::step]
         if kind == "line":
             ax.step(x, y, lw=0.5, where="post")
         else:
