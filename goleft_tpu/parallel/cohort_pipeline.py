@@ -19,10 +19,15 @@ from ..models.emdepth import em_depth_batch, cn_batch
 from .sharded_coverage import sharded_depth_fn
 
 
-def build_cohort_step(mesh: Mesh, shard_len: int, window: int):
+def build_cohort_step(mesh: Mesh, shard_len: int, window: int,
+                      carry_mode: str = "all_gather"):
     """Returns jitted fn(seg_s, seg_e, keep) → dict(depth, wmeans, lambdas,
-    cn). Input arrays (S, n_seq*per) laid out for P('data','seq')."""
-    coverage = sharded_depth_fn(mesh, shard_len, window)
+    cn). Input arrays (S, n_seq*per) laid out for P('data','seq').
+    ``carry_mode`` selects the inter-shard prefix collective (see
+    sharded_depth_fn): all_gather for small seq axes, the log-step
+    ppermute scan for large meshes."""
+    coverage = sharded_depth_fn(mesh, shard_len, window,
+                                carry_mode=carry_mode)
 
     def step(seg_s, seg_e, keep):
         depth, wsums = coverage(seg_s, seg_e, keep)
