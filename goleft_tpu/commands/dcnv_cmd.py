@@ -19,7 +19,8 @@ from ..models.dcnv import gc_debias_pipeline
 from .emdepth_cmd import read_matrix
 
 
-def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None):
+def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None,
+             plot_prefix: str | None = None):
     out = out or sys.stdout
     chroms, starts, ends, depths, samples = read_matrix(matrix_path)
     fa = Faidx(fasta)
@@ -32,6 +33,30 @@ def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None):
     for i in range(len(chroms)):
         vals = "\t".join(f"{v:.3f}" for v in norm[i])
         out.write(f"{chroms[i]}\t{starts[i]}\t{ends[i]}\t{vals}\n")
+    if plot_prefix:
+        # reference parity: per-chromosome scaled-coverage chart pages
+        # (dcnv.go:274-345 writes "<base>-depth-<chrom>.html" with a
+        # 0-2.5 y-axis, width thinning by cohort size, and its own
+        # color fn without the background-env check)
+        from ..utils.report import line_chart, write_page
+
+        width = 0.4 if len(samples) <= 30 else \
+            (0.3 if len(samples) <= 50 else 0.2)
+        for c in dict.fromkeys(chroms):  # unique, ordered
+            m = chroms == c
+            xs = starts[m].tolist()
+            sub = norm[m]
+            series = [
+                {"label": samples[k], "x": xs,
+                 "y": sub[:, k].tolist(), "width": width}
+                for k in range(len(samples))
+            ]
+            chart = line_chart(
+                f"dcnv_{c}", series, f"position on {c}",
+                "scaled coverage", y_max=2.5, per_sample=False,
+            )
+            write_page(f"{plot_prefix}-depth-{c}.html",
+                       f"dcnv depths {c}", [chart])
     return norm
 
 
@@ -43,9 +68,12 @@ def main(argv=None):
     p.add_argument("-f", "--fasta", required=True)
     p.add_argument("-w", "--window", type=int, default=9,
                    help="moving-median window (rows)")
+    p.add_argument("--plot", default=None, metavar="PREFIX",
+                   help="write <PREFIX>-depth-<chrom>.html chart pages "
+                        "(the reference prototype hardcodes 'dd')")
     p.add_argument("matrix")
     a = p.parse_args(argv)
-    run_dcnv(a.matrix, a.fasta, window=a.window)
+    run_dcnv(a.matrix, a.fasta, window=a.window, plot_prefix=a.plot)
 
 
 if __name__ == "__main__":

@@ -417,8 +417,10 @@ def _plot_depth_chrom(base, chrom, mat, lengths, names, interactive,
 
 def _plot_roc_chrom(base, chrom, rocs, names, write_png):
     x = [i / (ops.SLOTS * ops.SLOTS_MID) for i in range(ops.SLOTS)]
+    n_bg = report._n_backgrounds()  # plot.go:338-341 relabels them
     series = [
-        {"label": names[k], "x": x, "y": rocs[k].tolist()}
+        {"label": "background" if k < n_bg else names[k],
+         "x": x, "y": rocs[k].tolist()}
         for k in range(len(names))
     ]
     div, js = report.line_chart(
@@ -439,11 +441,14 @@ def _write_index_html(directory, base, name, sexes, counters, samples, pcs,
     charts = []
     keys = sorted(sexes)
     if len(keys) >= 2:
+        # background samples are excluded from the sex scatter entirely
+        # (plot.go:443-445)
+        bg = report._n_backgrounds()
         pts = [{
             "label": "samples",
-            "x": sexes[keys[0]].tolist(),
-            "y": sexes[keys[1]].tolist(),
-            "names": samples,
+            "x": sexes[keys[0]][bg:].tolist(),
+            "y": sexes[keys[1]][bg:].tolist(),
+            "names": samples[bg:],
         }]
         charts.append(report.scatter_chart(
             "sex", pts, f"inferred copy number for {keys[0]}",

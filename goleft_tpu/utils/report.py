@@ -34,6 +34,15 @@ nav a {{ margin-right: 12px; }}
 """
 
 
+def _n_backgrounds() -> int:
+    """INDEXCOV_N_BACKGROUNDS: the first n samples plot gray
+    (reference plot.go:85-96)."""
+    try:
+        return int(os.environ.get("INDEXCOV_N_BACKGROUNDS", "") or 0)
+    except ValueError:
+        return 0
+
+
 def _color(i: int, background: bool = False) -> str:
     if background:
         return "rgba(180,180,180,0.94)"
@@ -49,13 +58,18 @@ def line_chart(
     y_max: float | None = None,
     stepped: bool = True,
     legend: bool = True,
+    per_sample: bool = True,
 ) -> tuple[str, str]:
     """Return (div html, js) for a multi-series line chart.
 
     series entries: {"label", "x": list, "y": list, optional "color"}.
+    ``per_sample`` marks series as one-per-sample, which honors
+    INDEXCOV_N_BACKGROUNDS (first n gray — reference randomColor(i,
+    check=true), plot.go:98-107; scatter/group charts pass check=false).
     """
     from ..io import native
 
+    n_bg = _n_backgrounds() if per_sample else 0
     dataset_parts = []
     for i, s in enumerate(series):
         meta = {
@@ -63,8 +77,9 @@ def line_chart(
             "fill": False,
             "pointRadius": 0,
             "borderWidth": s.get("width", 0.75),
-            "borderColor": s.get("color", _color(i)),
-            "backgroundColor": s.get("color", _color(i)),
+            "borderColor": s.get("color", _color(i, background=i < n_bg)),
+            "backgroundColor": s.get("color",
+                                     _color(i, background=i < n_bg)),
             "steppedLine": stepped,
             "pointHitRadius": 6,
         }

@@ -136,3 +136,18 @@ def test_cli_dispatcher(capsys):
     for prog in PROGS:
         assert prog in err
     assert cli_main(["nope"]) == 1
+
+
+def test_dcnv_plot_pages(tmp_path, monkeypatch):
+    rng = np.random.default_rng(5)
+    n = 40
+    seqs = "".join("GCAT"[int(x) % 4] * 250 for x in rng.integers(0, 4, 4 * n))
+    fasta = write_fasta(str(tmp_path / "r.fa"), {"chr9": seqs[: n * 1000]})
+    starts = np.arange(n) * 1000
+    depths = rng.gamma(30, 1.0, size=(n, 2)).round(1)
+    p = str(tmp_path / "m.tsv")
+    _write_matrix(p, ["chr9"] * n, starts, starts + 1000, depths, ["a", "b"])
+    monkeypatch.chdir(tmp_path)
+    run_dcnv(p, fasta, out=io.StringIO(), plot_prefix="dd")
+    page = (tmp_path / "dd-depth-chr9.html").read_text()
+    assert "scaled depth" in page and "dcnv_chr9" in page

@@ -63,7 +63,9 @@ def test_rans_roundtrip(kind):
 
 
 def _twin_reads(rng, n=2500, ref_len=120_000):
-    """Read tuples exercising varied CIGARs, flags, mapqs."""
+    """Read tuples exercising varied CIGARs, flags, mapqs — including
+    placed-unmapped records (flag 0x4 with coordinates, as aligners
+    emit for unmapped mates)."""
     reads = []
     for s in np.sort(rng.integers(0, ref_len - 400, size=n)):
         cig = rng.choice([
@@ -71,7 +73,11 @@ def _twin_reads(rng, n=2500, ref_len=120_000):
             "5H95M", "20M3D30M2I48M", "80M20S",
         ])
         mq = int(rng.integers(0, 61))
-        fl = int(rng.choice([0, 0x10, 0x400, 0x100, 0x200, 0x1 | 0x2]))
+        fl = int(rng.choice([0, 0x10, 0x400, 0x100, 0x200, 0x1 | 0x2,
+                             0x4, 0x1 | 0x4]))
+        if fl & 0x4:
+            cig = ""  # placed-unmapped records carry CIGAR '*'
+            mq = 0  # and MAPQ 0 (CRAM stores no MQ series for them)
         reads.append((0, int(s), cig, mq, fl))
     return reads
 

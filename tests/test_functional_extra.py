@@ -102,3 +102,14 @@ def test_depth_cache_resume(tmp_path):
     d3, _ = run_depth(p, str(tmp_path / "c"), reference=fa, window=500,
                       mapq=50, cache_dir=cache)
     assert open(d3).read() != open(d1).read()
+
+
+def test_indexcov_n_backgrounds_env(monkeypatch):
+    from goleft_tpu.utils import report
+
+    monkeypatch.setenv("INDEXCOV_N_BACKGROUNDS", "2")
+    assert report._color(0) == "rgba(180,180,180,0.94)"
+    assert report._color(1) == "rgba(180,180,180,0.94)"
+    assert report._color(2) != "rgba(180,180,180,0.94)"
+    monkeypatch.delenv("INDEXCOV_N_BACKGROUNDS")
+    assert report._color(0) != "rgba(180,180,180,0.94)"
