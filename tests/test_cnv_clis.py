@@ -150,4 +150,4 @@ def test_dcnv_plot_pages(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run_dcnv(p, fasta, out=io.StringIO(), plot_prefix="dd")
     page = (tmp_path / "dd-depth-chr9.html").read_text()
-    assert "scaled depth" in page and "dcnv_chr9" in page
+    assert "scaled coverage" in page and "dcnv_chr9" in page

@@ -107,9 +107,15 @@ def test_depth_cache_resume(tmp_path):
 def test_indexcov_n_backgrounds_env(monkeypatch):
     from goleft_tpu.utils import report
 
+    series = [{"label": f"s{i}", "x": [0, 1], "y": [1.0, 2.0]}
+              for i in range(3)]
+    gray = "rgba(180,180,180,0.94)"
     monkeypatch.setenv("INDEXCOV_N_BACKGROUNDS", "2")
-    assert report._color(0) == "rgba(180,180,180,0.94)"
-    assert report._color(1) == "rgba(180,180,180,0.94)"
-    assert report._color(2) != "rgba(180,180,180,0.94)"
+    _, js = report.line_chart("c", series, "x", "y")
+    assert js.count(gray) == 4  # first 2 series, border+background each
+    # scatter/group charts ignore the env (reference check=false sites)
+    _, js2 = report.line_chart("c", series, "x", "y", per_sample=False)
+    assert gray not in js2
     monkeypatch.delenv("INDEXCOV_N_BACKGROUNDS")
-    assert report._color(0) != "rgba(180,180,180,0.94)"
+    _, js3 = report.line_chart("c", series, "x", "y")
+    assert gray not in js3
