@@ -273,44 +273,59 @@ def rans_decode(data: bytes) -> bytes:
     raise ValueError(f"cram: unknown rANS order {order}")
 
 
+def _normalize_freqs(freqs: np.ndarray, total: int) -> np.ndarray:
+    """Counts → per-symbol frequencies summing exactly to TOTFREQ."""
+    present = freqs > 0
+    norm = np.maximum((freqs * TOTFREQ) // total,
+                      present.astype(np.int64))
+    diff = TOTFREQ - int(norm.sum())
+    big = int(np.argmax(norm))
+    norm[big] += diff
+    if norm[big] <= 0:
+        raise ValueError("rans: degenerate distribution")
+    return norm
+
+
+def _serialize_rle(symbols, payload_fn) -> bytearray:
+    """The rANS table outer structure shared by both orders: ascending
+    symbol/context bytes with adjacent-run RLE (marker byte sym+1, then
+    the count of FURTHER consecutive entries), each entry followed by
+    ``payload_fn(symbol)`` bytes, 0x00-terminated."""
+    table = bytearray()
+    i = 0
+    while i < len(symbols):
+        run = 0
+        while (i + run + 1 < len(symbols)
+               and symbols[i + run + 1] == symbols[i + run] + 1):
+            run += 1
+        table.append(int(symbols[i]))
+        table += payload_fn(int(symbols[i]))
+        if run:
+            table.append(int(symbols[i] + 1))
+            table.append(run - 1)
+            for k in range(1, run + 1):
+                table += payload_fn(int(symbols[i + k]))
+        i += run + 1
+    table.append(0)
+    return table
+
+
+def _serialize_freqs0(norm: np.ndarray) -> bytearray:
+    """Order-0 frequency table bytes (RLE over adjacent symbols)."""
+    return _serialize_rle(np.nonzero(norm > 0)[0],
+                          lambda s: _write_u7(int(norm[s])))
+
+
 def rans_encode_0(data: bytes) -> bytes:
     """Order-0 rANS 4x8 encoder (for fixtures + decoder round-trips)."""
     if len(data) == 0:
         return b"\x00" + struct.pack("<II", 0, 0)
     arr = np.frombuffer(data, dtype=np.uint8)
     freqs = np.bincount(arr, minlength=256).astype(np.int64)
-    # normalize to TOTFREQ, keeping every present symbol >= 1
-    present = freqs > 0
-    norm = np.maximum((freqs * TOTFREQ) // len(arr), present.astype(np.int64))
-    # fix rounding so the total is exactly TOTFREQ
-    diff = TOTFREQ - int(norm.sum())
-    big = int(np.argmax(norm))
-    norm[big] += diff
-    if norm[big] <= 0:
-        raise ValueError("rans: degenerate distribution")
+    norm = _normalize_freqs(freqs, len(arr))
     cum = np.zeros(257, dtype=np.int64)
     np.cumsum(norm, out=cum[1:])
-
-    # frequency table serialization (RLE over symbols)
-    table = bytearray()
-    syms = np.nonzero(present)[0]
-    i = 0
-    while i < len(syms):
-        run = 0
-        while (i + run + 1 < len(syms)
-               and syms[i + run + 1] == syms[i + run] + 1):
-            run += 1
-        table.append(int(syms[i]))
-        table += _write_u7(int(norm[syms[i]]))
-        if run:
-            # adjacent-symbol RLE: marker byte (sym+1) then the count of
-            # FURTHER consecutive symbols after it, then their freqs
-            table.append(int(syms[i] + 1))
-            table.append(run - 1)
-            for k in range(1, run + 1):
-                table += _write_u7(int(norm[syms[i + k]]))
-        i += run + 1
-    table.append(0)
+    table = _serialize_freqs0(norm)
 
     # encode backwards with 4 interleaved states
     R = [RANS_LOW] * 4
@@ -328,6 +343,66 @@ def rans_encode_0(data: bytes) -> bytes:
     states = b"".join(struct.pack("<I", R[j]) for j in range(4))
     body = bytes(table) + states + bytes(reversed(payload))
     return b"\x00" + struct.pack("<II", len(body), len(arr)) + body
+
+
+def rans_encode_1(data: bytes) -> bytes:
+    """Order-1 rANS 4x8 encoder — validation twin for the order-1
+    decoder (real CRAMs use o1 for base/quality streams; our block
+    writer uses o0/gzip). Four interleaved streams over quarters, each
+    symbol coded in its in-stream predecessor's context, encoded in the
+    exact reverse of the decoder's consumption order.
+    """
+    n = len(data)
+    if n < 4:
+        raise ValueError("rans o1 needs at least 4 bytes")
+    arr = np.frombuffer(data, dtype=np.uint8)
+    F = n >> 2
+    quarter_lo = [0, F, 2 * F, 3 * F]
+    quarter_hi = [F, 2 * F, 3 * F, n]
+
+    counts = np.zeros((256, 256), dtype=np.int64)
+    totals = np.zeros(256, dtype=np.int64)
+    for j in range(4):
+        lo, hi = quarter_lo[j], quarter_hi[j]
+        prevs = np.concatenate(([0], arr[lo:hi - 1]))
+        np.add.at(counts, (prevs, arr[lo:hi]), 1)
+        np.add.at(totals, prevs, 1)
+
+    norm = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    ctxs = np.nonzero(totals > 0)[0]
+    for c in ctxs:
+        norm[c] = _normalize_freqs(counts[c], int(totals[c]))
+        np.cumsum(norm[c], out=cums[c][1:])
+
+    # outer context table: RLE over contexts, inner o0 table each
+    table = _serialize_rle(ctxs, lambda c: _serialize_freqs0(norm[c]))
+
+    # decoder consumption order: for i ascending, streams 0..3 each
+    # decode their i-th in-quarter symbol (stream 3 alone in the tail);
+    # encode by walking that order backwards directly
+    def reverse_steps():
+        for i in range(n - 3 * F - 1, -1, -1):
+            for j in (3, 2, 1, 0):
+                p = quarter_lo[j] + i
+                if p < quarter_hi[j]:
+                    yield j, p
+
+    R = [RANS_LOW] * 4
+    payload = bytearray()
+    for j, p in reverse_steps():
+        s = int(arr[p])
+        ctx = int(arr[p - 1]) if p > quarter_lo[j] else 0
+        f = int(norm[ctx][s])
+        x = R[j]
+        x_max = ((RANS_LOW >> TF_SHIFT) << 8) * f
+        while x >= x_max:
+            payload.append(x & 0xFF)
+            x >>= 8
+        R[j] = ((x // f) << TF_SHIFT) + (x % f) + int(cums[ctx][s])
+    states = b"".join(struct.pack("<I", R[j]) for j in range(4))
+    body = bytes(table) + states + bytes(reversed(payload))
+    return b"\x01" + struct.pack("<II", len(body), n) + body
 
 
 # ------------------------------------------------------------- blocks
@@ -381,11 +456,14 @@ def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
     return Block(method, ctype, cid, data), pos
 
 
-def write_block(method: int, ctype: int, cid: int, data: bytes) -> bytes:
-    if method == M_GZIP:
-        comp = gzip.compress(data, 6)
-    elif method == M_RANS:
+def write_block(method: int, ctype: int, cid: int, data: bytes,
+                rans_order: int = 0) -> bytes:
+    if method == M_RANS and (rans_order == 0 or len(data) < 4):
         comp = rans_encode_0(data)
+    elif method == M_RANS:
+        comp = rans_encode_1(data)
+    elif method == M_GZIP:
+        comp = gzip.compress(data, 6)
     else:
         comp = data
     head = bytes([method, ctype]) + write_itf8(cid) + \
@@ -1284,11 +1362,13 @@ class CramWriter:
 
     def __init__(self, fh, header_text: str, ref_names: list[str],
                  ref_lens: list[int], records_per_container: int = 10000,
-                 block_method: int = M_GZIP, ap_delta: bool = True):
+                 block_method: int = M_GZIP, ap_delta: bool = True,
+                 rans_order: int = 0):
         self._fh = fh
         self.ref_names = list(ref_names)
         self._rpc = records_per_container
         self._method = block_method
+        self._rans_order = rans_order
         self._ap_delta = ap_delta
         self._pending: list[dict] = []
         self._counter = 0
@@ -1443,7 +1523,8 @@ class CramWriter:
         blocks += write_block(M_RAW, CT_CORE, 0, b"")
         for cid in used:
             blocks += write_block(self._method, CT_EXTERNAL, cid,
-                                  ext_payload[cid])
+                                  ext_payload[cid],
+                                  rans_order=self._rans_order)
         comp_block = write_block(M_RAW, CT_COMP_HEADER, 0,
                                  comp.serialize())
         body = comp_block + blocks

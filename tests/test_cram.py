@@ -84,12 +84,12 @@ def _twin_reads(rng, n=2500, ref_len=120_000):
 
 def _write_cram(path, reads, ref_names=("chr1", "chr2"),
                 ref_lens=(120_000, 50_000), method=M_GZIP, rpc=700,
-                with_crai=True):
+                with_crai=True, rans_order=0):
     hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
     with open(path, "wb") as fh:
         with CramWriter(fh, hdr, list(ref_names), list(ref_lens),
                         records_per_container=rpc,
-                        block_method=method) as w:
+                        block_method=method, rans_order=rans_order) as w:
             for i, (tid, pos, cig, mq, fl) in enumerate(reads):
                 w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
                                flag=fl, name=f"r{i:05d}")
@@ -98,15 +98,17 @@ def _write_cram(path, reads, ref_names=("chr1", "chr2"),
     return path
 
 
-@pytest.mark.parametrize("method", [M_RAW, M_GZIP, M_RANS])
-def test_cram_matches_bam_twin_columns(tmp_path, method):
+@pytest.mark.parametrize("method,rans_order",
+                         [(M_RAW, 0), (M_GZIP, 0), (M_RANS, 0),
+                          (M_RANS, 1)])
+def test_cram_matches_bam_twin_columns(tmp_path, method, rans_order):
     rng = np.random.default_rng(9)
     reads = _twin_reads(rng)
     bam_p = str(tmp_path / "t.bam")
     cram_p = str(tmp_path / "t.cram")
     write_bam(bam_p, reads, ref_names=("chr1", "chr2"),
               ref_lens=(120_000, 50_000))
-    _write_cram(cram_p, reads, method=method)
+    _write_cram(cram_p, reads, method=method, rans_order=rans_order)
 
     want = BamReader.from_file(bam_p).read_columns()
     cf = CramFile.from_file(cram_p)
@@ -234,3 +236,25 @@ def test_corrupt_cram_clear_error(tmp_path):
     p.write_bytes(b"CRAM\x03\x00" + b"\x00" * 64)
     with pytest.raises((SystemExit, ValueError)):
         open_bam_file(str(p))
+
+
+@pytest.mark.parametrize("order", [0, 1])
+def test_rans_order_fuzz(order):
+    """Both rANS orders round-trip across distributions (incl. the
+    markov-heavy data order-1 exists for)."""
+    rng = np.random.default_rng(100 + order)
+    for trial in range(60):
+        n = int(rng.integers(4, 3000))
+        syms = rng.choice(256, size=int(rng.integers(1, 60)),
+                          replace=False)
+        if trial % 3 == 0:
+            data = bytearray([int(syms[0])])
+            for _ in range(n - 1):
+                data.append(data[-1] if rng.random() < 0.8
+                            else int(rng.choice(syms)))
+            data = bytes(data)
+        else:
+            data = rng.choice(syms, size=n).astype(np.uint8).tobytes()
+        enc = (rans_encode_0 if order == 0
+               else cram.rans_encode_1)(data)
+        assert rans_decode(enc) == data, (order, trial, n)
