@@ -274,15 +274,29 @@ def rans_decode(data: bytes) -> bytes:
 
 
 def _normalize_freqs(freqs: np.ndarray, total: int) -> np.ndarray:
-    """Counts → per-symbol frequencies summing exactly to TOTFREQ."""
+    """Counts → per-symbol frequencies summing exactly to TOTFREQ.
+
+    Rare symbols floor-clamp to 1, which can push the sum ABOVE TOTFREQ
+    for large skewed alphabets (e.g. 200 singleton symbols); the deficit
+    is then shaved from the largest entries (each kept ≥ 1) rather than
+    blindly subtracted from one argmax, which could go negative.
+    """
     present = freqs > 0
     norm = np.maximum((freqs * TOTFREQ) // total,
                       present.astype(np.int64))
     diff = TOTFREQ - int(norm.sum())
-    big = int(np.argmax(norm))
-    norm[big] += diff
-    if norm[big] <= 0:
-        raise ValueError("rans: degenerate distribution")
+    if diff >= 0:
+        norm[int(np.argmax(norm))] += diff
+        return norm
+    while diff < 0:
+        big = int(np.argmax(norm))
+        if norm[big] <= 1:
+            # all present symbols at 1 and still over TOTFREQ: >4096
+            # distinct symbols is impossible for a byte alphabet
+            raise ValueError("rans: degenerate distribution")
+        take = min(-diff, int(norm[big]) - 1)
+        norm[big] -= take
+        diff += take
     return norm
 
 

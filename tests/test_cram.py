@@ -258,3 +258,16 @@ def test_rans_order_fuzz(order):
         enc = (rans_encode_0 if order == 0
                else cram.rans_encode_1)(data)
         assert rans_decode(enc) == data, (order, trial, n)
+
+
+def test_rans_normalization_skewed_large_alphabet():
+    """~200 singleton symbols + heavy mass: the rounding deficit exceeds
+    any single frequency and must spread across the largest entries."""
+    rng = np.random.default_rng(7)
+    heavy = rng.choice(256, size=56, replace=False)
+    rare = np.setdiff1d(np.arange(256), heavy)[:200]
+    data = np.concatenate([rng.choice(heavy, size=200_000), rare])
+    rng.shuffle(data)
+    data = data.astype(np.uint8).tobytes()
+    assert rans_decode(rans_encode_0(data)) == data
+    assert rans_decode(cram.rans_encode_1(data)) == data

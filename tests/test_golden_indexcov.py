@@ -1,0 +1,78 @@
+"""Hand-derived indexcov golden: a .bai built byte-by-byte with chosen
+linear-index offsets, and expected outputs computed on paper.
+
+The chromosome has 7 linear-index entries → 6 per-16KB-tile sizes,
+chosen as voffset deltas (file_offset << 16):
+
+    sizes = [100, 200, 300, 400, 500, 1000]
+
+Median-by-capped-cumsum (indexcov.go:104-124): sorted = same order;
+98th-pct cap index = int(0.98·6) = 5 → cap 1000 (no-op); cumsum =
+[100, 300, 600, 1000, 1500, 2500]; total//2 = 1250; first cumsum
+entry > 1250 is index 4 → median = 500.
+
+Normalized depths = sizes/500 = [0.2, 0.4, 0.6, 0.8, 1, 2]
+("%.3g" formatting in bed.gz).
+
+Bin counters (indexcov.go:1050-1078): in (0.85–1.15) = {1.0} → 1;
+out = {0.2, 0.4, 0.6, 0.8, 2} → 5; hi (>1.15) = {2} → 1;
+low (<0.15) = 0; no missing tail. p.out = out/in (indexcov.go:883) = 5/1 = 5.00.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+def _build_bai(path, sizes, ref_len_tiles):
+    """One-reference .bai whose linear-index voffset deltas are
+    ``sizes`` (values are compressed-offset<<16)."""
+    offs = np.concatenate(([0], np.cumsum(sizes))).astype(np.uint64)
+    voffs = offs * np.uint64(1 << 16)
+    out = bytearray(b"BAI\x01")
+    out += struct.pack("<i", 1)  # n_ref
+    out += struct.pack("<i", 1)  # one bin: the stats pseudo-bin
+    out += struct.pack("<Ii", 0x924A, 2)
+    out += struct.pack("<QQ", 0, 0)
+    out += struct.pack("<QQ", 600, 7)  # mapped, unmapped
+    out += struct.pack("<i", len(voffs))
+    out += voffs.astype("<u8").tobytes()
+    out += struct.pack("<Q", 0)
+    with open(path, "wb") as fh:
+        fh.write(bytes(out))
+
+
+def test_indexcov_matches_hand_derived_values(tmp_path):
+    from goleft_tpu.commands.indexcov import run_indexcov
+
+    sizes = [100, 200, 300, 400, 500, 1000]
+    bai = str(tmp_path / "s1.bai")
+    _build_bai(bai, sizes, len(sizes))
+    fai = str(tmp_path / "ref.fa.fai")
+    with open(fai, "w") as fh:
+        fh.write(f"chr1\t{16384 * len(sizes)}\t6\t60\t61\n")
+    d = str(tmp_path / "out")
+    run_indexcov([bai], directory=d, fai=fai, exclude_patt="", sex="",
+                 write_html=False, write_png=False)
+
+    base = os.path.join(d, "out-indexcov")
+    rows = gzip.open(base + ".bed.gz", "rt").read().splitlines()
+    want_depths = ["0.2", "0.4", "0.6", "0.8", "1", "2"]
+    assert rows[0].startswith("#chrom")
+    assert len(rows) == 1 + 6
+    for i, w in enumerate(want_depths):
+        s, e = i * 16384, (i + 1) * 16384
+        assert rows[1 + i] == f"chr1\t{s}\t{e}\t{w}", rows[1 + i]
+
+    ped = open(base + ".ped").read().splitlines()
+    hdr = ped[0].lstrip("#").split("\t")
+    vals = dict(zip(hdr, ped[1].split("\t")))
+    assert vals["bins.in"] == "1"
+    assert vals["bins.out"] == "5"
+    assert vals["bins.hi"] == "1"
+    assert vals["bins.lo"] == "0"
+    assert vals["p.out"] == "5.00"
+    assert vals["mapped"] == "600"
+    assert vals["unmapped"] == "7"
