@@ -13,6 +13,7 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <locale.h>
 #include <zlib.h>
@@ -258,12 +259,7 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
     return nr;
 }
 
-// Fused decode + window reduction: walk BAM records and accumulate
-// per-window depth sums directly — no segment arrays materialize and
-// nothing per-read ever crosses to the device. This is the hierarchical
-// reduction that keeps host→device traffic at O(windows) instead of
-// O(reads): the TPU consumes the (windows × samples) matrix for the
-// cohort math (normalization/EM/PCA) where batched compute dominates.
+// ---- fused decode + window reduction -------------------------------
 //
 // Semantics mirror ops/depth_pipeline.py::shard_depth_pipeline exactly:
 // segments are M/=/X CIGAR blocks of records passing (mapq >= min_mapq,
@@ -272,28 +268,88 @@ long bam_decode(const uint8_t* body, long body_len, long offset,
 // delta_scratch must hold length+1 int32 and arrive ZEROED; the cumsum
 // pass re-zeroes every entry it reads (and error paths memset), so the
 // same buffer stays clean across calls without a 4·length memset each
-// time. Returns kept-record count, or a negative bam_decode error code.
-long bam_window_reduce(const uint8_t* body, long body_len, long offset,
-                       int target_tid, int start, int end,
-                       long w0, long length, long window,
-                       int depth_cap, int min_mapq, int flag_mask,
-                       int64_t* wsums, int32_t* delta_scratch,
-                       long* consumed_out, int32_t* done_out) {
-    long off = offset;
-    long nk = 0;
-#define BWR_FAIL(code) do { \
-        memset(delta_scratch, 0, (length + 1) * sizeof(int32_t)); \
-        return (code); } while (0)
-    *done_out = 1;
-    while (off + 4 <= body_len) {
-        int32_t block_size;
-        memcpy(&block_size, body + off, 4);
-        if (block_size < 32) BWR_FAIL(-9);
-        if (off + 4 + (long)block_size > body_len) {
-            *done_out = 0;
-            break;
+// time.
+
+}  // extern "C" — the record-walk template below needs C++ linkage
+
+// One shared record walker serves both reductions: the header parse,
+// geometry bounds checks, sorted-region stop, and mapq/flag filter must
+// stay byte-identical between the lean and dense paths (the max_overlap
+// exactness guard assumes they see exactly the same records), so the
+// only per-path code is the segment accumulator, injected statically.
+struct WalkCommon {
+    int target_tid, start, end;
+    long w0, length;
+    int min_mapq, flag_mask;
+    long nk;
+};
+
+// Lemire's fast division: magic = floor(2^64/window)+1 gives exact
+// j/window for 0 <= j < 2^32 (window >= 2; magic 0 flags window == 1).
+static inline uint64_t win_magic_for(long window) {
+    if (window <= 1) return 0;
+    return (uint64_t)(((unsigned __int128)1 << 64) / (uint64_t)window) + 1;
+}
+
+static inline long win_idx(long j, uint64_t magic) {
+    if (!magic) return j;  // window == 1
+    return (long)((unsigned __int128)(uint64_t)j * magic >> 64);
+}
+
+// Dense accumulator: per-base coverage deltas (delta holds length+1
+// zeroed int32); exact under depth_cap via bwr_tail's capped cumsum.
+struct BwrState : WalkCommon {
+    int32_t* delta;
+    inline void segment(long s, long e) {
+        delta[s] += 1;
+        delta[e] -= 1;
+    }
+};
+
+// Lean accumulator: each clipped segment adds its overlap directly to
+// the 1-2 windows it spans; wcount bounds max pileup depth per window.
+struct BwaState : WalkCommon {
+    long window;
+    uint64_t win_magic;  // see win_magic_for
+    int64_t* wsums;
+    int32_t* wcount;
+    inline void segment(long s, long e) {
+        long wl = win_idx(s, win_magic);
+        long wh = win_idx(e - 1, win_magic);
+        if (wl == wh) {
+            wsums[wl] += e - s;
+            wcount[wl] += 1;
+        } else {
+            for (long w = wl; w <= wh; w++) {
+                long a = w * window, b = a + window;
+                long lo = s > a ? s : a, hi = e < b ? e : b;
+                wsums[w] += hi - lo;
+                wcount[w] += 1;
+            }
         }
-        const uint8_t* p = body + off + 4;
+    }
+};
+
+// Walk complete BAM records in buf[*rpos_io, have); accumulate clipped
+// M/=/X segments via St::segment. Returns 1 on a clean stop (sorted
+// past region/tid), 0 when the buffer ended mid-record (caller supplies
+// more bytes), negative error.
+template <class St>
+static long bam_walk_records(St* st, const uint8_t* buf, long have,
+                             long* rpos_io) {
+    long off = *rpos_io;
+    const int target_tid = st->target_tid;
+    const int start = st->start, end = st->end;
+    const long w0 = st->w0, length = st->length;
+    const int min_mapq = st->min_mapq, flag_mask = st->flag_mask;
+    long ret = 0;
+    while (off + 4 <= have) {
+        int32_t block_size;
+        memcpy(&block_size, buf + off, 4);
+        if (block_size < 32) { ret = -9; break; }
+        if (off + 4 + (long)block_size > have) break;  // need more
+        const uint8_t* p = buf + off + 4;
+        __builtin_prefetch(p + 4 + block_size);
         int32_t rtid, rpos;
         memcpy(&rtid, p, 4);
         memcpy(&rpos, p + 4, 4);
@@ -301,11 +357,11 @@ long bam_window_reduce(const uint8_t* body, long body_len, long offset,
         uint16_t n_cig, fl;
         memcpy(&n_cig, p + 12, 2);
         memcpy(&fl, p + 14, 2);
-        if (32L + l_rn + 4L * n_cig > (long)block_size) BWR_FAIL(-9);
+        if (32L + l_rn + 4L * n_cig > (long)block_size) { ret = -9; break; }
         if (target_tid >= 0) {
-            if (rtid > target_tid || rtid < 0) break;
+            if (rtid > target_tid || rtid < 0) { ret = 1; break; }
             if (rtid < target_tid) { off += 4 + block_size; continue; }
-            if (end >= 0 && rpos >= end) break;
+            if (end >= 0 && rpos >= end) { ret = 1; break; }
         }
         off += 4 + block_size;
         if (q < min_mapq || (fl & flag_mask) != 0) continue;
@@ -326,40 +382,296 @@ long bam_window_reduce(const uint8_t* body, long body_len, long offset,
                 if (e < 0) e = 0;
                 if (e > length) e = length;
                 if (e > s) {
-                    delta_scratch[s] += 1;
-                    delta_scratch[e] -= 1;
+                    st->segment(s, e);
                     touched = 1;
                 }
             }
             if (opc < 9 && CONSUMES_REF[opc]) cursor += opl;
         }
-        nk += touched;
+        st->nk += touched;
     }
-    if (off < body_len && off + 4 > body_len) *done_out = 0;
-    *consumed_out = off - offset;
-    // capped cumsum + region mask + window sums in one scan, re-zeroing
-    // each delta entry as it is consumed (keeps the scratch clean for
-    // the next call without a full memset)
+    *rpos_io = off;
+    return ret;
+}
+
+static long bwr_walk(void* stv, const uint8_t* buf, long have,
+                     long* rpos_io) {
+    return bam_walk_records((BwrState*)stv, buf, have, rpos_io);
+}
+
+static long bwa_walk(void* stv, const uint8_t* buf, long have,
+                     long* rpos_io) {
+    return bam_walk_records((BwaState*)stv, buf, have, rpos_io);
+}
+
+extern "C" {
+
+// Capped cumsum + region mask + window sums in one scan, re-zeroing each
+// delta entry as it is consumed. Windows fully inside [rs, re) skip the
+// per-base mask test and skip 8-wide runs of zero deltas (most of the
+// array at typical coverage — depth only changes at read boundaries).
+static void bwr_tail(long length, long window, long rs, long re_,
+                     int depth_cap, int32_t* delta, int64_t* wsums) {
     long n_win = length / window;
-    long rs = (long)start - w0, re_ = (long)end - w0;
     int64_t run = 0;
+    const int64_t cap64 = depth_cap;
     for (long wi = 0; wi < n_win; wi++) {
         int64_t acc = 0;
         long base = wi * window;
-        for (long j = 0; j < window; j++) {
-            run += delta_scratch[base + j];
-            delta_scratch[base + j] = 0;
-            long pos = base + j;
-            if (pos >= rs && pos < re_) {
-                int64_t d = run < depth_cap ? run : depth_cap;
-                acc += d;
+        long wend = base + window;
+        if (base >= rs && wend <= re_) {
+            int64_t capped = run < cap64 ? run : cap64;
+            long j = base;
+            for (; j + 8 <= wend; j += 8) {
+                uint64_t a0, a1, a2, a3;
+                memcpy(&a0, delta + j, 8);
+                memcpy(&a1, delta + j + 2, 8);
+                memcpy(&a2, delta + j + 4, 8);
+                memcpy(&a3, delta + j + 6, 8);
+                if ((a0 | a1 | a2 | a3) == 0) {
+                    acc += capped * 8;  // flat run, already zeroed
+                    continue;
+                }
+                for (long k = j; k < j + 8; k++) {
+                    run += delta[k];
+                    delta[k] = 0;
+                    acc += run < cap64 ? run : cap64;
+                }
+                capped = run < cap64 ? run : cap64;
+            }
+            for (; j < wend; j++) {
+                run += delta[j];
+                delta[j] = 0;
+                acc += run < cap64 ? run : cap64;
+            }
+        } else {
+            for (long j = base; j < wend; j++) {
+                run += delta[j];
+                delta[j] = 0;
+                if (j >= rs && j < re_)
+                    acc += run < cap64 ? run : cap64;
             }
         }
         wsums[wi] = acc;
     }
-    delta_scratch[length] = 0;  // clipped endpoints land here
-#undef BWR_FAIL
-    return nk;
+    delta[length] = 0;  // clipped endpoints land here
+}
+
+// Fused decode + window reduction over an UNCOMPRESSED body buffer: walk
+// BAM records and accumulate per-window depth sums directly — no segment
+// arrays materialize and nothing per-read ever crosses to the device.
+// This is the hierarchical reduction that keeps host→device traffic at
+// O(windows) instead of O(reads). Returns kept-record count, or a
+// negative bam_decode error code.
+long bam_window_reduce(const uint8_t* body, long body_len, long offset,
+                       int target_tid, int start, int end,
+                       long w0, long length, long window,
+                       int depth_cap, int min_mapq, int flag_mask,
+                       int64_t* wsums, int32_t* delta_scratch,
+                       long* consumed_out, int32_t* done_out) {
+    BwrState st = {{target_tid, start, end, w0, length, min_mapq,
+                    flag_mask, 0}, delta_scratch};
+    long off = offset;
+    long status = bwr_walk(&st, body, body_len, &off);
+    if (status < 0) {
+        memset(delta_scratch, 0, (length + 1) * sizeof(int32_t));
+        return status;
+    }
+    // done=1: clean stop or exact EOF; done=0: ended mid-record — the
+    // caller must extend the inflate window.
+    *done_out = (status == 1 || off == body_len) ? 1 : 0;
+    *consumed_out = off - offset;
+    bwr_tail(length, window, (long)start - w0, (long)end - w0,
+             depth_cap, delta_scratch, wsums);
+    return st.nk;
+}
+
+// Generic streaming driver: inflate BGZF blocks from compressed offset
+// c_begin into a small recycled ring buffer and invoke `walk` on the
+// growing record window while the bytes are cache-hot — the shard's
+// uncompressed body (tens of MB) never materializes, so record walks
+// read from L2 instead of DRAM and host RSS stays O(1MB) per call.
+// rpos starts at in_block (an uncompressed skip into the first block:
+// a BAI virtual offset's low 16 bits, or the header length for
+// c_begin=0 — the skip may span whole blocks). check_crc=0 skips BGZF
+// payload CRC verification (trusted local files; the record walk still
+// bounds-checks all geometry). Returns 1 (clean stop) or 0 (clean EOF),
+// or a negative bgzf/BAM error (-1 when the stream ends mid-record).
+typedef long (*bam_walk_fn)(void* st, const uint8_t* buf, long have,
+                            long* rpos_io);
+
+static long bgzf_stream_walk(const uint8_t* comp, long comp_len,
+                             long c_begin, long in_block, int check_crc,
+                             bam_walk_fn walk, void* st) {
+    long cap = 1L << 20;
+    uint8_t* buf = (uint8_t*)malloc(cap);
+    if (!buf) return -4;
+#ifndef NO_LIBDEFLATE
+    struct libdeflate_decompressor* dec = libdeflate_alloc_decompressor();
+    if (!dec) { free(buf); return -4; }
+#define BSW_FAIL(code) do { \
+        libdeflate_free_decompressor(dec); free(buf); \
+        return (code); } while (0)
+#else
+#define BSW_FAIL(code) do { free(buf); return (code); } while (0)
+#endif
+    long have = 0, rpos = in_block, off = c_begin;
+    long status = 0;
+    while (off + 28 <= comp_len) {
+        if (comp[off] != 0x1f || comp[off + 1] != 0x8b) BSW_FAIL(-10);
+        uint16_t xlen;
+        memcpy(&xlen, comp + off + 10, 2);
+        long xoff = off + 12, xend = xoff + xlen;
+        if (xend > comp_len) BSW_FAIL(-6);
+        long bsize = -1;
+        while (xoff + 4 <= xend) {
+            uint8_t si1 = comp[xoff], si2 = comp[xoff + 1];
+            uint16_t slen;
+            memcpy(&slen, comp + xoff + 2, 2);
+            if (si1 == 0x42 && si2 == 0x43 && slen == 2) {
+                uint16_t bs;
+                memcpy(&bs, comp + xoff + 4, 2);
+                bsize = (long)bs + 1;
+                break;
+            }
+            xoff += 4 + slen;
+        }
+        if (bsize < 0) BSW_FAIL(-2);
+        if (off + bsize > comp_len) BSW_FAIL(-6);
+        long cdata_off = off + 12 + xlen;
+        long cdata_len = bsize - 12 - xlen - 8;
+        if (cdata_len < 0) BSW_FAIL(-8);
+        uint32_t isize;
+        memcpy(&isize, comp + off + bsize - 4, 4);
+        if (isize > 0) {
+            if (rpos >= have) {
+                // nothing unconsumed buffered (also covers a header or
+                // in-block skip spanning past everything inflated so far)
+                rpos -= have;
+                have = 0;
+            }
+            if (have + (long)isize > cap) {
+                memmove(buf, buf + rpos, have - rpos);
+                have -= rpos;
+                rpos = 0;
+                while (have + (long)isize > cap) {
+                    cap *= 2;
+                    uint8_t* nb = (uint8_t*)realloc(buf, cap);
+                    if (!nb) BSW_FAIL(-4);
+                    buf = nb;
+                }
+            }
+#ifndef NO_LIBDEFLATE
+            size_t actual = 0;
+            enum libdeflate_result r = libdeflate_deflate_decompress(
+                dec, comp + cdata_off, (size_t)cdata_len, buf + have,
+                (size_t)isize, &actual);
+            if (r != LIBDEFLATE_SUCCESS || actual != (size_t)isize)
+                BSW_FAIL(-5);
+            if (check_crc) {
+                uint32_t want_crc;
+                memcpy(&want_crc, comp + off + bsize - 8, 4);
+                if (libdeflate_crc32(0, buf + have, isize) != want_crc)
+                    BSW_FAIL(-7);
+            }
+#else
+            z_stream zs;
+            memset(&zs, 0, sizeof(zs));
+            if (inflateInit2(&zs, -15) != Z_OK) BSW_FAIL(-4);
+            zs.next_in = const_cast<uint8_t*>(comp + cdata_off);
+            zs.avail_in = (uInt)cdata_len;
+            zs.next_out = buf + have;
+            zs.avail_out = isize;
+            int r = inflate(&zs, Z_FINISH);
+            inflateEnd(&zs);
+            if (r != Z_STREAM_END) BSW_FAIL(-5);
+            if (check_crc) {
+                uint32_t want_crc;
+                memcpy(&want_crc, comp + off + bsize - 8, 4);
+                if (crc32(0L, buf + have, isize) != want_crc)
+                    BSW_FAIL(-7);
+            }
+#endif
+            have += isize;
+            status = walk(st, buf, have, &rpos);
+            if (status != 0) break;
+        }
+        off += bsize;
+    }
+    if (status < 0) BSW_FAIL(status);
+    if (status == 0 && rpos < have) BSW_FAIL(-1);  // truncated record
+#ifndef NO_LIBDEFLATE
+    libdeflate_free_decompressor(dec);
+#endif
+    free(buf);
+#undef BSW_FAIL
+    return status;
+}
+
+// Streaming fused inflate + decode + window reduction over the RAW BGZF
+// file (exact capped semantics — see bam_window_reduce). Stops at the
+// region's clean stop or EOF. Returns kept-record count or a negative
+// error.
+long bam_window_reduce_stream(const uint8_t* comp, long comp_len,
+                              long c_begin, long in_block,
+                              int target_tid, int start, int end,
+                              long w0, long length, long window,
+                              int depth_cap, int min_mapq, int flag_mask,
+                              int check_crc,
+                              int64_t* wsums, int32_t* delta_scratch) {
+    BwrState st = {{target_tid, start, end, w0, length, min_mapq,
+                    flag_mask, 0}, delta_scratch};
+    long status = bgzf_stream_walk(comp, comp_len, c_begin, in_block,
+                                   check_crc, bwr_walk, &st);
+    if (status < 0) {
+        memset(delta_scratch, 0, (length + 1) * sizeof(int32_t));
+        return status;
+    }
+    bwr_tail(length, window, (long)start - w0, (long)end - w0,
+             depth_cap, delta_scratch, wsums);
+    return st.nk;
+}
+
+// ---- lean direct-window accumulation -------------------------------
+//
+// The dense delta array costs ~2 bytes of DRAM traffic per reference
+// base (write + cumsum-scan + re-zero). When no window's pileup can
+// reach depth_cap, window sums don't need a per-base pass at all: each
+// aligned segment adds its clipped overlap length directly to the 1-2
+// windows it spans, and the accumulators (8B × n_win) stay L2-resident.
+// Exactness guard: wcount[w] counts segments touching window w — an
+// upper bound on max pileup depth in w. max(wcount) <= depth_cap proves
+// the cap never binds, so uncapped sums are exact; otherwise the caller
+// must redo the shard with the dense (capped) path. Returns via
+// max_overlap_out so the caller can decide.
+
+// Streaming fused inflate + lean window accumulation (see BwaState).
+// wsums/wcount are (length/window) int64/int32, zeroed HERE (they are
+// small). max_overlap_out reports max(wcount): if it exceeds depth_cap
+// the sums may be cap-inexact and the caller must rerun the shard via
+// bam_window_reduce_stream. Other semantics and error codes match
+// bam_window_reduce_stream.
+long bam_window_acc_stream(const uint8_t* comp, long comp_len,
+                           long c_begin, long in_block,
+                           int target_tid, int start, int end,
+                           long w0, long length, long window,
+                           int min_mapq, int flag_mask, int check_crc,
+                           int64_t* wsums, int32_t* wcount,
+                           long* max_overlap_out) {
+    long n_win = length / window;
+    memset(wsums, 0, n_win * sizeof(int64_t));
+    memset(wcount, 0, n_win * sizeof(int32_t));
+    BwaState st = {{target_tid, start, end, w0, length, min_mapq,
+                    flag_mask, 0},
+                   window, win_magic_for(window), wsums, wcount};
+    long status = bgzf_stream_walk(comp, comp_len, c_begin, in_block,
+                                   check_crc, bwa_walk, &st);
+    if (status < 0) return status;
+    long mx = 0;
+    for (long w = 0; w < n_win; w++)
+        if (wcount[w] > mx) mx = wcount[w];
+    *max_overlap_out = mx;
+    return st.nk;
 }
 
 // Scan a .bai: per reference, the bin-section byte range, linear-index

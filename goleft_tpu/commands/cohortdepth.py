@@ -173,17 +173,11 @@ def cohort_matrix_blocks(
         voff = query_voffset(bai, tid, s)
         if voff is None:
             return np.zeros(n_win_r, np.int64)
-        scratch = getattr(_tl, "buf", None)
-        if scratch is None or len(scratch) < length_r + 1:
-            # zeroed by contract; bam_window_reduce re-zeroes on use
-            _tl.buf = scratch = np.zeros(length_r + 1, np.int32)
-        holder = getattr(_tl, "ibuf", None)
-        if holder is None:
-            _tl.ibuf = holder = [None]  # grown by window_reduce
+        # no scratch passed: the lean streaming path needs none, and the
+        # rare dense fallback (pileups past depth_cap) allocates its own
         return h.window_reduce(
             tid, s, e, w0, length_r, window, int(cap), mapq, 0x704,
-            voffset=voff, end_voffset=query_voffset(bai, tid, e),
-            delta_scratch=scratch, inflate_buf=holder,
+            voffset=voff,
         )
 
     def submit_reduces(ex, c, s, e):
@@ -204,7 +198,27 @@ def cohort_matrix_blocks(
         vals = (0.5 + means).astype(np.int64)
         return c, starts, ends, vals
 
+    def effective_cores() -> int:
+        # affinity/cgroup-aware: a container pinned to 1 CPU on a large
+        # host must take the serial path too
+        try:
+            return len(os.sched_getaffinity(0))
+        except AttributeError:  # non-Linux
+            return os.cpu_count() or 1
+
     def blocks_hybrid():
+        if processes <= 1 or effective_cores() <= 1:
+            # single core: thread churn only costs (the native calls
+            # release the GIL but there is no second core to take them)
+            for c, s, e in regions:
+                w0 = s // window * window
+                length_r = ((e - w0) + window - 1) // window * window
+                sums = np.stack([
+                    reduce_task(h, b, tm.get(c, -1), s, e, w0, length_r)
+                    for h, b, tm in zip(handles, bais, tid_maps)
+                ])
+                yield emit_block(c, s, e, sums)
+            return
         with cf.ThreadPoolExecutor(max_workers=processes) as ex:
             pending = submit_reduces(ex, *regions[0])
             for ri, (c, s, e) in enumerate(regions):

@@ -583,9 +583,14 @@ class BamFile:
         csrc/fastio.cpp::bam_window_reduce). Releases the GIL throughout,
         so per-sample reductions scale across decode threads.
 
-        ``delta_scratch`` (zeroed int32, reusable) and ``inflate_buf``
-        (a one-element list holder, grown in place) let hot loops avoid
-        re-allocating tens of MB per shard.
+        Lazy handles stream: the lean direct-window accumulation runs
+        first (no O(length) scratch at all) and the exact capped dense
+        path reruns the shard only when a pileup could reach
+        ``depth_cap``. ``delta_scratch`` (zeroed int32 of length+1) is
+        used by eager handles and the dense fallback — optional
+        everywhere; ``end_voffset``/``inflate_buf`` are accepted for
+        backward compatibility but ignored on the streaming path (the
+        walk stops itself at the region's first record past ``end``).
         """
         from . import native
 
@@ -599,11 +604,30 @@ class BamFile:
             out = native.bam_window_reduce(
                 self.body, offset, *args, delta_scratch=delta_scratch)
             return out["wsums"]
-        out = self._lazy_scan(
-            voffset, end_voffset,
-            lambda body, in_block: native.bam_window_reduce(
-                body, in_block, *args, delta_scratch=delta_scratch),
-            inflate_buf=inflate_buf,
+        # lazy: stream — inflate each BGZF block into a small recycled
+        # ring inside the C call and walk its records cache-hot; the
+        # shard's uncompressed body never materializes (end_voffset is
+        # unnecessary: the walk stops at the region's first record past
+        # ``end``, at most one block beyond it). First try the lean
+        # direct-window accumulation (no O(length) dense scratch); its
+        # max_overlap bound proves whether depth_cap could bind — only
+        # then rerun with the exact capped dense path (rare pileups).
+        del end_voffset, inflate_buf
+        if voffset is not None:
+            c_begin = int(self._co[self._block_of(voffset)])
+            in_block = voffset & 0xFFFF
+        else:
+            c_begin = 0
+            in_block = self._body_start
+        acc = native.bam_window_acc_stream(
+            self._comp, c_begin, in_block, tid, start, end, w0, length,
+            window, min_mapq, flag_mask,
+        )
+        if acc["max_overlap"] <= depth_cap:
+            return acc["wsums"]
+        out = native.bam_window_reduce_stream(
+            self._comp, c_begin, in_block, *args,
+            delta_scratch=delta_scratch,
         )
         return out["wsums"]
 

@@ -78,6 +78,8 @@ def get_lib() -> ctypes.CDLL | None:
         lib.bgzf_inflate_range.restype = ctypes.c_long
         lib.bam_decode.restype = ctypes.c_long
         lib.bam_window_reduce.restype = ctypes.c_long
+        lib.bam_window_reduce_stream.restype = ctypes.c_long
+        lib.bam_window_acc_stream.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -169,6 +171,7 @@ _ERRS = {
     -6: "truncated block",
     -7: "CRC mismatch (corrupt block)",
     -8: "corrupt block header geometry",
+    -10: "bad gzip magic",
 }
 
 # bam_decode has its own error space (fastio.cpp bam_decode header)
@@ -185,6 +188,16 @@ def _err(code) -> str:
 
 def _bam_err(code) -> str:
     return _BAM_ERRS.get(int(code), f"error {code}")
+
+
+def _stream_err(code) -> str:
+    """Streaming fused calls mix both error spaces: -1/-9 come from the
+    record walk, everything else from the BGZF layer (so -2 is 'missing
+    BC subfield' here, NOT bam_decode's 'capacity exceeded')."""
+    code = int(code)
+    if code in (-1, -9):
+        return _BAM_ERRS[code]
+    return _err(code)
 
 
 def bam_decode(body: np.ndarray, offset: int, target_tid: int,
@@ -454,3 +467,97 @@ def bam_window_reduce(body: np.ndarray, offset: int, target_tid: int,
         "consumed": int(consumed.value),
         "done": bool(done.value),
     }
+
+
+def bam_window_reduce_stream(comp, c_begin: int, in_block: int,
+                             target_tid: int, start: int, end: int,
+                             w0: int, length: int, window: int,
+                             depth_cap: int, min_mapq: int,
+                             flag_mask: int,
+                             delta_scratch: np.ndarray | None = None,
+                             check_crc: bool | None = None):
+    """Streaming fused inflate+decode+window-reduce over the raw BGZF
+    bytes: each block inflates into a ~1MB recycled ring and its records
+    are walked cache-hot — the shard's uncompressed body never
+    materializes (the round-2 decode floor was DRAM-bound on exactly
+    that round trip). Returns dict(wsums int64, n_kept) or None when
+    native is unavailable.
+
+    ``check_crc`` defaults to on; GOLEFT_TPU_SKIP_CRC=1 flips the
+    default for trusted local files (the walk still bounds-checks every
+    record, so corruption fails loudly, just without the crc32 pass).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    if end < 0:
+        raise ValueError("bam_window_reduce_stream requires an explicit "
+                         "end")
+    if length % window:
+        raise ValueError("length must be a multiple of window")
+    if check_crc is None:
+        check_crc = not os.environ.get("GOLEFT_TPU_SKIP_CRC")
+    buf = _as_u8(comp)
+    n_win = length // window
+    wsums = np.empty(n_win, dtype=np.int64)
+    if delta_scratch is None or len(delta_scratch) < length + 1:
+        delta_scratch = np.zeros(length + 1, dtype=np.int32)
+    nk = lib.bam_window_reduce_stream(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(c_begin),
+        ctypes.c_long(in_block),
+        ctypes.c_int(target_tid), ctypes.c_int(start), ctypes.c_int(end),
+        ctypes.c_long(w0), ctypes.c_long(length), ctypes.c_long(window),
+        ctypes.c_int(depth_cap), ctypes.c_int(min_mapq),
+        ctypes.c_int(flag_mask), ctypes.c_int(1 if check_crc else 0),
+        _ptr(wsums, ctypes.c_int64),
+        _ptr(delta_scratch, ctypes.c_int32),
+    )
+    if nk < 0:
+        raise ValueError(f"bam_window_reduce_stream: {_stream_err(nk)}")
+    return {"wsums": wsums, "n_kept": int(nk)}
+
+
+def bam_window_acc_stream(comp, c_begin: int, in_block: int,
+                          target_tid: int, start: int, end: int,
+                          w0: int, length: int, window: int,
+                          min_mapq: int, flag_mask: int,
+                          wcount: np.ndarray | None = None,
+                          check_crc: bool | None = None):
+    """Lean streaming accumulation: each aligned segment adds its clipped
+    overlap directly to the 1-2 windows it spans — no dense per-base
+    delta array, so the accumulators stay L2-resident and the shard
+    costs no O(length) DRAM traffic. Sums are UNCAPPED; ``max_overlap``
+    bounds the max pileup depth per window, so a caller enforcing
+    ``depth_cap`` must fall back to :func:`bam_window_reduce_stream`
+    when ``max_overlap > depth_cap`` (window_reduce does this
+    automatically). Returns dict(wsums, n_kept, max_overlap) or None.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    if end < 0:
+        raise ValueError("bam_window_acc_stream requires an explicit end")
+    if length % window:
+        raise ValueError("length must be a multiple of window")
+    if check_crc is None:
+        check_crc = not os.environ.get("GOLEFT_TPU_SKIP_CRC")
+    buf = _as_u8(comp)
+    n_win = length // window
+    wsums = np.empty(n_win, dtype=np.int64)
+    if wcount is None or len(wcount) < n_win:
+        wcount = np.empty(n_win, dtype=np.int32)
+    mx = ctypes.c_long(0)
+    nk = lib.bam_window_acc_stream(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(c_begin),
+        ctypes.c_long(in_block),
+        ctypes.c_int(target_tid), ctypes.c_int(start), ctypes.c_int(end),
+        ctypes.c_long(w0), ctypes.c_long(length), ctypes.c_long(window),
+        ctypes.c_int(min_mapq), ctypes.c_int(flag_mask),
+        ctypes.c_int(1 if check_crc else 0),
+        _ptr(wsums, ctypes.c_int64), _ptr(wcount, ctypes.c_int32),
+        ctypes.byref(mx),
+    )
+    if nk < 0:
+        raise ValueError(f"bam_window_acc_stream: {_stream_err(nk)}")
+    return {"wsums": wsums, "n_kept": int(nk),
+            "max_overlap": int(mx.value)}
