@@ -840,11 +840,68 @@ long format_float_matrix_rows(const char* chrom, long chrom_len,
     return w;
 }
 
+// %.5g-compatible fast formatter for the fixed-notation regime
+// (1e-4 <= v < 1e5): round to 5 significant decimal digits, place the
+// point, strip trailing fraction zeros. Returns chars written, or -1 to
+// defer to snprintf (out of regime, or the scaled value sits within
+// 1e-7 of a .5 rounding tie where double arithmetic can't decide the
+// way printf's exact-decimal rounding would).
+static long fmt_g5(double v, char* p) {
+    long w = 0;
+    if (v < 0) {
+        p[w++] = '-';
+        v = -v;
+    }
+    if (v == 0.0) {
+        p[w++] = '0';
+        return w;
+    }
+    if (v < 1e-4 || v >= 1e5) return -1;  // %g exponential regime
+    int e = 0;  // v = d.dddd * 10^e
+    double t = v;
+    while (t >= 10.0) { t /= 10.0; e++; }
+    while (t < 1.0) { t *= 10.0; e--; }
+    static const double P10[9] = {1e0, 1e1, 1e2, 1e3, 1e4,
+                                  1e5, 1e6, 1e7, 1e8};
+    double scaled = v * P10[4 - e];  // e in [-4,4] -> index in [0,8]
+    double fr = scaled - (double)(long)scaled;
+    double d = fr - 0.5;
+    if (d < 1e-7 && d > -1e-7) return -1;  // ambiguous rounding tie
+    long ndig = (long)(scaled + 0.5);
+    if (ndig >= 100000) {  // 99999.6 -> 1.0000e(e+1)
+        ndig = 10000;
+        e++;
+        if (e >= 5) return -1;
+    }
+    char digs[5];
+    for (int k = 4; k >= 0; k--) {
+        digs[k] = (char)('0' + ndig % 10);
+        ndig /= 10;
+    }
+    int last = 4;  // strip trailing zeros of the fraction only
+    while (last > e && last > 0 && digs[last] == '0') last--;
+    if (e >= 0) {
+        for (int k = 0; k <= e; k++) p[w++] = digs[k];
+        if (last > e) {
+            p[w++] = '.';
+            for (int k = e + 1; k <= last; k++) p[w++] = digs[k];
+        }
+    } else {
+        p[w++] = '0';
+        p[w++] = '.';
+        for (int k = 0; k < -e - 1; k++) p[w++] = '0';
+        for (int k = 0; k <= last; k++) p[w++] = digs[k];
+    }
+    return w;
+}
+
 // Serialize chart point pairs as JSON: [{"x":..,"y":..},...] with %.*g
 // values (C locale). Non-finite values emit null (valid JSON; chart.js
-// skips them). The pure-Python path (round() per point + json.dumps)
-// costs ~7ns/char at whole-genome chart sizes — this is the report
-// writer's hot loop. Returns bytes written or -1 on capacity.
+// skips them). This is the report writer's hot loop (tens of millions
+// of points at whole-genome sizes), so the common cases skip snprintf:
+// integral x up to 10 digits with xprec>=10 go through itoa (identical
+// bytes), and yprec==5 fixed-regime values through fmt_g5.
+// Returns bytes written or -1 on capacity.
 long format_xy_json(const double* xs, const double* ys, long n,
                     int xprec, int yprec, char* out, long out_cap) {
     if (xprec > 17) xprec = 17;  // "%.17g" fits the 32B point budget
@@ -863,17 +920,25 @@ long format_xy_json(const double* xs, const double* ys, long n,
         memcpy(out + w, "{\"x\":", 5);
         w += 5;
         double x = xs[i], y = ys[i];
-        if (x == x && x - x == 0.0)
-            w += snprintf(out + w, 32, "%.*g", xprec, x);
-        else {
+        if (x == x && x - x == 0.0) {
+            long xi = (long)x;
+            if (xprec >= 10 && (double)xi == x && x < 1e10 && x >= 0)
+                w += itoa_u(xi, out + w);
+            else
+                w += snprintf(out + w, 32, "%.*g", xprec, x);
+        } else {
             memcpy(out + w, "null", 4);
             w += 4;
         }
         memcpy(out + w, ",\"y\":", 5);
         w += 5;
-        if (y == y && y - y == 0.0)
-            w += snprintf(out + w, 32, "%.*g", yprec, y);
-        else {
+        if (y == y && y - y == 0.0) {
+            long fw = yprec == 5 ? fmt_g5(y, out + w) : -1;
+            if (fw >= 0)
+                w += fw;
+            else
+                w += snprintf(out + w, 32, "%.*g", yprec, y);
+        } else {
             memcpy(out + w, "null", 4);
             w += 4;
         }

@@ -15,9 +15,33 @@ import sys
 
 import numpy as np
 
-from ..utils.dtypes import preferred_float
 from .cohortdepth import cohort_matrix_blocks
 from .emdepth_cmd import call_cnvs
+
+
+def collect_matrix(blocks, n_win: int, n_samples: int):
+    """Stream cohort blocks into ONE preallocated matrix — the EM needs
+    the global per-sample median so the matrix materializes once, but
+    as int16 window means, not float: depth is capped at
+    DEPTH_CAP_EXTRA (2500) so round-half-up means always fit, and a
+    500-sample WGS cohort at 250bp holds ~12GB instead of ~48GB f64.
+    Normalization and EM later convert one 16k-window chunk at a time
+    (emdepth_cmd._norm_chunk), never the whole matrix."""
+    depths = np.empty((n_win, n_samples), dtype=np.int16)
+    starts = np.empty(n_win, dtype=np.int64)
+    ends = np.empty(n_win, dtype=np.int64)
+    chroms = np.empty(n_win, dtype=object)
+    row = 0
+    for c, st, en, v in blocks:
+        k = len(st)
+        chroms[row : row + k] = c
+        starts[row : row + k] = st
+        ends[row : row + k] = en
+        assert v.max(initial=0) < 32768, "window mean exceeds int16"
+        depths[row : row + k] = v.T  # (n_windows, samples)
+        row += k
+    assert row == n_win, (row, n_win)
+    return chroms, starts, ends, depths
 
 
 def run_cnv(bams, reference=None, fai=None, window: int = 1000,
@@ -30,23 +54,8 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
     )
     if n_win == 0:
         return []
-    # stream blocks into ONE preallocated matrix — the EM needs the
-    # global per-sample median so the matrix materializes once, but as
-    # numbers, not ASCII (round 1 wrote a temp TSV and re-parsed it),
-    # and each device block is dropped as soon as it's copied in
-    depths = np.empty((n_win, len(names)), dtype=preferred_float())
-    starts = np.empty(n_win, dtype=np.int64)
-    ends = np.empty(n_win, dtype=np.int64)
-    chroms = np.empty(n_win, dtype=object)
-    row = 0
-    for c, st, en, v in blocks:
-        k = len(st)
-        chroms[row : row + k] = c
-        starts[row : row + k] = st
-        ends[row : row + k] = en
-        depths[row : row + k] = v.T  # (n_windows, samples)
-        row += k
-    assert row == n_win, (row, n_win)
+    chroms, starts, ends, depths = collect_matrix(blocks, n_win,
+                                                  len(names))
     return call_cnvs(chroms, starts, ends, depths, names, out=out,
                      matrix_out=matrix_out)
 

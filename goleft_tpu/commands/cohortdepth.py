@@ -26,6 +26,7 @@ from ..io.bai import read_bai, query_voffset
 from ..io.bam import ReadColumns, open_bam_file
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
+from ..utils.decode_scaling import effective_cores
 from ..ops.depth_pipeline import shard_depth_pipeline
 from .depth import DEPTH_CAP_EXTRA, gen_regions
 from .indexcov import get_short_name
@@ -197,14 +198,6 @@ def cohort_matrix_blocks(
         means = sums[:, : len(starts)] / spans[None, :]
         vals = (0.5 + means).astype(np.int64)
         return c, starts, ends, vals
-
-    def effective_cores() -> int:
-        # affinity/cgroup-aware: a container pinned to 1 CPU on a large
-        # host must take the serial path too
-        try:
-            return len(os.sched_getaffinity(0))
-        except AttributeError:  # non-Linux
-            return os.cpu_count() or 1
 
     def blocks_hybrid():
         if processes <= 1 or effective_cores() <= 1:

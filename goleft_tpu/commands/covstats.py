@@ -156,9 +156,11 @@ class BamStatsAccumulator:
     def finalize(self) -> dict:
         import sys
 
-        if not self.done and self.total_seen <= self.skip:
-            # reference warns when the skip loop hits EOF
-            # (covstats.go:128-133) and proceeds with whatever remains
+        if not self.done and self.total_seen < self.skip:
+            # reference warns only when EOF interrupts the skip loop,
+            # i.e. STRICTLY fewer than skipReads records
+            # (covstats.go:128-133), and proceeds with whatever remains;
+            # a file with exactly skip records stays silent
             print("covstats: not enough reads to sample for bam stats",
                   file=sys.stderr)
         denom = max(self.k + self.n_unmapped, 1)

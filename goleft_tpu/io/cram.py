@@ -244,7 +244,12 @@ def _rans_decode_1(buf, pos, out_len):
             x = R[j]
             c = last[j]
             m = x & (TOTFREQ - 1)
-            s = luts[c][m] if c in luts else 0
+            if c not in luts:
+                # a context byte with no frequency table means the
+                # stream is corrupt or foreign — fail loudly instead of
+                # silently desynchronizing on symbol 0
+                raise ValueError("cram: rans missing order-1 context")
+            s = luts[c][m]
             out[idx[j]] = s
             x = int(freqs[c][s]) * (x >> TF_SHIFT) + m - int(cums[c][s])
             while x < RANS_LOW and pos < n:
@@ -1110,25 +1115,32 @@ def _container_records(buf: memoryview, pos: int,
                        hdr: ContainerHeader) -> list[CramRecord]:
     """Decode every record in the container starting at its first block."""
     end = pos + hdr.length
-    block, pos = read_block(buf, pos)
-    if block.content_type != CT_COMP_HEADER:
-        raise ValueError("cram: expected compression header block")
-    comp = CompressionHeader.parse(block.data)
-    records: list[CramRecord] = []
-    while pos < end:
-        sh_block, pos = read_block(buf, pos)
-        if sh_block.content_type != CT_SLICE_HEADER:
-            raise ValueError("cram: expected slice header block")
-        sl = SliceHeader.parse(sh_block.data)
-        core = b""
-        externals: dict[int, bytes] = {}
-        for _ in range(sl.n_blocks):
-            b, pos = read_block(buf, pos)
-            if b.content_type == CT_CORE:
-                core = b.data
-            elif b.content_type == CT_EXTERNAL:
-                externals[b.content_id] = b.data
-        records.extend(decode_slice(comp, sl, core, externals))
+    try:
+        block, pos = read_block(buf, pos)
+        if block.content_type != CT_COMP_HEADER:
+            raise ValueError("cram: expected compression header block")
+        comp = CompressionHeader.parse(block.data)
+        records: list[CramRecord] = []
+        while pos < end:
+            sh_block, pos = read_block(buf, pos)
+            if sh_block.content_type != CT_SLICE_HEADER:
+                raise ValueError("cram: expected slice header block")
+            sl = SliceHeader.parse(sh_block.data)
+            core = b""
+            externals: dict[int, bytes] = {}
+            for _ in range(sl.n_blocks):
+                b, pos = read_block(buf, pos)
+                if b.content_type == CT_CORE:
+                    core = b.data
+                elif b.content_type == CT_EXTERNAL:
+                    externals[b.content_id] = b.data
+            records.extend(decode_slice(comp, sl, core, externals))
+    except (IndexError, struct.error) as e:
+        # truncated mid-container: raw memoryview/struct errors become
+        # the module's clean error surface
+        raise ValueError(
+            f"cram: truncated container body at byte {pos}"
+        ) from e
     return records
 
 
@@ -1200,7 +1212,15 @@ class CramFile:
         pos = offset if offset is not None else self._first_data_container
         n = len(buf)
         while pos + 4 <= n:
-            hdr, body = ContainerHeader.parse(buf, pos)
+            try:
+                hdr, body = ContainerHeader.parse(buf, pos)
+            except (IndexError, struct.error) as e:
+                # memoryview reads past a truncated/corrupt container
+                # raise raw slicing errors; surface the module's own
+                # error type so CLIs print a clean "cram:" message
+                raise ValueError(
+                    f"cram: truncated or corrupt container at byte {pos}"
+                ) from e
             if hdr.ref_id == -1 and hdr.n_records == 0:
                 if hdr.n_blocks <= 1:
                     return  # EOF container

@@ -1,0 +1,89 @@
+"""Decode-thread scaling measurement shared by the bench and tests.
+
+The cohort engine's native calls release the GIL, so per-sample window
+reductions scale across decode threads on multi-core hosts (the
+reference's equivalent is its process pool, depth/depth.go:392-394).
+``measure_scaling`` runs that claim: N concurrent ``window_reduce``
+calls on distinct mmap-backed files vs the same calls serial.
+bench.py --suite records the numbers in BENCH_details.json;
+tests/test_thread_scaling.py asserts them.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import shutil
+import time
+
+import numpy as np
+
+
+def effective_cores() -> int:
+    """Affinity/cgroup-aware core count (a container pinned to 1 CPU on
+    a 64-core host must count as 1)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux
+        return os.cpu_count() or 1
+
+
+def build_cohort(tmp_dir, n_files: int = 4, ref_len: int = 2_000_000,
+                 coverage: int = 4, read_len: int = 100):
+    """Fabricate ``n_files`` identical single-chromosome BAMs+BAIs."""
+    from ..io.bam import BamWriter
+    from ..io.bai import build_bai, write_bai
+
+    n_reads = ref_len * coverage // read_len
+    rng = np.random.default_rng(5)
+    starts = np.sort(rng.integers(0, ref_len - read_len, size=n_reads))
+    base = os.path.join(str(tmp_dir), "s0.bam")
+    with open(base, "wb") as fh:
+        with BamWriter(
+            fh, "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr1\tLN:"
+            f"{ref_len}\n", ["chr1"], [ref_len], level=1,
+        ) as w:
+            for i, s in enumerate(starts):
+                w.write_record(0, int(s), [(read_len, 0)], mapq=60,
+                               name=f"r{i}")
+    write_bai(build_bai(base), base + ".bai")
+    paths = [base]
+    for i in range(1, n_files):
+        p = os.path.join(str(tmp_dir), f"s{i}.bam")
+        shutil.copyfile(base, p)
+        shutil.copyfile(base + ".bai", p + ".bai")
+        paths.append(p)
+    return paths, ref_len
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def measure_scaling(paths, ref_len: int, window: int = 500,
+                    repeats: int = 2):
+    """(serial_seconds, threaded_seconds, n_tasks) for one full-region
+    reduce per file, best-of-``repeats``."""
+    from ..io.bam import BamFile
+
+    handles = [BamFile.from_file(p, lazy=True) for p in paths]
+
+    def reduce_one(h):
+        return h.window_reduce(0, 0, ref_len, 0, ref_len, window,
+                               2500, 1, 0x704)
+
+    for h in handles:  # warm page cache + native lib
+        reduce_one(h)
+
+    t_serial = min(
+        _timed(lambda: [reduce_one(h) for h in handles])
+        for _ in range(repeats)
+    )
+    with cf.ThreadPoolExecutor(max_workers=len(handles)) as ex:
+        t_thread = min(
+            _timed(lambda: list(ex.map(reduce_one, handles)))
+            for _ in range(repeats)
+        )
+    return t_serial, t_thread, len(handles)

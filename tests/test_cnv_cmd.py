@@ -88,3 +88,71 @@ def test_cnv_array_path_matches_text_path(tmp_path):
     run_cnv(bams, reference=fa, window=1000, out=arr_out)
     assert arr_out.getvalue() == text_out.getvalue()
     assert len(arr_out.getvalue().splitlines()) > 1
+
+
+def test_cnv_matrix_memory_bounded(monkeypatch):
+    """The cohort matrix materializes as int16 (8x smaller than the old
+    full-f64 + normalized-copy footprint) and the normalization/EM
+    converts one chunk at a time in place. Asserted at two levels:
+    (a) collect_matrix's peak is the int16 matrix + one streamed block,
+    nowhere near a float materialization; (b) the full cnv pipeline
+    (EM stubbed) stays under 60% of the OLD footprint even at a scale
+    where fixed chunk transients still matter — at real cohort scale
+    the matrix term dominates and the ratio approaches 1/8."""
+    import tracemalloc
+    import numpy as np
+    from goleft_tpu.commands import cnv as cnv_mod
+    from goleft_tpu.models import emdepth as em_mod
+
+    n_win, S = 60_000, 100
+    rng = np.random.default_rng(3)
+
+    def gen_blocks():
+        for lo in range(0, n_win, 10_000):
+            k_ = min(10_000, n_win - lo)
+            st = np.arange(lo, lo + k_, dtype=np.int64) * 500
+            vals = rng.integers(28, 33, size=(S, k_), dtype=np.int64)
+            yield "chr1", st, st + 500, vals
+
+    # (a) matrix collection: int16 + one block, no float matrix
+    tracemalloc.start()
+    chroms, starts, ends, depths = cnv_mod.collect_matrix(
+        gen_blocks(), n_win, S)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert depths.dtype == np.int16
+    int16_matrix = n_win * S * 2
+    block_bytes = S * 10_000 * 8 * 2  # int64 block + its transpose/copy
+    assert peak < int16_matrix + block_bytes + 8_000_000, (
+        f"collect peak {peak / 1e6:.1f}MB"
+    )
+
+    # (b) full pipeline with stubbed EM vs the old footprint
+    def fake_blocks(*a, **k):
+        return [f"s{i}" for i in range(S)], n_win, gen_blocks()
+
+    monkeypatch.setattr(cnv_mod, "cohort_matrix_blocks", fake_blocks)
+
+    def fake_em(d):
+        # CN2 centered on the first row's mean: no CNVs called, so the
+        # measurement is matrix machinery, not result accumulation
+        m = float(np.mean(np.asarray(d[0])))
+        lam = np.maximum(np.arange(9.0) / 2 * m, 1e-6)
+        return np.tile(lam, (len(d), 1))
+
+    monkeypatch.setattr(em_mod, "em_depth_batch", fake_em)
+
+    class _Null:
+        def write(self, *_):
+            pass
+
+    rng = np.random.default_rng(3)
+    tracemalloc.start()
+    cnv_mod.run_cnv(["fake.bam"], fai="unused", out=_Null())
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    old_footprint = 2 * n_win * S * 8  # f64 matrix + normalized copy
+    assert peak < 0.6 * old_footprint, (
+        f"peak {peak / 1e6:.1f}MB vs old footprint "
+        f"{old_footprint / 1e6:.1f}MB"
+    )

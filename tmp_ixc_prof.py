@@ -1,0 +1,47 @@
+import cProfile, pstats, io, glob, struct, sys, time, tempfile, shutil
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from goleft_tpu.commands.indexcov import run_indexcov
+
+rng = np.random.default_rng(0)
+d = tempfile.mkdtemp(prefix="ixc_prof_")
+n_ix = 30
+chrom_lens = [int(2.5e8 * (1 - i * 0.03)) for i in range(25)]
+with open(f"{d}/ref.fa.fai", "w") as fh:
+    for i, ln in enumerate(chrom_lens):
+        fh.write(f"chr{i + 1}\t{ln}\t6\t60\t61\n")
+for s in range(n_ix):
+    blob = bytearray(b"BAI\x01") + struct.pack("<i", 25)
+    for ln in chrom_lens:
+        n_t = ln // 16384
+        blob += struct.pack("<i", 1)
+        blob += struct.pack("<Ii", 0x924A, 2)
+        blob += struct.pack("<QQ", 0, 0)
+        blob += struct.pack("<QQ", 40_000_000, 80_000)
+        base = int(rng.integers(0, 1 << 30))
+        deltas = rng.integers(20_000, 60_000, size=n_t).astype(np.int64)
+        ivs = ((base + np.cumsum(deltas)).astype(np.uint64)
+               * np.uint64(1 << 16))
+        blob += struct.pack("<i", n_t) + ivs.astype("<u8").tobytes()
+    blob += struct.pack("<Q", 0)
+    with open(f"{d}/s{s:03d}.bai", "wb") as fh:
+        fh.write(bytes(blob))
+bais = sorted(glob.glob(f"{d}/*.bai"))
+run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
+             exclude_patt="", sex="")  # warmup
+t0 = time.perf_counter()
+run_indexcov(bais, directory=f"{d}/out", fai=f"{d}/ref.fa.fai",
+             exclude_patt="", sex="")
+print(f"warm wall: {time.perf_counter()-t0:.2f}s")
+
+pr = cProfile.Profile()
+pr.enable()
+run_indexcov(bais, directory=f"{d}/out2", fai=f"{d}/ref.fa.fai",
+             exclude_patt="", sex="")
+pr.disable()
+s = io.StringIO()
+ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+ps.print_stats(45)
+print(s.getvalue()[:9000])
+shutil.rmtree(d, ignore_errors=True)
