@@ -38,6 +38,7 @@ CRAM_MAGIC = b"CRAM"
 
 # block compression methods
 M_RAW, M_GZIP, M_BZIP2, M_LZMA, M_RANS = 0, 1, 2, 3, 4
+M_RANSNX16, M_ARITH, M_FQZCOMP, M_TOK3 = 5, 6, 7, 8
 # block content types
 CT_FILE_HEADER, CT_COMP_HEADER, CT_SLICE_HEADER = 0, 1, 2
 CT_EXTERNAL, CT_CORE = 4, 5
@@ -278,18 +279,20 @@ def rans_decode(data: bytes) -> bytes:
     raise ValueError(f"cram: unknown rANS order {order}")
 
 
-def _normalize_freqs(freqs: np.ndarray, total: int) -> np.ndarray:
-    """Counts → per-symbol frequencies summing exactly to TOTFREQ.
+def _normalize_freqs(freqs: np.ndarray, total: int,
+                     target: int = TOTFREQ) -> np.ndarray:
+    """Counts → per-symbol frequencies summing exactly to ``target``
+    (TOTFREQ for 4x8; the Nx16 codec passes its shift-derived total).
 
-    Rare symbols floor-clamp to 1, which can push the sum ABOVE TOTFREQ
+    Rare symbols floor-clamp to 1, which can push the sum ABOVE target
     for large skewed alphabets (e.g. 200 singleton symbols); the deficit
     is then shaved from the largest entries (each kept ≥ 1) rather than
     blindly subtracted from one argmax, which could go negative.
     """
     present = freqs > 0
-    norm = np.maximum((freqs * TOTFREQ) // total,
+    norm = np.maximum((freqs * target) // max(total, 1),
                       present.astype(np.int64))
-    diff = TOTFREQ - int(norm.sum())
+    diff = target - int(norm.sum())
     if diff >= 0:
         norm[int(np.argmax(norm))] += diff
         return norm
@@ -441,6 +444,19 @@ def _decompress(method: int, data: bytes, raw_size: int) -> bytes:
         return lzma.decompress(data)
     if method == M_RANS:
         return rans_decode(data)
+    if method == M_RANSNX16:
+        from .rans_nx16 import decode as nx16_decode
+
+        return nx16_decode(data, raw_size)
+    if method in (M_ARITH, M_FQZCOMP, M_TOK3):
+        name = {M_ARITH: "adaptive arithmetic", M_FQZCOMP: "fqzcomp",
+                M_TOK3: "name tokeniser"}[method]
+        raise ValueError(
+            f"cram: 3.1 block codec '{name}' (method {method}) is not "
+            "implemented — re-encode with samtools view -O "
+            "cram,version=3.0 (or 3.1 without archive-level codecs); "
+            "see docs/cram.md"
+        )
     raise ValueError(f"cram: unsupported block compression method {method}")
 
 
@@ -477,7 +493,12 @@ def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
 
 def write_block(method: int, ctype: int, cid: int, data: bytes,
                 rans_order: int = 0) -> bytes:
-    if method == M_RANS and (rans_order == 0 or len(data) < 4):
+    if method == M_RANSNX16:
+        from .rans_nx16 import encode as nx16_encode
+
+        comp = nx16_encode(data, order=rans_order if len(data) >= 16
+                           else 0)
+    elif method == M_RANS and (rans_order == 0 or len(data) < 4):
         comp = rans_encode_0(data)
     elif method == M_RANS:
         comp = rans_encode_1(data)
@@ -1165,8 +1186,12 @@ class CramFile:
             raise ValueError("not a CRAM file (bad magic)")
         self.major, self.minor = buf[4], buf[5]
         if self.major != 3:
+            # 2.x containers use different block/slice layouts; 3.0 and
+            # 3.1 share the container format (3.1 adds block codecs,
+            # handled per block in _decompress)
             raise ValueError(
-                f"cram: unsupported major version {self.major}"
+                f"cram: unsupported major version {self.major} "
+                "(3.0/3.1 supported; re-encode 2.x with samtools)"
             )
         pos = 26  # magic + version + 20-byte file id
         hdr, pos = ContainerHeader.parse(buf, pos)
@@ -1397,7 +1422,7 @@ class CramWriter:
     def __init__(self, fh, header_text: str, ref_names: list[str],
                  ref_lens: list[int], records_per_container: int = 10000,
                  block_method: int = M_GZIP, ap_delta: bool = True,
-                 rans_order: int = 0):
+                 rans_order: int = 0, minor: int = 0):
         self._fh = fh
         self.ref_names = list(ref_names)
         self._rpc = records_per_container
@@ -1407,7 +1432,8 @@ class CramWriter:
         self._pending: list[dict] = []
         self._counter = 0
         self._offsets: list[tuple[int, int, int, int, int]] = []
-        fh.write(CRAM_MAGIC + bytes([3, 0]) + b"goleft-tpu-cram\x00\x00\x00\x00\x00")
+        fh.write(CRAM_MAGIC + bytes([3, minor])
+                 + b"goleft-tpu-cram\x00\x00\x00\x00\x00")
         sq = "".join(
             f"@SQ\tSN:{n}\tLN:{ln}\n"
             for n, ln in zip(ref_names, ref_lens)

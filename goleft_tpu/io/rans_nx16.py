@@ -1,0 +1,568 @@
+"""rANS Nx16 codec (CRAM 3.1 block method 5), clean-room.
+
+CRAM 3.1's default byte-stream codec: interleaved rANS with 16-bit
+renormalization and optional meta-transforms. Layout implemented from
+the CRAM 3.1 codecs specification (the reference accepts 3.1 through
+htslib — covstats.go:229 smoove NewReader; this module is the
+tpu-native rebuild's own implementation, validated by an in-repo
+encoder/decoder pair + fuzzing like the 4x8 codec in io/cram.py):
+
+- flags byte: ORDER=0x01, X32=0x04 (32-way interleave, else 4),
+  STRIPE=0x08, NOSZ=0x10 (no stored size), CAT=0x20 (stored raw),
+  RLE=0x40, PACK=0x80
+- sizes are uint7 varints (big-endian 7-bit groups, 0x80 continuation)
+- order-0: states decode round-robin (out[i] from state i%N), 12-bit
+  frequencies normalized to 4096, one 16-bit renorm step per symbol
+- order-1: shared alphabet, per-context frequency rows (shift bits in
+  the table header's high nibble; low bit marks a rans-o0-compressed
+  table), output split into N contiguous slices with the last state
+  carrying the tail, per-slice context starts at 0
+- PACK: ≤16 distinct symbols bit-packed LSB-first (0/1/2/4 bits)
+- RLE: marked symbols appear once per run in the literal stream; run
+  extensions live in the metadata as uint7s, consumed in order
+- STRIPE: the stream splits into N' byte-interleaved lanes, each lane
+  its own complete Nx16 stream
+
+Decode order for combined transforms: rans/CAT innermost, then RLE
+expansion, then PACK expansion, mirroring the encoder's PACK→RLE→rans.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+F_ORDER1 = 0x01
+F_X32 = 0x04
+F_STRIPE = 0x08
+F_NOSZ = 0x10
+F_CAT = 0x20
+F_RLE = 0x40
+F_PACK = 0x80
+
+TF_SHIFT = 12
+TOTFREQ = 1 << TF_SHIFT
+RANS_LOW = 1 << 15
+
+
+# ------------------------------------------------------------- varint
+
+def read_uint7(buf, pos: int) -> tuple[int, int]:
+    v = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        v = (v << 7) | (b & 0x7F)
+        if not (b & 0x80):
+            return v, pos
+
+
+def write_uint7(v: int) -> bytes:
+    out = bytearray([v & 0x7F])
+    v >>= 7
+    while v:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    return bytes(reversed(out))
+
+
+# ----------------------------------------------------------- alphabet
+
+def _read_alphabet(buf, pos: int) -> tuple[list[int], int]:
+    """Ascending symbol list with adjacent-run RLE: a symbol equal to
+    previous+1 is a run marker followed by the count of FURTHER
+    consecutive symbols; terminated by a 0 symbol."""
+    syms: list[int] = []
+    rle = 0
+    sym = buf[pos]
+    pos += 1
+    last = -2
+    while True:
+        syms.append(sym)
+        if rle > 0:
+            rle -= 1
+            sym += 1
+        else:
+            last = sym
+            sym = buf[pos]
+            pos += 1
+            if sym == last + 1:
+                rle = buf[pos]
+                pos += 1
+        if rle == 0 and sym == 0:
+            break
+    return syms, pos
+
+
+def _write_alphabet(syms) -> bytearray:
+    out = bytearray()
+    i = 0
+    while i < len(syms):
+        run = 0
+        while (i + run + 1 < len(syms)
+               and syms[i + run + 1] == syms[i + run] + 1):
+            run += 1
+        out.append(int(syms[i]))
+        if run:
+            out.append(int(syms[i] + 1))
+            out.append(run - 1)
+        i += run + 1
+    out.append(0)
+    return out
+
+
+def _normalize(freqs: np.ndarray, total: int, target: int) -> np.ndarray:
+    """Counts → frequencies summing exactly to ``target`` (each present
+    symbol ≥ 1); shared with the 4x8 codec."""
+    from .cram import _normalize_freqs
+
+    return _normalize_freqs(freqs, total, target)
+
+
+# ------------------------------------------------------------ order 0
+
+def _read_freqs0(buf, pos: int):
+    syms, pos = _read_alphabet(buf, pos)
+    freqs = np.zeros(256, dtype=np.int64)
+    for s in syms:
+        freqs[s], pos = read_uint7(buf, pos)
+    tot = int(freqs.sum())
+    if tot != TOTFREQ and tot > 0:
+        freqs = _normalize(freqs, tot, TOTFREQ)
+    return freqs, pos
+
+
+def _decode_rans0(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    freqs, pos = _read_freqs0(buf, pos)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    lut = np.zeros(TOTFREQ, dtype=np.uint8)
+    for s in np.nonzero(freqs)[0]:
+        lut[cum[s]:cum[s + 1]] = s
+    R = list(struct.unpack_from(f"<{n_states}I", buf, pos))
+    pos += 4 * n_states
+    out = bytearray(out_len)
+    n = len(buf)
+    mask = TOTFREQ - 1
+    for i in range(out_len):
+        j = i % n_states
+        x = R[j]
+        m = x & mask
+        s = int(lut[m])
+        out[i] = s
+        x = int(freqs[s]) * (x >> TF_SHIFT) + m - int(cum[s])
+        if x < RANS_LOW and pos + 1 < n:
+            x = (x << 16) | buf[pos] | (buf[pos + 1] << 8)
+            pos += 2
+        R[j] = x
+    return bytes(out)
+
+
+def _encode_rans0(data: bytes, n_states: int = 4) -> bytes:
+    arr = np.frombuffer(data, dtype=np.uint8)
+    counts = np.bincount(arr, minlength=256).astype(np.int64)
+    norm = _normalize(counts, len(arr), TOTFREQ)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(norm, out=cum[1:])
+    table = _write_alphabet(np.nonzero(norm > 0)[0])
+    for s in np.nonzero(norm > 0)[0]:
+        table += write_uint7(int(norm[s]))
+    R = [RANS_LOW] * n_states
+    payload = bytearray()
+    for i in range(len(arr) - 1, -1, -1):
+        s = int(arr[i])
+        j = i % n_states
+        f = int(norm[s])
+        x = R[j]
+        x_max = ((RANS_LOW >> TF_SHIFT) << 16) * f
+        if x >= x_max:
+            payload.append((x >> 8) & 0xFF)
+            payload.append(x & 0xFF)
+            x >>= 16
+        R[j] = ((x // f) << TF_SHIFT) + (x % f) + int(cum[s])
+    states = b"".join(struct.pack("<I", R[j]) for j in range(n_states))
+    # payload bytes were appended hi,lo per step walking backwards; the
+    # decoder reads lo,hi forwards — reverse pairs then the sequence
+    pay = bytes(payload)
+    pairs = [pay[i:i + 2] for i in range(0, len(pay), 2)]
+    fwd = b"".join(bytes([p[1], p[0]]) for p in reversed(pairs))
+    return bytes(table) + states + fwd
+
+
+# ------------------------------------------------------------ order 1
+
+def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    head = buf[pos]
+    pos += 1
+    shift = head >> 4
+    if head & 1:
+        # compressed table: uncompressed size first, then its
+        # compressed byte count, then a bare rans-o0 stream
+        ulen, pos = read_uint7(buf, pos)
+        clen, pos = read_uint7(buf, pos)
+        table = _decode_rans0(buf, pos, ulen, 4)
+        pos += clen
+        tbuf, tpos = memoryview(table), 0
+    else:
+        tbuf, tpos = buf, pos
+    target = 1 << shift
+    syms, tpos = _read_alphabet(tbuf, tpos)
+    freqs = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    luts = {}
+    for c in syms:
+        row = np.zeros(256, dtype=np.int64)
+        for s in syms:
+            row[s], tpos = read_uint7(tbuf, tpos)
+        tot = int(row.sum())
+        if tot not in (0, target):
+            row = _normalize(row, tot, target)
+        freqs[c] = row
+        np.cumsum(row, out=cums[c][1:])
+        lut = np.zeros(target, dtype=np.uint8)
+        for s in np.nonzero(row)[0]:
+            lut[cums[c][s]:cums[c][s + 1]] = s
+        luts[c] = lut
+    if not (head & 1):
+        pos = tpos
+    R = list(struct.unpack_from(f"<{n_states}I", buf, pos))
+    pos += 4 * n_states
+    out = bytearray(out_len)
+    n = len(buf)
+    mask = target - 1
+    F = out_len // n_states
+    idx = [j * F for j in range(n_states)]
+    ends = [F * (j + 1) for j in range(n_states - 1)] + [out_len]
+    last = [0] * n_states
+    while True:
+        done = True
+        for j in range(n_states):
+            if idx[j] >= ends[j]:
+                continue
+            done = False
+            x = R[j]
+            c = last[j]
+            if c not in luts:
+                raise ValueError("rans-nx16: missing order-1 context")
+            m = x & mask
+            s = int(luts[c][m])
+            out[idx[j]] = s
+            x = int(freqs[c][s]) * (x >> shift) + m - int(cums[c][s])
+            if x < RANS_LOW and pos + 1 < n:
+                x = (x << 16) | buf[pos] | (buf[pos + 1] << 8)
+                pos += 2
+            R[j] = x
+            last[j] = s
+            idx[j] += 1
+        if done:
+            break
+    return bytes(out)
+
+
+def _encode_rans1(data: bytes, n_states: int = 4) -> bytes:
+    n = len(data)
+    arr = np.frombuffer(data, dtype=np.uint8)
+    F = n // n_states
+    lo = [j * F for j in range(n_states)]
+    hi = [F * (j + 1) for j in range(n_states - 1)] + [n]
+
+    counts = np.zeros((256, 256), dtype=np.int64)
+    for j in range(n_states):
+        prevs = np.concatenate(([0], arr[lo[j]:hi[j] - 1]))
+        np.add.at(counts, (prevs, arr[lo[j]:hi[j]]), 1)
+    used = sorted(set(np.nonzero(counts.sum(axis=1))[0])
+                  | set(np.unique(arr)))
+    shift = TF_SHIFT
+    target = 1 << shift
+    norm = np.zeros((256, 256), dtype=np.int64)
+    cums = np.zeros((256, 257), dtype=np.int64)
+    for c in used:
+        tot = int(counts[c].sum())
+        if tot > 0:
+            norm[c] = _normalize(counts[c], tot, target)
+        else:
+            # context never used as predecessor: flat row over alphabet
+            norm[c][used] = 1
+            norm[c] = _normalize(norm[c], len(used), target)
+        np.cumsum(norm[c], out=cums[c][1:])
+
+    table = bytearray(_write_alphabet(used))
+    for c in used:
+        for s in used:
+            table += write_uint7(int(norm[c][s]))
+    head = shift << 4
+    tbytes = bytes(table)
+    if len(tbytes) >= 64:
+        comp = _encode_rans0(tbytes, 4)
+        framed = (write_uint7(len(tbytes)) + write_uint7(len(comp))
+                  + comp)
+        if len(framed) < len(tbytes):
+            head |= 1  # compressed table
+            tbytes = framed
+
+    def reverse_steps():
+        tail = hi[n_states - 1] - lo[n_states - 1]
+        for i in range(tail - 1, -1, -1):
+            for j in range(n_states - 1, -1, -1):
+                p = lo[j] + i
+                if p < hi[j]:
+                    yield j, p
+
+    R = [RANS_LOW] * n_states
+    payload = bytearray()
+    for j, p in reverse_steps():
+        s = int(arr[p])
+        ctx = int(arr[p - 1]) if p > lo[j] else 0
+        f = int(norm[ctx][s])
+        x = R[j]
+        x_max = ((RANS_LOW >> shift) << 16) * f
+        if x >= x_max:
+            payload.append((x >> 8) & 0xFF)
+            payload.append(x & 0xFF)
+            x >>= 16
+        R[j] = ((x // f) << shift) + (x % f) + int(cums[ctx][s])
+    states = b"".join(struct.pack("<I", R[j]) for j in range(n_states))
+    pay = bytes(payload)
+    pairs = [pay[i:i + 2] for i in range(0, len(pay), 2)]
+    fwd = b"".join(bytes([p[1], p[0]]) for p in reversed(pairs))
+    return bytes([head]) + tbytes + states + fwd
+
+
+# ------------------------------------------------------- PACK and RLE
+
+def _pack_bits(nsym: int) -> int:
+    if nsym <= 1:
+        return 0
+    if nsym <= 2:
+        return 1
+    if nsym <= 4:
+        return 2
+    return 4
+
+
+def _unpack(data: bytes, pmap: list[int], out_len: int) -> bytes:
+    if out_len == 0:
+        return b""
+    bits = _pack_bits(len(pmap))
+    if bits == 0:
+        return bytes([pmap[0]]) * out_len
+    per = 8 // bits
+    mask = (1 << bits) - 1
+    out = bytearray(out_len)
+    for i in range(out_len):
+        b = data[i // per]
+        out[i] = pmap[(b >> (bits * (i % per))) & mask]
+    return bytes(out)
+
+
+def _pack(data: bytes) -> tuple[bytes, list[int]] | None:
+    syms = sorted(set(data))
+    if len(syms) > 16:
+        return None
+    bits = _pack_bits(len(syms))
+    if bits == 0:
+        return b"", syms
+    back = {s: i for i, s in enumerate(syms)}
+    per = 8 // bits
+    out = bytearray((len(data) + per - 1) // per)
+    for i, v in enumerate(data):
+        out[i // per] |= back[v] << (bits * (i % per))
+    return bytes(out), syms
+
+
+def _rle_encode(data: bytes):
+    """(literals, runs-meta, rle symbol set): every run of a marked
+    symbol stores the symbol once in the literal stream and the number
+    of FURTHER repeats as a uint7 in the metadata."""
+    arr = np.frombuffer(data, dtype=np.uint8)
+    # mark symbols whose total run savings beat their metadata cost
+    saves = np.zeros(256, dtype=np.int64)
+    i = 0
+    n = len(arr)
+    while i < n:
+        j = i
+        while j < n and arr[j] == arr[i]:
+            j += 1
+        saves[arr[i]] += (j - i) - 2  # literal + ~1 meta byte per run
+        i = j
+    rle_syms = sorted(int(s) for s in np.nonzero(saves > 0)[0])
+    if not rle_syms:
+        return None
+    marked = set(rle_syms)
+    lits = bytearray()
+    runs = bytearray()
+    i = 0
+    while i < n:
+        s = int(arr[i])
+        j = i
+        while j < n and arr[j] == s:
+            j += 1
+        if s in marked:
+            lits.append(s)
+            runs += write_uint7(j - i - 1)
+        else:
+            lits += bytes(arr[i:j])
+        i = j
+    return bytes(lits), bytes(runs), rle_syms
+
+
+def _rle_expand(lits: bytes, meta, mpos: int, rle_syms: set,
+                out_len: int) -> bytes:
+    out = bytearray()
+    for b in lits:
+        out.append(b)
+        if b in rle_syms:
+            r, mpos = read_uint7(meta, mpos)
+            out += bytes([b]) * r
+    if len(out) != out_len:
+        raise ValueError("rans-nx16: rle expansion length mismatch")
+    return bytes(out)
+
+
+# ----------------------------------------------------------- top level
+
+def decode(data: bytes, expected_len: int | None = None) -> bytes:
+    """Decode one rANS-Nx16 stream (the full block payload)."""
+    buf = memoryview(data)
+    pos = 0
+    flags = buf[pos]
+    pos += 1
+    if flags & F_NOSZ:
+        if expected_len is None:
+            raise ValueError("rans-nx16: NOSZ stream needs external size")
+        out_len = expected_len
+    else:
+        out_len, pos = read_uint7(buf, pos)
+    if flags & F_STRIPE:
+        n_lanes = buf[pos]
+        pos += 1
+        clens = []
+        for _ in range(n_lanes):
+            c, pos = read_uint7(buf, pos)
+            clens.append(c)
+        lanes = []
+        for j in range(n_lanes):
+            lane_len = (out_len - j + n_lanes - 1) // n_lanes
+            lanes.append(decode(bytes(buf[pos:pos + clens[j]]), lane_len))
+            pos += clens[j]
+        out = bytearray(out_len)
+        for j, lane in enumerate(lanes):
+            out[j::n_lanes] = lane
+        return bytes(out)
+    n_states = 32 if flags & F_X32 else 4
+
+    pack_map = None
+    final_len = out_len
+    if flags & F_PACK:
+        nsym = buf[pos]
+        pos += 1
+        pack_map = [buf[pos + k] for k in range(nsym)]
+        pos += nsym
+        out_len, pos = read_uint7(buf, pos)  # packed byte count
+    rle_syms = None
+    rle_meta = None
+    rle_out_len = out_len
+    if flags & F_RLE:
+        # [meta_len u7 (low bit: 1 = raw)] [literal count u7] [meta]
+        mlen, pos = read_uint7(buf, pos)
+        raw = mlen & 1
+        body_len = mlen >> 1
+        rle_out_len = out_len
+        out_len, pos = read_uint7(buf, pos)  # literal count
+        if raw:
+            meta = bytes(buf[pos:pos + body_len])
+            pos += body_len
+        else:
+            # meta itself is a bare rans-o0 stream: uncompressed size
+            # first, then body_len compressed bytes
+            um, pos = read_uint7(buf, pos)
+            meta = _decode_rans0(buf, pos, um, 4)
+            pos += body_len
+        mpos = 0
+        ns = meta[mpos]
+        mpos += 1
+        if ns == 0:
+            ns = 256
+        rle_syms = set(meta[mpos:mpos + ns])
+        rle_meta = (meta, mpos + ns)
+
+    if flags & F_CAT:
+        payload = bytes(buf[pos:pos + out_len])
+    elif flags & F_ORDER1:
+        payload = _decode_rans1(buf, pos, out_len, n_states)
+    else:
+        payload = _decode_rans0(buf, pos, out_len, n_states)
+
+    if rle_syms is not None:
+        payload = _rle_expand(payload, rle_meta[0], rle_meta[1],
+                              rle_syms, rle_out_len)
+    if pack_map is not None:
+        payload = _unpack(payload, pack_map, final_len)
+    if len(payload) != final_len:
+        raise ValueError("rans-nx16: output length mismatch")
+    return payload
+
+
+def encode(data: bytes, order: int = 0, use_rle: bool = False,
+           use_pack: bool = False, stripe: int = 0,
+           x32: bool = False) -> bytes:
+    """Encode (fixture writer + fuzz twin for the decoder). Transforms
+    apply PACK → RLE → rans, the exact inverse of decode's expansion
+    order; tiny or degenerate bodies store CAT."""
+    if stripe:
+        lanes = [data[j::stripe] for j in range(stripe)]
+        subs = [encode(ln, order=order, x32=x32) for ln in lanes]
+        out = bytearray([F_STRIPE])
+        out += write_uint7(len(data))
+        out.append(stripe)
+        for s in subs:
+            out += write_uint7(len(s))
+        for s in subs:
+            out += s
+        return bytes(out)
+    flags = order & 1
+    if x32:
+        flags |= F_X32
+    n_states = 32 if x32 else 4
+    body = data
+    meta = bytearray()
+    final_len = len(data)
+    if use_pack and body:
+        res = _pack(body)
+        if res is not None and (len(res[0]) < len(body) or not res[0]):
+            packed, pmap = res
+            flags |= F_PACK
+            meta += bytes([len(pmap)]) + bytes(pmap)
+            meta += write_uint7(len(packed))
+            body = packed
+    if use_rle:
+        res = _rle_encode(body)
+        if res is not None:
+            lits, runs, rle_syms = res
+            flags |= F_RLE
+            m = bytes(bytearray([len(rle_syms) & 0xFF])
+                      + bytes(rle_syms) + runs)
+            mc = _encode_rans0(m, 4) if len(m) >= 32 else None
+            if mc is not None and len(mc) + len(
+                    write_uint7(len(m))) < len(m):
+                meta += write_uint7(len(mc) << 1)  # low bit 0: compressed
+                meta += write_uint7(len(lits))
+                meta += write_uint7(len(m)) + mc
+            else:
+                meta += write_uint7((len(m) << 1) | 1)
+                meta += write_uint7(len(lits))
+                meta += m
+            body = lits
+    if len(body) < 4 * n_states or len(set(body)) <= 1:
+        flags |= F_CAT
+        payload = bytes(body)
+    elif flags & F_ORDER1:
+        payload = _encode_rans1(body, n_states)
+    else:
+        payload = _encode_rans0(body, n_states)
+    if not (flags & F_CAT) and len(payload) >= len(body):
+        flags = (flags & ~F_ORDER1) | F_CAT
+        payload = bytes(body)
+    return bytes([flags]) + write_uint7(final_len) + bytes(meta) \
+        + payload

@@ -16,7 +16,7 @@ import pytest
 from goleft_tpu.io import cram
 from goleft_tpu.io.bam import BamReader, open_bam_file, parse_cigar
 from goleft_tpu.io.cram import (
-    CramFile, CramWriter, M_GZIP, M_RANS, M_RAW,
+    CramFile, CramWriter, M_GZIP, M_RANS, M_RANSNX16, M_RAW,
     rans_decode, rans_encode_0, read_itf8, read_ltf8, write_itf8,
     write_ltf8,
 )
@@ -84,11 +84,11 @@ def _twin_reads(rng, n=2500, ref_len=120_000):
 
 def _write_cram(path, reads, ref_names=("chr1", "chr2"),
                 ref_lens=(120_000, 50_000), method=M_GZIP, rpc=700,
-                with_crai=True, rans_order=0):
+                with_crai=True, rans_order=0, minor=0):
     hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
     with open(path, "wb") as fh:
         with CramWriter(fh, hdr, list(ref_names), list(ref_lens),
-                        records_per_container=rpc,
+                        records_per_container=rpc, minor=minor,
                         block_method=method, rans_order=rans_order) as w:
             for i, (tid, pos, cig, mq, fl) in enumerate(reads):
                 w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
@@ -98,17 +98,20 @@ def _write_cram(path, reads, ref_names=("chr1", "chr2"),
     return path
 
 
-@pytest.mark.parametrize("method,rans_order",
-                         [(M_RAW, 0), (M_GZIP, 0), (M_RANS, 0),
-                          (M_RANS, 1)])
-def test_cram_matches_bam_twin_columns(tmp_path, method, rans_order):
+@pytest.mark.parametrize("method,rans_order,minor",
+                         [(M_RAW, 0, 0), (M_GZIP, 0, 0), (M_RANS, 0, 0),
+                          (M_RANS, 1, 0), (M_RANSNX16, 0, 1),
+                          (M_RANSNX16, 1, 1)])
+def test_cram_matches_bam_twin_columns(tmp_path, method, rans_order,
+                                       minor):
     rng = np.random.default_rng(9)
     reads = _twin_reads(rng)
     bam_p = str(tmp_path / "t.bam")
     cram_p = str(tmp_path / "t.cram")
     write_bam(bam_p, reads, ref_names=("chr1", "chr2"),
               ref_lens=(120_000, 50_000))
-    _write_cram(cram_p, reads, method=method, rans_order=rans_order)
+    _write_cram(cram_p, reads, method=method, rans_order=rans_order,
+                minor=minor)
 
     want = BamReader.from_file(bam_p).read_columns()
     cf = CramFile.from_file(cram_p)

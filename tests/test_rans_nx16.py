@@ -1,0 +1,140 @@
+"""rANS-Nx16 (CRAM 3.1 block method 5) encoder/decoder twin tests.
+
+Same validation strategy as the 4x8 codec: an in-repo encoder fuzzes
+the decoder across every flag combination (order 0/1, 4- and 32-state
+interleave, PACK, RLE, STRIPE, CAT) plus hand-built streams whose
+expected bytes are derived on paper from the layout documented in
+goleft_tpu/io/rans_nx16.py.
+"""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import rans_nx16 as rx
+
+
+def test_uint7_roundtrip():
+    for v in (0, 1, 127, 128, 300, 16383, 16384, 2**31 - 1):
+        blob = rx.write_uint7(v)
+        got, pos = rx.read_uint7(blob, 0)
+        assert got == v and pos == len(blob)
+    # hand-derived: 300 = 0b10_0101100 -> [0x82, 0x2C]
+    assert rx.write_uint7(300) == bytes([0x82, 0x2C])
+
+
+def test_alphabet_rle_roundtrip():
+    for syms in ([5], [0, 1, 2, 3], [65, 67, 71, 84],
+                 [0], [10, 11, 12, 40, 41, 200], list(range(100, 140))):
+        blob = rx._write_alphabet(syms)
+        got, pos = rx._read_alphabet(blob, 0)
+        assert got == syms and pos == len(blob)
+
+
+def test_cat_stream_bytes_hand_built():
+    # flags=CAT(0x20), len=3 (uint7 0x03), then raw payload
+    assert rx.decode(bytes([0x20, 0x03]) + b"abc") == b"abc"
+
+
+def test_pack_unpack_2bit():
+    data = bytes([7, 9, 7, 11, 13, 13, 9, 7])
+    packed, pmap = rx._pack(data)
+    assert pmap == [7, 9, 11, 13]
+    # 2 bits LSB-first: [7,9,7,11] -> 0|1<<2|0<<4|2<<6 = 0x84
+    assert packed[0] == 0x84
+    assert rx._unpack(packed, pmap, len(data)) == data
+
+
+@pytest.mark.parametrize("order", [0, 1])
+@pytest.mark.parametrize("rle", [False, True])
+@pytest.mark.parametrize("pack", [False, True])
+@pytest.mark.parametrize("x32", [False, True])
+def test_roundtrip_flag_matrix(order, rle, pack, x32):
+    rng = np.random.default_rng(0)
+    cases = [
+        bytes(rng.integers(0, 256, 5000, dtype=np.uint8)),
+        bytes(rng.choice([65, 67, 71, 84], p=[.4, .3, .2, .1],
+                         size=8000).astype(np.uint8)),
+        b"A" * 3000 + b"B" * 17 + bytes(
+            rng.integers(0, 8, 500, dtype=np.uint8)),
+        bytes(rng.integers(0, 4, 10000, dtype=np.uint8)),
+        bytes([7]) * 5000,
+        b"",
+        b"xyz",
+        b"".join(bytes([int(s)]) * int(r) for s, r in
+                 zip(rng.integers(0, 6, 300), rng.integers(1, 40, 300))),
+    ]
+    for data in cases:
+        enc = rx.encode(data, order=order, use_rle=rle, use_pack=pack,
+                        x32=x32)
+        assert rx.decode(enc) == data
+
+
+@pytest.mark.parametrize("stripe", [2, 4])
+def test_roundtrip_stripe(stripe):
+    rng = np.random.default_rng(1)
+    data = bytes(rng.integers(0, 64, 6000, dtype=np.uint8))
+    enc = rx.encode(data, order=0, stripe=stripe)
+    assert rx.decode(enc) == data
+
+
+def test_roundtrip_fuzz():
+    rng = np.random.default_rng(2)
+    for it in range(150):
+        n = int(rng.integers(0, 4000))
+        alpha = int(rng.integers(1, 256))
+        data = bytes(rng.integers(0, alpha, n, dtype=np.uint8))
+        enc = rx.encode(data, order=int(rng.integers(0, 2)),
+                        use_rle=bool(rng.integers(0, 2)),
+                        use_pack=bool(rng.integers(0, 2)))
+        assert rx.decode(enc) == data, it
+
+
+def test_nosz_requires_external_size():
+    rng = np.random.default_rng(3)
+    data = bytes(rng.integers(0, 16, 500, dtype=np.uint8))
+    enc = bytearray(rx.encode(data))
+    # strip the stored size and set NOSZ
+    flags = enc[0]
+    size_len = len(rx.write_uint7(len(data)))
+    stripped = bytes([flags | rx.F_NOSZ]) + bytes(enc[1 + size_len:])
+    assert rx.decode(stripped, expected_len=len(data)) == data
+    with pytest.raises(ValueError, match="external size"):
+        rx.decode(stripped)
+
+
+def test_unsupported_31_codecs_error_clearly(tmp_path):
+    from goleft_tpu.io.cram import _decompress, M_ARITH, M_FQZCOMP, M_TOK3
+
+    for m, nm in ((M_ARITH, "arith"), (M_FQZCOMP, "fqzcomp"),
+                  (M_TOK3, "tokeniser")):
+        with pytest.raises(ValueError, match="3.1 block codec"):
+            _decompress(m, b"\x00\x01\x02", 3)
+
+
+def test_order1_compressed_table_path():
+    # a wide alphabet with strong order-1 structure: the table is large
+    # enough that the encoder compresses it (head low bit set) while o1
+    # still beats CAT; decode must agree
+    rng = np.random.default_rng(5)
+    deltas = rng.choice([0, 0, 0, 1, 2, 5], size=20000)
+    data = bytes(np.cumsum(deltas).astype(np.int64) % 120)
+    enc = rx.encode(data, order=1)
+    # head byte of the o1 payload: after flags + size varint
+    szlen = len(rx.write_uint7(len(data)))
+    head = enc[1 + szlen]
+    assert head & 1, "expected the compressed-table path"
+    assert rx.decode(enc) == data
+
+
+def test_rle_compressed_meta_path():
+    # many distinct run symbols make the RLE meta big enough to compress
+    rng = np.random.default_rng(6)
+    data = b"".join(bytes([int(s)]) * int(r) for s, r in
+                    zip(rng.integers(0, 200, 2000),
+                        rng.integers(3, 30, 2000)))
+    enc = rx.encode(data, use_rle=True)
+    assert enc[0] & rx.F_RLE
+    szlen = len(rx.write_uint7(len(data)))
+    mlen, _ = rx.read_uint7(enc, 1 + szlen)
+    assert (mlen & 1) == 0, "expected compressed RLE metadata"
+    assert rx.decode(enc) == data
