@@ -235,14 +235,74 @@ def bench_suite(quick: bool) -> dict:
                 "for 30 samples",
     }
 
-    # emdepth: 2504-sample 1000G-scale matrix, batched EM over windows
+    # pallas vs XLA depth kernel at product shape (the pay-or-park
+    # decision record: the XLA scatter+cumsum path sits on the memory
+    # roofline; the pallas compare-reduction does O(endpoints/tile)
+    # vector work per position and is kept as an experimental backend)
+    try:
+        from goleft_tpu.ops.pallas_coverage import (
+            bucket_endpoints, pallas_depth,
+        )
+        from goleft_tpu.ops.depth_pipeline import shard_depth_pipeline
+
+        L = 2_500_000 if quick else 10_000_000
+        pw = [make_workload(L, 30, 150, 100 + s) for s in range(3)]
+        tiled = [bucket_endpoints(s, e, k, L) for s, e, k in pw]
+        p_cap = max(t[0].shape[1] for t in tiled)
+        tiled = [bucket_endpoints(s, e, k, L, p_cap=p_cap)
+                 for s, e, k in pw]
+        staged_p = [(jax.device_put(st), jax.device_put(et), nt)
+                    for st, et, nt in tiled]
+        jax.block_until_ready(
+            pallas_depth(*staged_p[0][:2], n_tiles=staged_p[0][2]))
+        t0 = time.perf_counter()
+        for st, et, nt in staged_p:
+            o = pallas_depth(st, et, n_tiles=nt)
+        jax.block_until_ready(o)
+        t_pallas = (time.perf_counter() - t0) / len(staged_p)
+
+        def xla_run(w):
+            s, e, k = w
+            return shard_depth_pipeline(
+                s, e, k, np.int32(0), np.int32(0), np.int32(L),
+                np.int32(2500), np.int32(4), np.int32(0),
+                length=L, window=250,
+            )
+
+        staged_x = [jax.device_put(w) for w in pw]
+        jax.block_until_ready(xla_run(staged_x[0]))
+        t0 = time.perf_counter()
+        for w in staged_x:
+            o = xla_run(w)
+        jax.block_until_ready(o)
+        t_xla = (time.perf_counter() - t0) / len(staged_x)
+        out["pallas_vs_xla_depth"] = {
+            "shard_bp": L, "coverage": 30,
+            "pallas_ms": round(t_pallas * 1e3, 3),
+            "xla_ms": round(t_xla * 1e3, 3),
+            "pallas_over_xla": round(t_pallas / t_xla, 2),
+            "decision": "park: XLA path is at the HBM roofline (see "
+                        "kernel roofline); pallas does O(endpoints/"
+                        "tile) compares per position — experimental "
+                        "backend only (ops/pallas_coverage.py)",
+        }
+    except Exception as e:  # pragma: no cover - non-TPU backends
+        out["pallas_vs_xla_depth"] = {"error": str(e)}
+
+    # emdepth: 2504-sample 1000G-scale matrix, batched EM at the
+    # PRODUCT chunk size (emdepth_cmd.EM_CHUNK windows per dispatch —
+    # round 2 measured at B=1000 where per-dispatch link latency
+    # dominated and made the kernel look 10x slower than it is)
+    from goleft_tpu.commands.emdepth_cmd import EM_CHUNK
+
     n_s = 500 if quick else 2504
-    n_w = 200 if quick else 1000
+    n_w = 2048 if quick else EM_CHUNK
+    em_reps = 2
     ems = [
         jax.device_put(
             rng.gamma(30, 1.0, size=(n_w, n_s)).astype(np.float32)
         )
-        for _ in range(reps + 1)
+        for _ in range(em_reps + 1)
     ]
 
     def em(m):
@@ -251,9 +311,9 @@ def bench_suite(quick: bool) -> dict:
 
     em(ems[0])  # compile
     t0 = time.perf_counter()
-    for r in range(reps):
+    for r in range(em_reps):
         em(ems[r + 1])
-    dt = (time.perf_counter() - t0) / reps
+    dt = (time.perf_counter() - t0) / em_reps
     # decode-thread scaling: the executable artifact for the README's
     # multi-core claim (see tests/test_thread_scaling.py — same
     # measurement, judge-visible here)
@@ -283,9 +343,16 @@ def bench_suite(quick: bool) -> dict:
     from goleft_tpu.models.emdepth import MAX_ITER, N_LAMBDA
 
     per_iter_flops = n_s * N_LAMBDA * 6  # assign+one-hot+2 reductions
+    wgs_windows = 3_000_000  # BASELINE config 5: WGS at 1kb windows
     out["emdepth_em"] = {
         "windows": n_w, "samples": n_s, "seconds": round(dt, 4),
         "window_calls_per_sec": round(n_w / dt, 1),
+        "wgs_extrapolated_minutes": round(
+            wgs_windows / (n_w / dt) / 60, 2
+        ),
+        "note": "device-resident EM+CN at the product dispatch size; "
+                "the cnv/emdepth CLI overlaps H2D of chunk k+1 with "
+                "compute of chunk k (emdepth_cmd._batched_em)",
         "roofline": roofline(
             # masked-convergence fori_loop always runs MAX_ITER
             # iterations; each reads the (B,S) depth row once (minimal

@@ -74,6 +74,8 @@ def _batched_em(depths: np.ndarray, med=None, medmed=None,
     caller writes it (want_cn)."""
     from ..utils.dtypes import preferred_float
 
+    import jax
+
     dtype = dtype or (depths.dtype if depths.dtype.kind == "f"
                       else preferred_float())
     B = len(depths)
@@ -82,24 +84,39 @@ def _batched_em(depths: np.ndarray, med=None, medmed=None,
         lam = np.asarray(em.em_depth_batch(c))
         return lam, (np.asarray(em.cn_batch(lam, c)) if want_cn
                      else None)
-    lams = cns = None
-    for lo in range(0, B, EM_CHUNK):
+
+    def staged(lo):
         chunk = _norm_chunk(depths[lo : lo + EM_CHUNK], med, medmed,
                             dtype)
         n = len(chunk)
         if n < EM_CHUNK:
             pad = np.ones((EM_CHUNK - n, depths.shape[1]), chunk.dtype)
             chunk = np.concatenate([chunk, pad])
-        lam = np.asarray(em.em_depth_batch(chunk))
+        # async H2D: the transfer of chunk k+1 rides the link while the
+        # device chews chunk k (device_put returns immediately)
+        return jax.device_put(chunk), n
+
+    lams = cns = None
+    offsets = list(range(0, B, EM_CHUNK))
+    pending = staged(offsets[0])
+    for ki, lo in enumerate(offsets):
+        dev, n = pending
+        # dispatch chunk k's device work FIRST (async), then do chunk
+        # k+1's host normalization + H2D while the device computes —
+        # both the host prep and the transfer hide behind compute
+        lam_dev = em.em_depth_batch(dev)
+        cn_dev = em.cn_batch(lam_dev, dev) if want_cn else None
+        if ki + 1 < len(offsets):
+            pending = staged(offsets[ki + 1])
+        lam = np.asarray(lam_dev)
         if lams is None:
             lams = np.empty((B,) + lam.shape[1:], lam.dtype)
         lams[lo : lo + n] = lam[:n]
         if want_cn:
-            cn = np.asarray(em.cn_batch(lam, chunk))
+            cn = np.asarray(cn_dev)
             if cns is None:
                 cns = np.empty((B,) + cn.shape[1:], cn.dtype)
             cns[lo : lo + n] = cn[:n]
-        chunk = None  # free before the next chunk materializes
     return lams, cns
 
 

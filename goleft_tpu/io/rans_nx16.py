@@ -437,6 +437,10 @@ def decode(data: bytes, expected_len: int | None = None) -> bytes:
     if flags & F_STRIPE:
         n_lanes = buf[pos]
         pos += 1
+        if n_lanes == 0 and out_len > 0:
+            # would silently yield zeros; fail loudly like every other
+            # corrupt-stream path
+            raise ValueError("rans-nx16: stripe stream with 0 lanes")
         clens = []
         for _ in range(n_lanes):
             c, pos = read_uint7(buf, pos)

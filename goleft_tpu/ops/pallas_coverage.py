@@ -16,12 +16,17 @@ depth out, no 40MB delta array written and re-read.
 Windowed sums / callable classes stay in XLA (cheap fused elementwise on
 the kernel's output).
 
-Measured on TPU v5e (10Mb shard, 30×/150bp): 0.26 ms/shard (~39 Gbases/s)
-— correct but slower than the XLA scatter+cumsum pipeline (~0.06 ms with
-device-resident inputs), whose fused passes are purely memory-bound while
-this kernel spends O(endpoints/tile) vector compares per position. Kept
-as a tested alternative backend and the template for future fused
-VMEM-resident window/class variants.
+STATUS: EXPERIMENTAL — parked, not a product path. Measured on TPU v5e
+(10Mb shard, 30×/150bp): 0.26 ms/shard (~39 Gbases/s) — correct but
+slower than the XLA scatter+cumsum pipeline (~0.06 ms device-resident;
+the recorded comparison lives in BENCH_details.json
+``pallas_vs_xla_depth``). The XLA path sits at the HBM roofline
+(bench.py kernel roofline block), so no amount of VMEM fusion of the
+window sums / class packing recovers the gap: this kernel's cost is
+O(endpoints/tile) vector compares per position — algorithmic, not
+traffic. Kept tested (tests/test_pallas_coverage.py) as the template
+for future VMEM-resident variants and as the only in-repo example of
+the sequential-grid carry pattern.
 """
 
 from __future__ import annotations

@@ -152,7 +152,10 @@ def test_cnv_matrix_memory_bounded(monkeypatch):
     _, peak = tracemalloc.get_traced_memory()
     tracemalloc.stop()
     old_footprint = 2 * n_win * S * 8  # f64 matrix + normalized copy
-    assert peak < 0.6 * old_footprint, (
+    # the EM double-buffer deliberately keeps one extra in-flight chunk
+    # (H2D overlap); at this test's small scale that chunk is ~14% of
+    # the old footprint, at cohort scale it is ~2% of the matrix
+    assert peak < 0.7 * old_footprint, (
         f"peak {peak / 1e6:.1f}MB vs old footprint "
         f"{old_footprint / 1e6:.1f}MB"
     )
