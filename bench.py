@@ -424,13 +424,33 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
             pass
 
     fai = f"{d}/ref.fa.fai"
-    t0 = _t.perf_counter()
-    run_cohortdepth(bams, fai=fai, window=500, out=_Null())
-    cold = _t.perf_counter() - t0
-    # steady state (caches warm — what a whole-genome run amortizes to)
-    t0 = _t.perf_counter()
-    run_cohortdepth(bams, fai=fai, window=500, out=_Null())
-    wall = _t.perf_counter() - t0
+    # the headline MUST measure the strict default: clear any inherited
+    # skip-crc knob for the timed runs and restore it afterwards
+    import os as _os
+
+    prev_skip = _os.environ.pop("GOLEFT_TPU_SKIP_CRC", None)
+    try:
+        t0 = _t.perf_counter()
+        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+        cold = _t.perf_counter() - t0
+        # steady state (caches warm — what a whole-genome run
+        # amortizes to)
+        t0 = _t.perf_counter()
+        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+        wall = _t.perf_counter() - t0
+        # non-default variant: BGZF payload CRC verification skipped
+        # (GOLEFT_TPU_SKIP_CRC=1, trusted local files). Recorded for
+        # the stage analysis only; the headline stays the strict
+        # default.
+        _os.environ["GOLEFT_TPU_SKIP_CRC"] = "1"
+        t0 = _t.perf_counter()
+        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+        wall_nocrc = _t.perf_counter() - t0
+    finally:
+        if prev_skip is None:
+            _os.environ.pop("GOLEFT_TPU_SKIP_CRC", None)
+        else:
+            _os.environ["GOLEFT_TPU_SKIP_CRC"] = prev_skip
 
     # stage breakdown: open+index, fused decode+reduce, formatting
     t0 = _t.perf_counter()
@@ -461,6 +481,7 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
         "wall_seconds_warm": round(wall, 3),
         "wall_seconds_cold": round(cold, 3),
         "gbases_per_sec": round(gbases / wall, 4),
+        "gbases_per_sec_skip_crc": round(gbases / wall_nocrc, 4),
         "stage_seconds": {
             "open_and_index": round(t_load, 3),
             "decode_window_reduce": round(t_reduce, 3),

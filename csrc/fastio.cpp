@@ -11,6 +11,7 @@
 // (see goleft_tpu/io/native.py, which builds lazily and falls back to the
 // pure-Python codecs on any failure).
 
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -729,6 +730,8 @@ long bai_scan(const uint8_t* data, long len, long max_ref,
     return n_ref;
 }
 
+static long fmt_g(double v, char* p, int prec);
+
 // Fast non-negative int64 → decimal; returns chars written.
 static inline long itoa_u(int64_t v, char* p) {
     char tmp[24];
@@ -827,11 +830,16 @@ long format_float_matrix_rows(const char* chrom, long chrom_len,
         w += itoa_u(ends[r], out + w);
         for (long c = 0; c < n_cols; c++) {
             out[w++] = '\t';
-            if (valid[c * n_rows + r])
-                w += snprintf(out + w, 33, "%.*g", prec,
-                              vals[c * n_rows + r]);
-            else
+            if (valid[c * n_rows + r]) {
+                double v = vals[c * n_rows + r];
+                long fw = fmt_g(v, out + w, prec);
+                if (fw >= 0)
+                    w += fw;
+                else
+                    w += snprintf(out + w, 33, "%.*g", prec, v);
+            } else {
                 out[w++] = '0';
+            }
         }
         out[w++] = '\n';
     }
@@ -840,45 +848,52 @@ long format_float_matrix_rows(const char* chrom, long chrom_len,
     return w;
 }
 
-// %.5g-compatible fast formatter for the fixed-notation regime
-// (1e-4 <= v < 1e5): round to 5 significant decimal digits, place the
-// point, strip trailing fraction zeros. Returns chars written, or -1 to
-// defer to snprintf (out of regime, or the scaled value sits within
-// 1e-7 of a .5 rounding tie where double arithmetic can't decide the
-// way printf's exact-decimal rounding would).
-static long fmt_g5(double v, char* p) {
+// %.{prec}g-compatible fast formatter for the fixed-notation regime
+// (1e-4 <= v < 10^prec): round to prec significant decimal digits,
+// place the point, strip trailing fraction zeros. Returns chars
+// written, or -1 to defer to snprintf (out of regime, or the scaled
+// value sits within 1e-7 of a .5 rounding tie where double arithmetic
+// can't decide the way printf's exact-decimal rounding would).
+static long fmt_g(double v, char* p, int prec) {
+    static const double P10[22] = {
+        1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11,
+        1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
+    };
+    if (prec < 1 || prec > 15) return -1;
+    if (v != v) return -1;  // NaN: snprintf prints "nan"
     long w = 0;
     if (v < 0) {
         p[w++] = '-';
         v = -v;
     }
     if (v == 0.0) {
+        if (std::signbit(v)) p[w++] = '-';  // %g prints "-0" for -0.0
         p[w++] = '0';
         return w;
     }
-    if (v < 1e-4 || v >= 1e5) return -1;  // %g exponential regime
-    int e = 0;  // v = d.dddd * 10^e
+    if (v < 1e-4 || v >= P10[prec]) return -1;  // exponential regime
+    int e = 0;  // v = d.ddd... * 10^e
     double t = v;
     while (t >= 10.0) { t /= 10.0; e++; }
     while (t < 1.0) { t *= 10.0; e--; }
-    static const double P10[9] = {1e0, 1e1, 1e2, 1e3, 1e4,
-                                  1e5, 1e6, 1e7, 1e8};
-    double scaled = v * P10[4 - e];  // e in [-4,4] -> index in [0,8]
+    // e in [-4, prec-1] -> index in [0, prec+3]
+    double scaled = v * P10[prec - 1 - e];
     double fr = scaled - (double)(long)scaled;
     double d = fr - 0.5;
     if (d < 1e-7 && d > -1e-7) return -1;  // ambiguous rounding tie
     long ndig = (long)(scaled + 0.5);
-    if (ndig >= 100000) {  // 99999.6 -> 1.0000e(e+1)
-        ndig = 10000;
+    long full = (long)P10[prec];
+    if (ndig >= full) {  // e.g. 999.6 at prec 3 -> 1.00e(e+1)
+        ndig = full / 10;
         e++;
-        if (e >= 5) return -1;
+        if (e >= prec) return -1;
     }
-    char digs[5];
-    for (int k = 4; k >= 0; k--) {
+    char digs[16];
+    for (int k = prec - 1; k >= 0; k--) {
         digs[k] = (char)('0' + ndig % 10);
         ndig /= 10;
     }
-    int last = 4;  // strip trailing zeros of the fraction only
+    int last = prec - 1;  // strip trailing zeros of the fraction only
     while (last > e && last > 0 && digs[last] == '0') last--;
     if (e >= 0) {
         for (int k = 0; k <= e; k++) p[w++] = digs[k];
@@ -900,7 +915,7 @@ static long fmt_g5(double v, char* p) {
 // skips them). This is the report writer's hot loop (tens of millions
 // of points at whole-genome sizes), so the common cases skip snprintf:
 // integral x up to 10 digits with xprec>=10 go through itoa (identical
-// bytes), and yprec==5 fixed-regime values through fmt_g5.
+// bytes), and fixed-regime y values through the generalized fmt_g.
 // Returns bytes written or -1 on capacity.
 long format_xy_json(const double* xs, const double* ys, long n,
                     int xprec, int yprec, char* out, long out_cap) {
@@ -933,7 +948,7 @@ long format_xy_json(const double* xs, const double* ys, long n,
         memcpy(out + w, ",\"y\":", 5);
         w += 5;
         if (y == y && y - y == 0.0) {
-            long fw = yprec == 5 ? fmt_g5(y, out + w) : -1;
+            long fw = fmt_g(y, out + w, yprec);
             if (fw >= 0)
                 w += fw;
             else

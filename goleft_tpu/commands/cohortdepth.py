@@ -134,16 +134,20 @@ def cohort_matrix_blocks(
 
     # multi-chip: shard the sample axis across all devices (data
     # parallelism — XLA partitions the vmapped pipeline, no collectives
-    # needed); single chip runs the same code unsharded
-    n_dev = len(jax.devices())
+    # needed); single chip runs the same code unsharded. Device discovery
+    # is deferred to the device engine: the hybrid engine is pure host
+    # work and must not block on (or pay for) accelerator bring-up.
     sharding = None
     S_pad = S
-    if n_dev > 1:
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if engine != "hybrid":
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            from jax.sharding import Mesh, NamedSharding, \
+                PartitionSpec as P
 
-        mesh = Mesh(np.array(jax.devices()), ("data",))
-        sharding = NamedSharding(mesh, P("data", None))
-        S_pad = ((S + n_dev - 1) // n_dev) * n_dev
+            mesh = Mesh(np.array(jax.devices()), ("data",))
+            sharding = NamedSharding(mesh, P("data", None))
+            S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 
     def decode(args):
         h, bai, tid, s, e = args
