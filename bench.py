@@ -109,6 +109,78 @@ def roofline(bytes_moved: float, seconds: float, flops: float = 0.0,
     return out
 
 
+
+def _fabricate_bai_cohort(d: str, n_ix: int, chrom_lens, rng) -> list:
+    """Write n_ix whole-genome .bai files + ref.fa.fai into d."""
+    import glob
+    import struct
+
+    with open(f"{d}/ref.fa.fai", "w") as fh:
+        for i, ln in enumerate(chrom_lens):
+            fh.write(f"chr{i + 1}\t{ln}\t6\t60\t61\n")
+    for s in range(n_ix):
+        blob = bytearray(b"BAI\x01") + struct.pack("<i", len(chrom_lens))
+        for ln in chrom_lens:
+            n_t = ln // 16384
+            blob += struct.pack("<i", 1)
+            blob += struct.pack("<Ii", 0x924A, 2)
+            blob += struct.pack("<QQ", 0, 0)
+            blob += struct.pack("<QQ", 40_000_000, 80_000)
+            base = int(rng.integers(0, 1 << 30))
+            deltas = rng.integers(20_000, 60_000, size=n_t).astype(
+                np.int64)
+            ivs = ((base + np.cumsum(deltas)).astype(np.uint64)
+                   * np.uint64(1 << 16))
+            blob += struct.pack("<i", n_t) + ivs.astype("<u8").tobytes()
+        blob += struct.pack("<Q", 0)
+        with open(f"{d}/s{s:03d}.bai", "wb") as fh:
+            fh.write(bytes(blob))
+    return sorted(glob.glob(f"{d}/*.bai"))
+
+
+def _thread_scaling_entry() -> dict:
+    """Decode-thread scaling measurement entry (pure host work)."""
+    import tempfile
+
+    try:
+        from goleft_tpu.utils.decode_scaling import (
+            build_cohort, effective_cores, measure_scaling,
+        )
+        with tempfile.TemporaryDirectory(prefix="goleft_thr_") as td:
+            paths, rl = build_cohort(td)
+            t_ser, t_thr, n_tasks = measure_scaling(paths, rl)
+        return {
+            "threads": n_tasks,
+            "effective_cores": effective_cores(),
+            "serial_seconds": round(t_ser, 4),
+            "threaded_seconds": round(t_thr, 4),
+            "threaded_over_serial": round(t_thr / t_ser, 3),
+            "platform": "host (no device work)",
+            "note": "N concurrent native window_reduce calls on "
+                    "distinct files; on a 1-core host the ratio bounds "
+                    "GIL-release overhead (speedup impossible), on "
+                    "multi-core it must approach 1/min(N, cores)",
+        }
+    except Exception as e:  # pragma: no cover - keep bench robust
+        return {"error": str(e)}
+
+
+def _merge_details(details: dict) -> dict:
+    """Merge new entries into BENCH_details.json (preserving entries
+    other modes wrote) and echo to stderr."""
+    try:
+        with open("BENCH_details.json") as fh:
+            prev = json.load(fh)
+    except (OSError, ValueError):
+        prev = {}
+    prev.update(details)
+    with open("BENCH_details.json", "w") as fh:
+        json.dump(prev, fh, indent=1)
+    for k, v in prev.items():
+        print(f"{k}: {v}", file=sys.stderr)
+    return prev
+
+
 def bench_suite(quick: bool) -> dict:
     """Cohort-scale secondary benchmarks (BASELINE.md configs 3-5)."""
     import jax
@@ -175,27 +247,7 @@ def bench_suite(quick: bool) -> dict:
     d = tempfile.mkdtemp(prefix="goleft_ixc_")
     n_ix = 10 if quick else 30
     chrom_lens = [int(2.5e8 * (1 - i * 0.03)) for i in range(25)]
-    with open(f"{d}/ref.fa.fai", "w") as fh:
-        for i, ln in enumerate(chrom_lens):
-            fh.write(f"chr{i + 1}\t{ln}\t6\t60\t61\n")
-    for s in range(n_ix):
-        blob = bytearray(b"BAI\x01") + struct.pack("<i", 25)
-        for ln in chrom_lens:
-            n_t = ln // 16384
-            blob += struct.pack("<i", 1)
-            blob += struct.pack("<Ii", 0x924A, 2)
-            blob += struct.pack("<QQ", 0, 0)
-            blob += struct.pack("<QQ", 40_000_000, 80_000)
-            base = int(rng.integers(0, 1 << 30))
-            deltas = rng.integers(20_000, 60_000, size=n_t).astype(
-                np.int64)
-            ivs = ((base + np.cumsum(deltas)).astype(np.uint64)
-                   * np.uint64(1 << 16))
-            blob += struct.pack("<i", n_t) + ivs.astype("<u8").tobytes()
-        blob += struct.pack("<Q", 0)
-        with open(f"{d}/s{s:03d}.bai", "wb") as fh:
-            fh.write(bytes(blob))
-    bais = sorted(glob.glob(f"{d}/*.bai"))
+    bais = _fabricate_bai_cohort(d, n_ix, chrom_lens, rng)
     run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
                  exclude_patt="", sex="")  # compile warmup
     t0 = time.perf_counter()
@@ -317,28 +369,7 @@ def bench_suite(quick: bool) -> dict:
     # decode-thread scaling: the executable artifact for the README's
     # multi-core claim (see tests/test_thread_scaling.py — same
     # measurement, judge-visible here)
-    import tempfile as _tf
-
-    try:
-        from goleft_tpu.utils.decode_scaling import (
-            build_cohort, effective_cores, measure_scaling,
-        )
-        with _tf.TemporaryDirectory(prefix="goleft_thr_") as td:
-            paths, rl = build_cohort(td)
-            t_ser, t_thr, n_tasks = measure_scaling(paths, rl)
-        out["decode_thread_scaling"] = {
-            "threads": n_tasks,
-            "effective_cores": effective_cores(),
-            "serial_seconds": round(t_ser, 4),
-            "threaded_seconds": round(t_thr, 4),
-            "threaded_over_serial": round(t_thr / t_ser, 3),
-            "note": "N concurrent native window_reduce calls on "
-                    "distinct files; on a 1-core host the ratio bounds "
-                    "GIL-release overhead (speedup impossible), on "
-                    "multi-core it must approach 1/min(N, cores)",
-        }
-    except Exception as e:  # pragma: no cover - keep bench robust
-        out["decode_thread_scaling"] = {"error": str(e)}
+    out["decode_thread_scaling"] = _thread_scaling_entry()
 
     from goleft_tpu.models.emdepth import MAX_ITER, N_LAMBDA
 
@@ -503,9 +534,67 @@ def _timed(fn, *a, **kw) -> float:
     return time.perf_counter() - t0
 
 
+def host_suite(quick: bool) -> dict:
+    """Host-only benchmarks on a CPU-forced jax backend — the fallback
+    when the accelerator tunnel is unavailable. Entries carry a
+    ``platform`` label so a CPU-mode artifact can never be mistaken for
+    a device measurement. The caller MUST pin the platform before any
+    jax-touching work (main's --suite-host branch does)."""
+    import shutil
+    import tempfile
+
+    out = {}
+    rng = np.random.default_rng(0)
+    from goleft_tpu.commands.indexcov import run_indexcov
+
+    d = tempfile.mkdtemp(prefix="goleft_ixc_")
+    n_ix = 10 if quick else 30
+    chrom_lens = [int(2.5e8 * (1 - i * 0.03)) for i in range(25)]
+    bais = _fabricate_bai_cohort(d, n_ix, chrom_lens, rng)
+    run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
+                 exclude_patt="", sex="")  # warmup/compile
+    t0 = time.perf_counter()
+    run_indexcov(bais, directory=f"{d}/out", fai=f"{d}/ref.fa.fai",
+                 exclude_patt="", sex="")
+    dt = time.perf_counter() - t0
+    shutil.rmtree(d, ignore_errors=True)
+    out["indexcov_e2e_wholegenome"] = {
+        "samples": n_ix, "chromosomes": 25,
+        "genome_gb": round(sum(chrom_lens) / 1e9, 2),
+        "seconds_warm": round(dt, 2),
+        "platform": "cpu-forced (accelerator tunnel unavailable)",
+        "note": "full CLI path: .bai parse -> QC -> bed.gz/ped/roc/"
+                "html/png; reference README cites ~30s for 30 samples",
+    }
+    out["decode_thread_scaling"] = _thread_scaling_entry()
+    return out
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     quick = "--quick" in argv
+    if "--suite-host" in argv:
+        # accelerator-free fallback: refresh the host-side entries and
+        # the cohort headline (pure host) without touching the device.
+        # Pin the platform FIRST so no later jax touch can initialize
+        # an accelerator backend and silently falsify the labels.
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        cohort = bench_cohort(
+            *((20, 2_000_000, 3) if quick else (50, 10_000_000, 4)))
+        cohort["platform"] = "host (decode+reduce is pure host work)"
+        details = {"cohort_e2e": cohort}
+        details.update(host_suite(quick))
+        _merge_details(details)
+        print(json.dumps({
+            "metric": "cohort_depth_e2e_gbases_per_sec",
+            "value": cohort["gbases_per_sec"], "unit": "Gbases/s",
+            "vs_baseline": round(
+                cohort["gbases_per_sec"]
+                / cohort["numpy_kernel_gbases_per_sec"], 2),
+        }))
+        return
     import jax
 
     from goleft_tpu.ops.depth_pipeline import shard_depth_pipeline
@@ -621,17 +710,7 @@ def main(argv=None):
     if details:
         # merge with any existing entries so --cohort alone doesn't wipe
         # --suite results (and vice versa)
-        try:
-            with open("BENCH_details.json") as fh:
-                prev = json.load(fh)
-        except (OSError, ValueError):
-            prev = {}
-        prev.update(details)
-        details = prev
-        with open("BENCH_details.json", "w") as fh:
-            json.dump(details, fh, indent=1)
-        for k, v in details.items():
-            print(f"{k}: {v}", file=sys.stderr)
+        _merge_details(details)
 
     dev = jax.devices()[0]
     print(json.dumps({
