@@ -173,3 +173,43 @@ def test_viral_fai_parses_fully():
     assert fai[0].name == "1" and fai[0].length == 249250621
     assert fai[-1].name == "gi|379059601|ref|NC_016898.1|"
     assert fai[-1].length == 7855
+
+
+def test_depth_cli_on_foreign_bam(tmp_path):
+    """Full depth CLI on the samtools-written t.bam with its own
+    hg19.fa.fai: pinned bed rows (window 0-1000's mean 1001 agrees with
+    the independently hand-derived base sum 1001364 in
+    test_t_bam_depth_cross_engine_and_pinned_sums)."""
+    from goleft_tpu.commands.depth import run_depth
+
+    run_depth(_p("depth", "test", "t.bam"), str(tmp_path / "o"),
+              fai=_p("depth", "test", "hg19.fa.fai"),
+              window=1000, mapq=1)
+    lines = open(str(tmp_path / "o.depth.bed")).read().splitlines()
+    assert len(lines) == 38  # ceil(16571/1000) + ceil(20001/1000)
+    assert lines[0] == "chrM\t0\t1000\t1001"
+    assert lines[1] == "chrM\t1000\t2000\t1563"
+    assert lines[2] == "chrM\t2000\t3000\t918.3"
+    assert lines[-1] == "chr22\t20000\t20001\t6"
+    cl = open(str(tmp_path / "o.callable.bed")).read().splitlines()
+    assert len(cl) == 148
+    assert cl[0] == "chrM\t0\t1\tNO_COVERAGE"
+    assert cl[-1] == "chr22\t19780\t20001\tCALLABLE"
+
+
+def test_covstats_cli_on_foreign_bam(capsys):
+    """covstats on t.bam: the file holds 80330 records — fewer than the
+    100k sampling skip — so the reference warns and proceeds with
+    nothing (degenerate zero stats). The SM tag from the
+    samtools-written @RG header must surface as the sample name."""
+    import io
+
+    from goleft_tpu.commands.covstats import run_covstats
+
+    buf = io.StringIO()
+    run_covstats([_p("depth", "test", "t.bam")], out=buf)
+    err = capsys.readouterr().err
+    assert "not enough reads" in err
+    row = buf.getvalue().splitlines()[1].split("\t")
+    assert row[-1] == "Test1"  # @RG SM from the foreign header
+    assert row[0] == "0.00" and row[11] == "0"
