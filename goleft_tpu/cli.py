@@ -22,32 +22,39 @@ def _lazy(module: str):
     return runner
 
 
+# name -> (help, runner, uses_device). Device-using commands get their
+# backend brought up at dispatch under a hang watchdog (device_guard):
+# the shared path, so a new tool declares one flag instead of wiring
+# its own call site.
 PROGS = {
     "depth": ("parallelize calls to the TPU depth engine",
-              _lazy(".commands.depth")),
+              _lazy(".commands.depth"), True),
     "depthwed": ("matricize depth bed files to n-sites * n-samples",
-                 _lazy(".commands.depthwed")),
+                 _lazy(".commands.depthwed"), False),
     "covstats": ("coverage and insert-size statistics by sampling",
-                 _lazy(".commands.covstats")),
+                 _lazy(".commands.covstats"), False),
     "indexcov": ("quick coverage estimate using only the bam/cram index",
-                 _lazy(".commands.indexcov")),
+                 _lazy(".commands.indexcov"), True),
     "indexsplit": ("create regions of even data size across bams/crams",
-                   _lazy(".commands.indexsplit")),
-    "samplename": ("report samples in a bam file", _lazy(".commands.samplename")),
+                   _lazy(".commands.indexsplit"), False),
+    "samplename": ("report samples in a bam file",
+                   _lazy(".commands.samplename"), False),
     "emdepth": ("EM copy-number calls from a depth matrix",
-                _lazy(".commands.emdepth_cmd")),
+                _lazy(".commands.emdepth_cmd"), True),
     "multidepth": ("joint depth over many bams with min-coverage blocks",
-                   _lazy(".commands.multidepth")),
-    "dcnv": ("GC-debias + normalize a depth matrix", _lazy(".commands.dcnv_cmd")),
+                   _lazy(".commands.multidepth"), True),
+    "dcnv": ("GC-debias + normalize a depth matrix",
+             _lazy(".commands.dcnv_cmd"), True),
     "cnveval": ("evaluate CNV calls against a truth set",
-                _lazy(".commands.cnveval_cmd")),
-    "bench": ("run the TPU benchmark suite", _lazy(".commands.bench_cmd")),
+                _lazy(".commands.cnveval_cmd"), False),
+    "bench": ("run the TPU benchmark suite",
+              _lazy(".commands.bench_cmd"), True),
     "anonymize": ("make shareable header-only bam+bai fixtures",
-                  _lazy(".commands.anonymize")),
+                  _lazy(".commands.anonymize"), False),
     "cohortdepth": ("depth matrix for many bams in one device pass",
-                    _lazy(".commands.cohortdepth")),
+                    _lazy(".commands.cohortdepth"), True),
     "cnv": ("CNV calls straight from bams (cohort depth + EM)",
-            _lazy(".commands.cnv")),
+            _lazy(".commands.cnv"), True),
 }
 
 
@@ -74,6 +81,16 @@ def main(argv: list[str] | None = None) -> int:
         print(f"unknown subcommand: {prog}\n", file=sys.stderr)
         print(usage(), file=sys.stderr)
         return 1
+    # GOLEFT_TPU_CPU=1: pin the platform before any backend init — the
+    # escape hatch when the accelerator (or its tunnel) is down. Device-
+    # using commands then bring the backend up HERE, under the hang
+    # watchdog, so a wedged tunnel warns with that knob instead of
+    # hanging silently inside the first jit call.
+    from .utils.device_guard import devices_with_watchdog, maybe_force_cpu
+
+    maybe_force_cpu()
+    if PROGS[prog][2]:
+        devices_with_watchdog()
     sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
     ret = PROGS[prog][1](argv[1:])
     return int(ret or 0)

@@ -140,12 +140,15 @@ def cohort_matrix_blocks(
     sharding = None
     S_pad = S
     if engine != "hybrid":
-        n_dev = len(jax.devices())
+        from ..utils.device_guard import devices_with_watchdog
+
+        devs = devices_with_watchdog()
+        n_dev = len(devs)
         if n_dev > 1:
             from jax.sharding import Mesh, NamedSharding, \
                 PartitionSpec as P
 
-            mesh = Mesh(np.array(jax.devices()), ("data",))
+            mesh = Mesh(np.array(devs), ("data",))
             sharding = NamedSharding(mesh, P("data", None))
             S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 

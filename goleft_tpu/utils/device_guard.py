@@ -1,0 +1,83 @@
+"""Device bring-up guard: escape hatch + hang diagnostics.
+
+The accelerator may sit behind a tunnel (this dev environment) or a
+driver that can wedge; a product CLI must never hang silently on
+backend bring-up with no way out. Two mechanisms:
+
+- ``GOLEFT_TPU_CPU=1`` pins the jax platform to CPU before any backend
+  initializes (``maybe_force_cpu`` runs at CLI dispatch). Every tool
+  runs correctly on host — slower, never stuck.
+- ``devices_with_watchdog()`` wraps the first device discovery: if
+  bring-up exceeds the deadline, a warning names the likely cause and
+  the escape hatch while the attempt continues (the reference's analog
+  is its red shard-failure banner — failures must be loud and
+  actionable, depth/depth.go:396-399).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+log = logging.getLogger("goleft-tpu.device")
+
+def _watchdog_seconds() -> float:
+    raw = os.environ.get("GOLEFT_TPU_DEVICE_WATCHDOG_SECONDS", "30")
+    try:
+        v = float(raw)
+    except ValueError:
+        log.warning(
+            "ignoring malformed GOLEFT_TPU_DEVICE_WATCHDOG_SECONDS=%r",
+            raw)
+        return 30.0
+    return v if v > 0 else 30.0
+
+
+WATCHDOG_SECONDS = _watchdog_seconds()
+
+
+def maybe_force_cpu() -> bool:
+    """Pin the jax platform to CPU when GOLEFT_TPU_CPU is set. Must run
+    before any jax backend initializes; returns True when pinned.
+    Failure to honor an explicitly-set knob is LOUD — the user set it
+    because the device is wedged."""
+    if not os.environ.get("GOLEFT_TPU_CPU"):
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # backend already up — nothing safe to do
+        log.warning(
+            "GOLEFT_TPU_CPU=1 set but the jax backend is already "
+            "initialized (%s) — execution may still target the "
+            "accelerator", e)
+        return False
+    return True
+
+
+def devices_with_watchdog(seconds: float | None = None):
+    """``jax.devices()`` with a hang warning: if backend bring-up takes
+    longer than ``seconds``, log what is probably wrong and how to
+    escape (GOLEFT_TPU_CPU=1), while the attempt continues."""
+    import jax
+
+    deadline = WATCHDOG_SECONDS if seconds is None else seconds
+    done = threading.Event()
+
+    def _warn():
+        if not done.wait(deadline):
+            log.warning(
+                "accelerator bring-up has taken >%.0fs — the device "
+                "backend or its tunnel may be down. Rerun with "
+                "GOLEFT_TPU_CPU=1 to execute on the host CPU instead.",
+                deadline,
+            )
+
+    t = threading.Thread(target=_warn, daemon=True)
+    t.start()
+    try:
+        return jax.devices()
+    finally:
+        done.set()

@@ -1,0 +1,51 @@
+"""Device bring-up guard: escape hatch + hang watchdog."""
+
+import logging
+import time
+
+from goleft_tpu.utils import device_guard
+
+
+def test_maybe_force_cpu_honors_env(monkeypatch):
+    calls = []
+
+    class FakeConfig:
+        def update(self, k, v):
+            calls.append((k, v))
+
+    monkeypatch.setenv("GOLEFT_TPU_CPU", "1")
+    import jax
+
+    monkeypatch.setattr(jax, "config", FakeConfig())
+    assert device_guard.maybe_force_cpu() is True
+    assert calls == [("jax_platforms", "cpu")]
+
+
+def test_maybe_force_cpu_noop_without_env(monkeypatch):
+    monkeypatch.delenv("GOLEFT_TPU_CPU", raising=False)
+    assert device_guard.maybe_force_cpu() is False
+
+
+def test_watchdog_warns_on_slow_bringup(monkeypatch, caplog):
+    import jax
+
+    def slow_devices():
+        time.sleep(0.25)
+        return ["dev0"]
+
+    monkeypatch.setattr(jax, "devices", slow_devices)
+    with caplog.at_level(logging.WARNING, logger="goleft-tpu.device"):
+        out = device_guard.devices_with_watchdog(seconds=0.05)
+    assert out == ["dev0"]
+    assert any("GOLEFT_TPU_CPU=1" in r.message for r in caplog.records)
+
+
+def test_watchdog_silent_on_fast_bringup(monkeypatch, caplog):
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: ["dev0"])
+    with caplog.at_level(logging.WARNING, logger="goleft-tpu.device"):
+        out = device_guard.devices_with_watchdog(seconds=5)
+    time.sleep(0.05)
+    assert out == ["dev0"]
+    assert not caplog.records
