@@ -213,3 +213,18 @@ def test_covstats_cli_on_foreign_bam(capsys):
     row = buf.getvalue().splitlines()[1].split("\t")
     assert row[-1] == "Test1"  # @RG SM from the foreign header
     assert row[0] == "0.00" and row[11] == "0"
+
+
+def test_indexsplit_cli_on_foreign_bam(capsys):
+    """indexsplit over the foreign 180-contig index: region set pinned
+    (even-data chunking, outlier chop, per-chrom budgets all run on
+    real samtools-written linear indexes)."""
+    from goleft_tpu.commands.indexsplit import main
+
+    main(["-n", "20",
+          _p("indexcov", "test-data", "sample_issue_27_0001.bam")])
+    lines = capsys.readouterr().out.splitlines()
+    assert len(lines) == 201
+    assert lines[0] == "KU215903\t0\t5462\t627.74\t3"
+    assert lines[1] == "KU215903\t5462\t10924\t627.74\t3"
+    assert lines[-1] == "4011\t0\t6468\t0.00\t0"
