@@ -228,3 +228,21 @@ def test_indexsplit_cli_on_foreign_bam(capsys):
     assert lines[0] == "KU215903\t0\t5462\t627.74\t3"
     assert lines[1] == "KU215903\t5462\t10924\t627.74\t3"
     assert lines[-1] == "4011\t0\t6468\t0.00\t0"
+
+
+def test_depth_cli_on_hla_bam(tmp_path):
+    """depth over the foreign hla.bam (bwa-written records with varied
+    CIGARs on an HLA contig): all 482 reads align within the first 2000
+    bases — the windowed mean there is pinned, everything after is 0."""
+    from goleft_tpu.commands.depth import run_depth
+
+    fai = str(tmp_path / "hla.fai")
+    with open(fai, "w") as fh:
+        fh.write("HLA-A*01:01:01:01\t16571\t6\t60\t61\n"
+                 "chr22\t20001\t6\t60\t61\n")
+    run_depth(_p("depth", "test", "hla.bam"), str(tmp_path / "h"),
+              fai=fai, window=2000, mapq=1)
+    lines = open(str(tmp_path / "h.depth.bed")).read().splitlines()
+    assert len(lines) == 20
+    assert lines[0] == "HLA-A*01:01:01:01\t0\t2000\t17.18"
+    assert all(ln.endswith("\t0") for ln in lines[1:])
