@@ -198,9 +198,13 @@ def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
     shift = head >> 4
     if head & 1:
         # compressed table: uncompressed size first, then its
-        # compressed byte count, then a bare rans-o0 stream
+        # compressed byte count, then a bare rans-o0 stream. A full
+        # 256x256 uint7 table tops out well under 4MB — larger claims
+        # are corruption, rejected before any allocation.
         ulen, pos = read_uint7(buf, pos)
         clen, pos = read_uint7(buf, pos)
+        if ulen > 1 << 22:
+            raise ValueError("rans-nx16: implausible o1 table size")
         table = _decode_rans0(buf, pos, ulen, 4)
         pos += clen
         tbuf, tpos = memoryview(table), 0
@@ -434,6 +438,14 @@ def decode(data: bytes, expected_len: int | None = None) -> bytes:
         out_len = expected_len
     else:
         out_len, pos = read_uint7(buf, pos)
+        if expected_len is not None and out_len != expected_len:
+            # the CRAM block header declares the raw size; a stored size
+            # that disagrees is corruption — and checking BEFORE any
+            # allocation stops a crafted varint from demanding memory
+            raise ValueError(
+                f"rans-nx16: stored size {out_len} != declared block "
+                f"size {expected_len}"
+            )
     if flags & F_STRIPE:
         n_lanes = buf[pos]
         pos += 1
@@ -472,15 +484,20 @@ def decode(data: bytes, expected_len: int | None = None) -> bytes:
         mlen, pos = read_uint7(buf, pos)
         raw = mlen & 1
         body_len = mlen >> 1
-        rle_out_len = out_len
         out_len, pos = read_uint7(buf, pos)  # literal count
         if raw:
             meta = bytes(buf[pos:pos + body_len])
             pos += body_len
         else:
             # meta itself is a bare rans-o0 stream: uncompressed size
-            # first, then body_len compressed bytes
+            # first, then body_len compressed bytes. Size is bounded by
+            # the output: at most one run varint per output byte, each
+            # ≤ 10 bytes even when written non-minimally (0x80-padded —
+            # the same spec lenience ITF8 parsing preserves), so meta
+            # stays O(out_len); larger claims are corruption.
             um, pos = read_uint7(buf, pos)
+            if um > 10 * rle_out_len + 4096:
+                raise ValueError("rans-nx16: implausible RLE meta size")
             meta = _decode_rans0(buf, pos, um, 4)
             pos += body_len
         mpos = 0

@@ -138,3 +138,37 @@ def test_rle_compressed_meta_path():
     mlen, _ = rx.read_uint7(enc, 1 + szlen)
     assert (mlen & 1) == 0, "expected compressed RLE metadata"
     assert rx.decode(enc) == data
+
+
+def test_decode_rejects_size_mismatch_before_allocating():
+    data = bytes(np.random.default_rng(7).integers(0, 50, 500,
+                                                   dtype=np.uint8))
+    enc = bytearray(rx.encode(data))
+    # corrupt the stored size varint into a huge value
+    huge = rx.write_uint7(1 << 50)
+    bad = bytes([enc[0]]) + huge + bytes(
+        enc[1 + len(rx.write_uint7(len(data))):])
+    with pytest.raises(ValueError, match="stored size"):
+        rx.decode(bad, expected_len=len(data))
+
+
+def test_mutation_fuzz_never_silent():
+    """Random single-byte mutations of valid streams must either decode
+    to SOME bytes of the declared length or raise — never hang, crash
+    the interpreter, or return a wrong-length result."""
+    import struct as _s
+
+    rng = np.random.default_rng(8)
+    base = bytes(rng.integers(0, 30, 2000, dtype=np.uint8))
+    for order in (0, 1):
+        enc = bytearray(rx.encode(base, order=order, use_rle=True))
+        for _ in range(120):
+            mut = bytearray(enc)
+            i = int(rng.integers(0, len(mut)))
+            mut[i] ^= int(rng.integers(1, 256))
+            try:
+                out = rx.decode(bytes(mut), expected_len=len(base))
+                assert len(out) == len(base)
+            except (ValueError, IndexError, KeyError, _s.error,
+                    MemoryError, OverflowError):
+                pass
