@@ -246,3 +246,26 @@ def test_depth_cli_on_hla_bam(tmp_path):
     assert len(lines) == 20
     assert lines[0] == "HLA-A*01:01:01:01\t0\t2000\t17.18"
     assert all(ln.endswith("\t0") for ln in lines[1:])
+
+
+def test_depth_cli_with_reference_windows_bed(tmp_path):
+    """-b with the reference's own windows.bed (its functional-test
+    input): one region per bed line, no merging (depth.go:103-120),
+    windows grid-aligned and clipped to each region. Row inventory and
+    boundary rows pinned."""
+    from goleft_tpu.commands.depth import run_depth
+
+    run_depth(_p("depth", "test", "t.bam"), str(tmp_path / "b"),
+              fai=_p("depth", "test", "hg19.fa.fai"),
+              bed=_p("depth", "test", "windows.bed"),
+              window=1000, mapq=1)
+    lines = open(str(tmp_path / "b.depth.bed")).read().splitlines()
+    # region row counts: (14250,15500)->2, (1575,15800)->15, chrM:
+    # (100,1000)->1, (2000,5000)->3, five sub-window regions -> 5
+    assert len(lines) == 26
+    assert lines[0] == "chr22\t14250\t15000\t1.653"
+    assert lines[1] == "chr22\t15000\t15500\t14.03"
+    assert lines[2] == "chr22\t1575\t2000\t1.271"
+    assert lines[16] == "chr22\t15000\t15800\t9.155"
+    assert lines[17] == "chrM\t100\t1000\t1045"
+    assert lines[-1] == "chrM\t39\t43\t489.8"
