@@ -269,3 +269,19 @@ def test_depth_cli_with_reference_windows_bed(tmp_path):
     assert lines[16] == "chr22\t15000\t15800\t9.155"
     assert lines[17] == "chrM\t100\t1000\t1045"
     assert lines[-1] == "chrM\t39\t43\t489.8"
+
+
+def test_multidepth_cli_on_foreign_bam(capsys):
+    """Joint depth blocks over the foreign t.bam (passed twice so the
+    strict > minSamples quirk — multidepth.go:170, faithfully kept —
+    admits blocks): qualifying-run block boundaries and %.2f means
+    pinned."""
+    from goleft_tpu.commands.multidepth import main
+
+    main(["-c", "chrM", "--mincov", "200",
+          _p("depth", "test", "t.bam"), _p("depth", "test", "t.bam")])
+    lines = capsys.readouterr().out.splitlines()
+    assert lines[0] == "#chrom\tstart\tend\tTest1\tTest1"
+    assert len(lines) == 3
+    assert lines[1] == "chrM\t15\t2616\t901.14\t901.14"
+    assert lines[2] == "chrM\t2702\t5066\t867.82\t867.82"
