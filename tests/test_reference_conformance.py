@@ -285,3 +285,29 @@ def test_multidepth_cli_on_foreign_bam(capsys):
     assert len(lines) == 3
     assert lines[1] == "chrM\t15\t2616\t901.14\t901.14"
     assert lines[2] == "chrM\t2702\t5066\t867.82\t867.82"
+
+
+def test_dcnv_full_stack_on_foreign_bam_and_fasta(tmp_path, capsys):
+    """cohortdepth over the foreign t.bam feeding dcnv's GC-debias
+    against the REAL hg19.fa the reference ships: windows sort by
+    foreign GC content, moving-median divide, unsort, sample-median
+    normalize — first/last normalized rows pinned."""
+    import shutil
+
+    from goleft_tpu.commands.cohortdepth import run_cohortdepth
+    from goleft_tpu.commands.dcnv_cmd import main as dcnv_main
+
+    shutil.copyfile(_p("depth", "test", "hg19.fa"),
+                    str(tmp_path / "hg19.fa"))
+    shutil.copyfile(_p("depth", "test", "hg19.fa.fai"),
+                    str(tmp_path / "hg19.fa.fai"))
+    with open(str(tmp_path / "m.tsv"), "w") as fh:
+        run_cohortdepth([_p("depth", "test", "t.bam")] * 3,
+                        fai=str(tmp_path / "hg19.fa.fai"), window=500,
+                        out=fh)
+    dcnv_main(["-f", str(tmp_path / "hg19.fa"), str(tmp_path / "m.tsv")])
+    lines = capsys.readouterr().out.splitlines()
+    assert len(lines) == 76  # 34 chrM + 41 chr22 windows + header
+    assert lines[0] == "#chrom\tstart\tend\tTest1\tTest1\tTest1"
+    assert lines[1] == "chrM\t0\t500\t119.333\t119.333\t119.333"
+    assert lines[-1] == "chr22\t20000\t20001\t1.000\t1.000\t1.000"
