@@ -570,9 +570,55 @@ def host_suite(quick: bool) -> dict:
     return out
 
 
+def _device_backend_usable(timeout_s: float = 120.0) -> bool:
+    """Probe accelerator bring-up in a SUBPROCESS so a wedged tunnel
+    (which hangs jax.devices() indefinitely) cannot turn the benchmark
+    run into silence. The probe asserts a NON-CPU platform — a silent
+    CPU fallback backend must not green-light the device suite.
+
+    The child is never killed: SIGKILLing a client mid-bring-up is
+    itself a documented way to wedge the remote session. On timeout the
+    orphan is left to finish (it exits cleanly on its own if bring-up
+    was merely slow) and this run conservatively takes the host path.
+    A successful probe is followed by a short settle so the bench's own
+    client doesn't race the probe client's teardown."""
+    import subprocess
+    import time as _time
+
+    try:
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "assert d and d[0].platform != 'cpu', d"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+    except OSError:
+        return False
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        rc = child.poll()
+        if rc is not None:
+            if rc == 0:
+                _time.sleep(5)  # let the probe client's session settle
+                return True
+            return False
+        _time.sleep(1)
+    # still hanging: leave it be (no kill) and take the host path
+    return False
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     quick = "--quick" in argv
+    if "--suite-host" not in argv and "--no-probe" not in argv:
+        if not _device_backend_usable():
+            print(
+                "bench: accelerator backend unusable (probe timed out "
+                "or failed) — falling back to --suite-host so the run "
+                "still records honest host-side numbers",
+                file=sys.stderr,
+            )
+            argv = list(argv) + ["--suite-host"]
     if "--suite-host" in argv:
         # accelerator-free fallback: refresh the host-side entries and
         # the cohort headline (pure host) without touching the device.
