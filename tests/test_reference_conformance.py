@@ -311,3 +311,28 @@ def test_dcnv_full_stack_on_foreign_bam_and_fasta(tmp_path, capsys):
     assert lines[0] == "#chrom\tstart\tend\tTest1\tTest1\tTest1"
     assert lines[1] == "chrM\t0\t500\t119.333\t119.333\t119.333"
     assert lines[-1] == "chr22\t20000\t20001\t1.000\t1.000\t1.000"
+
+
+def test_anonymize_foreign_bam_indexcov_roundtrip(tmp_path, capsys):
+    """anonymize(t.bam) (header rewritten, ORIGINAL samtools .bai copied
+    beside it — main.go:63-76) then indexcov over the pair. chrM is
+    absent by faithful parity: its linear index has a single interval
+    and both implementations drop <2-interval refs (types.go:67-69 /
+    io/bai.py sizes)."""
+    import gzip
+
+    from goleft_tpu.commands.anonymize import main as anon_main
+    from goleft_tpu.commands.indexcov import run_indexcov
+
+    anon_main(["coh", _p("depth", "test", "t.bam"),
+               "-d", str(tmp_path)])
+    capsys.readouterr()
+    bam = str(tmp_path / "sample_coh_0001.bam")
+    assert os.path.exists(bam) and os.path.exists(bam + ".bai")
+    out = run_indexcov([bam], directory=str(tmp_path / "ix"), sex="",
+                       exclude_patt="", write_png=False,
+                       write_html=False)
+    rows = gzip.open(out["bed"]).read().decode().splitlines()
+    assert rows[0] == "#chrom\tstart\tend\tsample_coh_0001"
+    assert rows[1] == "chr22\t0\t16384\t1"
+    assert len(rows) == 2
