@@ -371,21 +371,29 @@ def _cols_from_decode(out: dict) -> "ReadColumns":
 
 def _parse_header_buf(buf) -> tuple[BamHeader, int]:
     """Parse the BAM header block from an uncompressed buffer; returns
-    (header, offset of first alignment record)."""
+    (header, offset of first alignment record). Corrupt header geometry
+    surfaces as ValueError — the module's one error type for bad input
+    (raw struct/unicode errors would leak through every CLI)."""
     if bytes(buf[:4]) != BAM_MAGIC:
         raise ValueError("not a BAM file (bad magic)")
-    (l_text,) = struct.unpack_from("<i", buf, 4)
-    text = bytes(buf[8 : 8 + l_text]).rstrip(b"\x00").decode()
-    off = 8 + l_text
-    (n_ref,) = struct.unpack_from("<i", buf, off)
-    off += 4
-    names, lens = [], []
-    for _ in range(n_ref):
-        (l_name,) = struct.unpack_from("<i", buf, off)
-        names.append(bytes(buf[off + 4 : off + 4 + l_name - 1]).decode())
-        (l_ref,) = struct.unpack_from("<i", buf, off + 4 + l_name)
-        lens.append(l_ref)
-        off += 8 + l_name
+    try:
+        (l_text,) = struct.unpack_from("<i", buf, 4)
+        text = bytes(buf[8 : 8 + l_text]).rstrip(b"\x00").decode()
+        off = 8 + l_text
+        (n_ref,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        if l_text < 0 or n_ref < 0:
+            raise ValueError("bam: negative header length")
+        names, lens = [], []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack_from("<i", buf, off)
+            names.append(
+                bytes(buf[off + 4 : off + 4 + l_name - 1]).decode())
+            (l_ref,) = struct.unpack_from("<i", buf, off + 4 + l_name)
+            lens.append(l_ref)
+            off += 8 + l_name
+    except (struct.error, UnicodeDecodeError) as e:
+        raise ValueError(f"bam: corrupt header ({e})") from e
     return BamHeader(text, names, lens), off
 
 
@@ -415,15 +423,21 @@ class BamFile:
                 "BamFile got CRAM bytes — open with io.cram.CramFile "
                 "(open_bam_file routes automatically)"
             )
-        scan = None
-        try:
-            scan = native.bgzf_scan(data)
-        except Exception:
-            scan = None
+        # the pure-Python fallback exists for hosts WITHOUT the native
+        # toolchain — a scan error on a corrupt file must surface as the
+        # module's clean error, not get retried (and fail with a raw
+        # zlib.error) through the Python codec (found by the stream
+        # corruption fuzz)
+        scan = native.bgzf_scan(data)  # None only when native is absent
         if scan is None:
-            raw = bgzf_decompress(
-                bytes(data) if not isinstance(data, bytes) else data
-            )
+            import zlib
+
+            try:
+                raw = bgzf_decompress(
+                    bytes(data) if not isinstance(data, bytes) else data
+                )
+            except zlib.error as e:
+                raise ValueError(f"bgzf: corrupt deflate stream ({e})")
             self.body = np.frombuffer(raw, dtype=np.uint8)
             self._co = self._uo = None
             self._comp = None
@@ -475,7 +489,16 @@ class BamFile:
             return cls(fh.read())
 
     def _block_of(self, voff: int) -> int:
-        blk = int(np.searchsorted(self._co, voff >> 16, side="right")) - 1
+        coff = voff >> 16
+        if coff > int(self._co[-1]):
+            # the index promises data past the last block — a truncated
+            # file with its stale .bai would otherwise decode as silent
+            # zero depth for every shard beyond the cut
+            raise ValueError(
+                "bam: virtual offset beyond file end (truncated file "
+                "or stale index)"
+            )
+        blk = int(np.searchsorted(self._co, coff, side="right")) - 1
         return max(blk, 0)
 
     def voffset_to_offset(self, voff: int) -> int:
@@ -734,15 +757,22 @@ def read_header_only(path: str, initial: int = 1 << 20) -> BamHeader:
 
 def open_bam(data, lazy: bool = False):
     """Decoded-BAM handle: native fast path when available, else the
-    pure-Python streaming adapter (same read_columns signature)."""
+    pure-Python streaming adapter (same read_columns signature).
+
+    Corrupt data raises ValueError from whichever codec runs — the
+    Python path is a fallback for hosts WITHOUT the native library,
+    never a retry for bytes the native codec rejected (retrying corrupt
+    bytes through zlib leaked raw zlib.error; stream-fuzz finding)."""
+    import zlib
+
     from . import native
 
     if native.get_lib() is not None:
-        try:
-            return BamFile(data, lazy=lazy)
-        except Exception:
-            pass
-    return _PyBamAdapter(data)
+        return BamFile(data, lazy=lazy)
+    try:
+        return _PyBamAdapter(data)
+    except zlib.error as e:
+        raise ValueError(f"bgzf: corrupt deflate stream ({e})")
 
 
 def read_alignment_header(path: str) -> BamHeader:
@@ -773,13 +803,15 @@ def open_bam_file(path: str, lazy: bool = True):
             return CramFile.from_file(path)
         except ValueError as e:
             raise SystemExit(f"{path}: CRAM open failed: {e}") from e
-    if lazy and native.get_lib() is not None:
-        try:
+    try:
+        if lazy and native.get_lib() is not None:
             return BamFile.from_file(path, lazy=True)
-        except Exception:
-            pass
-    with open(path, "rb") as fh:
-        return open_bam(fh.read(), lazy=False)
+        with open(path, "rb") as fh:
+            return open_bam(fh.read(), lazy=False)
+    except ValueError as e:
+        # clean CLI surface for corrupt/truncated input, mirroring the
+        # CRAM branch above
+        raise SystemExit(f"{path}: {e}") from e
 
 
 def reg2bin(beg: int, end: int) -> int:

@@ -4,9 +4,14 @@ import numpy as np
 import pytest
 
 from goleft_tpu.commands.depth import run_depth
+from goleft_tpu.io import native
 from goleft_tpu.io.bam import open_bam_file
 from goleft_tpu.io.fai import write_fai
 from helpers import write_bam_and_bai, write_fasta, random_reads
+
+needs_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
 
 
 def test_cram_input_clear_error(tmp_path):
@@ -16,7 +21,12 @@ def test_cram_input_clear_error(tmp_path):
         open_bam_file(str(p))
 
 
+@needs_native
 def test_depth_truncated_bam_fails_cleanly(tmp_path, capsys):
+    """Structure-level truncation (mid-BGZF-block) is caught at OPEN
+    with a clean path-prefixed message — not retried through the Python
+    codec into a raw zlib.error (stream-fuzz finding), and not N shard
+    banners for a file that can't be read at all."""
     rng = np.random.default_rng(0)
     reads = random_reads(rng, 2000, 0, 100_000)
     p = str(tmp_path / "t.bam")
@@ -26,6 +36,29 @@ def test_depth_truncated_bam_fails_cleanly(tmp_path, capsys):
     data = open(p, "rb").read()
     with open(p, "wb") as fh:
         fh.write(data[: len(data) * 3 // 4 + 7])
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 100_000})
+    write_fai(fa)
+    with pytest.raises(SystemExit, match="truncated"):
+        run_depth(p, str(tmp_path / "o"), reference=fa, window=10_000)
+
+
+@needs_native
+def test_depth_record_level_truncation_shard_banner(tmp_path, capsys):
+    """Truncation at a BGZF block boundary scans clean but cuts a
+    record mid-stream: the OPEN succeeds, the affected shard reports
+    the red banner, and depth exits nonzero (reference max-exit-code
+    behavior, depth.go:395-399)."""
+    from goleft_tpu.io.native import bgzf_scan
+
+    rng = np.random.default_rng(0)
+    reads = random_reads(rng, 2000, 0, 100_000)
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(100_000,))
+    data = open(p, "rb").read()
+    co, uo, total = bgzf_scan(np.frombuffer(data, np.uint8))
+    cut = int(co[2 * len(co) // 3])
+    with open(p, "wb") as fh:
+        fh.write(data[:cut])
     fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * 100_000})
     write_fai(fa)
     with pytest.raises(SystemExit):
