@@ -47,8 +47,11 @@ PROGS = {
              _lazy(".commands.dcnv_cmd"), True),
     "cnveval": ("evaluate CNV calls against a truth set",
                 _lazy(".commands.cnveval_cmd"), False),
+    # bench manages its own device probe (subprocess, non-hanging) and
+    # falls back to host mode itself — dispatch must not bring the
+    # backend up first
     "bench": ("run the TPU benchmark suite",
-              _lazy(".commands.bench_cmd"), True),
+              _lazy(".commands.bench_cmd"), False),
     "anonymize": ("make shareable header-only bam+bai fixtures",
                   _lazy(".commands.anonymize"), False),
     "cohortdepth": ("depth matrix for many bams in one device pass",
