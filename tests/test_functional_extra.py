@@ -119,3 +119,44 @@ def test_indexcov_n_backgrounds_env(monkeypatch):
     monkeypatch.delenv("INDEXCOV_N_BACKGROUNDS")
     _, js3 = report.line_chart("c", series, "x", "y")
     assert gray not in js3
+
+
+def test_save_png_pil_renderer(tmp_path, monkeypatch):
+    """The Pillow chart rasterizer: line/step and scatter kinds, NaN
+    points dropped, y_max clamp, vertex cap — and the INDEXCOV_FMT
+    matplotlib fallback still writes every requested format."""
+    import numpy as np
+    from PIL import Image
+
+    from goleft_tpu.utils import report
+
+    monkeypatch.delenv("INDEXCOV_FMT", raising=False)
+    x = np.arange(5000, dtype=np.float64) * 16384
+    y = np.abs(np.sin(x / 3e6)) * 2.0
+    y[10] = np.nan
+    series = [{"label": "s0", "x": x, "y": y},
+              {"label": "s1", "x": x[:100], "y": y[:100] * 0.5}]
+    p = str(tmp_path / "depth.png")
+    report.save_png(p, series, "position", "scaled coverage", y_max=2.5)
+    im = Image.open(p)
+    assert im.size == (480, 360) and im.mode == "RGB"
+    # the canvas is not blank: plotted pixels differ from white
+    assert np.asarray(im).min() < 250
+
+    sp = str(tmp_path / "sc.png")
+    report.save_png(sp, [{"label": "pts", "x": x[:20] / 1e6,
+                          "y": y[:20]}], "a", "b", kind="scatter")
+    assert Image.open(sp).size == (480, 360)
+
+    # empty series: still a valid image, no crash
+    ep = str(tmp_path / "empty.png")
+    report.save_png(ep, [{"label": "e", "x": x[:0], "y": y[:0]}],
+                    "a", "b")
+    assert Image.open(ep).size == (480, 360)
+
+    # INDEXCOV_FMT routes through matplotlib and writes the extra format
+    monkeypatch.setenv("INDEXCOV_FMT", "svg")
+    fp = str(tmp_path / "fmt.png")
+    report.save_png(fp, series, "a", "b")
+    assert os.path.exists(fp)
+    assert os.path.exists(str(tmp_path / "fmt.svg"))
