@@ -157,6 +157,66 @@ long bgzf_inflate_range(const uint8_t* data, long len, long c_begin,
     return total;
 }
 
+// Compress one BGZF block: write the 18-byte member header, the raw
+// deflate payload, and the crc32/isize trailer into out. Returns the
+// total member size, or negative: -2 payload over the 65280-byte BGZF
+// input cap, -3 out_cap too small, -4 allocator failure, -5 compressor
+// error, -6 member would exceed the 65536-byte BGZF limit (cannot
+// happen for payloads within the input cap). The libdeflate compressor
+// is cached per (thread, level) — allocation is the expensive part of
+// small-block compression.
+long bgzf_deflate_block(const uint8_t* data, long len, int level,
+                        uint8_t* out, long out_cap) {
+    if (len < 0 || len > 65280) return -2;  // BGZF cap minus overhead
+#ifndef NO_LIBDEFLATE
+    static thread_local struct libdeflate_compressor* comp = nullptr;
+    static thread_local int comp_level = -1;
+    if (comp == nullptr || comp_level != level) {
+        if (comp) libdeflate_free_compressor(comp);
+        comp = libdeflate_alloc_compressor(level);
+        comp_level = level;
+        if (!comp) return -4;
+    }
+    size_t max_out = libdeflate_deflate_compress_bound(comp, (size_t)len);
+    if ((long)(18 + max_out + 8) > out_cap) return -3;
+    size_t clen = libdeflate_deflate_compress(comp, data, (size_t)len,
+                                              out + 18, max_out);
+    if (clen == 0) return -5;
+    uint32_t crc = libdeflate_crc32(0, data, (size_t)len);
+#else
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK)
+        return -4;
+    zs.next_in = const_cast<uint8_t*>(data);
+    zs.avail_in = (uInt)len;
+    zs.next_out = out + 18;
+    zs.avail_out = (uInt)(out_cap - 26 > 0 ? out_cap - 26 : 0);
+    int r = deflate(&zs, Z_FINISH);
+    size_t clen = zs.total_out;
+    deflateEnd(&zs);
+    if (r != Z_STREAM_END) return -3;
+    uint32_t crc = crc32(0L, data, (uInt)len);
+#endif
+    long bsize = 18 + (long)clen + 8;
+    if (bsize > out_cap) return -3;
+    if (bsize > 65536) return -6;
+    // 18-byte BGZF member header with the BC subfield
+    out[0] = 0x1F; out[1] = 0x8B; out[2] = 8; out[3] = 4;
+    memset(out + 4, 0, 6);
+    out[9] = 0xFF;
+    out[10] = 6; out[11] = 0;          // XLEN
+    out[12] = 0x42; out[13] = 0x43;    // 'B' 'C'
+    out[14] = 2; out[15] = 0;
+    uint16_t bs16 = (uint16_t)(bsize - 1);
+    memcpy(out + 16, &bs16, 2);
+    memcpy(out + 18 + clen, &crc, 4);
+    uint32_t isize = (uint32_t)len;
+    memcpy(out + 18 + clen + 4, &isize, 4);
+    return bsize;
+}
+
 // CIGAR op properties: MIDNSHP=X
 static const int CONSUMES_REF[9] = {1, 0, 1, 1, 0, 0, 0, 1, 1};
 static const int CONSUMES_QUERY[9] = {1, 1, 0, 0, 1, 0, 0, 1, 1};

@@ -80,6 +80,7 @@ def get_lib() -> ctypes.CDLL | None:
         lib.bam_window_reduce.restype = ctypes.c_long
         lib.bam_window_reduce_stream.restype = ctypes.c_long
         lib.bam_window_acc_stream.restype = ctypes.c_long
+        lib.bgzf_deflate_block.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -262,6 +263,27 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
         out["consumed"] = int(consumed.value)
         out["done"] = bool(done.value)
         return out
+
+
+def bgzf_deflate_block(chunk: bytes, level: int) -> bytes | None:
+    """One complete BGZF member (header + deflate + crc/isize) for
+    ``chunk`` (≤ 65280 bytes) via libdeflate; None when native is
+    unavailable (callers fall back to zlib)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(chunk)
+    # worst case up front: deflate expansion is bounded well under 2x
+    # (~130KB max for a full 65280-byte block), so one call suffices
+    cap = len(buf) * 2 + 4096
+    out = np.empty(cap, dtype=np.uint8)
+    n = lib.bgzf_deflate_block(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_int(level),
+        _ptr(out), ctypes.c_long(cap),
+    )
+    if n < 0:
+        return None  # fall back to the zlib path
+    return out[:n].tobytes()
 
 
 def bai_scan(data):
