@@ -58,3 +58,42 @@ def test_cohortdepth_header_names(tmp_path):
     run_cohortdepth([p], reference=fa, window=1000, out=out)
     hdr = out.getvalue().splitlines()[0]
     assert hdr == "#chrom\tstart\tend\tsampleA"
+
+
+def test_blocks_hybrid_threaded_path_matches_serial(tmp_path,
+                                                    monkeypatch):
+    """The double-buffered thread-pool path (what multi-core hosts run)
+    must produce byte-identical output to the single-core inline path —
+    on this 1-core host the threaded branch is otherwise never taken,
+    so force the core-count gate both ways."""
+    import io
+
+    import numpy as np
+
+    from goleft_tpu.commands import cohortdepth as cd
+    from goleft_tpu.io.fai import write_fai
+    from helpers import write_bam_and_bai, write_fasta, random_reads
+
+    rng = np.random.default_rng(12)
+    ref_len = 120_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(4):
+        reads = random_reads(rng, 2000, 0, ref_len)
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:t{i}\n")
+        p = str(tmp_path / f"t{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,), header_text=hdr)
+        bams.append(p)
+
+    outs = {}
+    for cores in (1, 4):
+        monkeypatch.setattr(cd, "effective_cores", lambda c=cores: c)
+        buf = io.StringIO()
+        cd.run_cohortdepth(bams, reference=fa, window=500, out=buf,
+                           engine="hybrid", processes=4)
+        outs[cores] = buf.getvalue()
+    assert outs[1] == outs[4]
+    assert len(outs[1].splitlines()) == ref_len // 500 + 1
