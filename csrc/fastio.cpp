@@ -217,6 +217,169 @@ long bgzf_deflate_block(const uint8_t* data, long len, int level,
     return bsize;
 }
 
+// ---- rANS 4x8 decode (CRAM 3.0 block method 4) ---------------------
+//
+// C port of io/cram.py::_rans_decode_0/_rans_decode_1 (the pure-Python
+// loops were ~55% of CRAM decode wall). Layout: u7 frequencies (1 byte
+// <128, else 0x80|hi,lo), symbol/context lists with adjacent-run RLE,
+// 12-bit frequencies, 4 interleaved states with 8-bit renormalization
+// below 1<<23. Order-0 interleaves round-robin (i&3); order-1 splits
+// the output into quarters with per-stream context carry. Returns 0,
+// or negative: -1 malformed/truncated stream, -9 missing o1 context.
+
+static inline long rans_u7(const uint8_t* buf, long len, long* pos,
+                           uint32_t* v) {
+    if (*pos >= len) return -1;
+    uint8_t b0 = buf[(*pos)++];
+    if (b0 < 0x80) { *v = b0; return 0; }
+    if (*pos >= len) return -1;
+    *v = ((uint32_t)(b0 & 0x7F) << 8) | buf[(*pos)++];
+    return 0;
+}
+
+// Parse one order-0 frequency table into freq[256]/cum[257]/lut[4096].
+static long rans_freqs0(const uint8_t* buf, long len, long* pos,
+                        uint16_t* freq, uint32_t* cum, uint8_t* lut) {
+    memset(freq, 0, 256 * sizeof(uint16_t));
+    if (*pos >= len) return -1;
+    int sym = buf[(*pos)++];
+    int last_sym = sym;
+    int rle = 0;
+    while (1) {
+        uint32_t f;
+        if (rans_u7(buf, len, pos, &f) < 0) return -1;
+        freq[sym] = (uint16_t)f;
+        if (rle > 0) {
+            rle--;
+            sym++;
+            if (sym > 255) return -1;
+        } else {
+            if (*pos >= len) return -1;
+            sym = buf[(*pos)++];
+            if (sym == last_sym + 1) {
+                if (*pos >= len) return -1;
+                rle = buf[(*pos)++];
+            }
+            last_sym = sym;
+        }
+        if (sym == 0 && rle == 0) break;
+    }
+    uint32_t c = 0;
+    for (int s = 0; s < 256; s++) {
+        cum[s] = c;
+        c += freq[s];
+    }
+    cum[256] = c;
+    if (c > 4096) return -1;
+    for (int s = 0; s < 256; s++)
+        if (freq[s])
+            memset(lut + cum[s], s, freq[s]);
+    return 0;
+}
+
+long rans4x8_decode(const uint8_t* buf, long len, long pos, int order,
+                    uint8_t* out, long out_len) {
+    if (out_len == 0) return 0;
+    if (order == 0) {
+        uint16_t freq[256];
+        uint32_t cum[257];
+        static thread_local uint8_t lut[4096];
+        memset(lut, 0, sizeof(lut));
+        if (rans_freqs0(buf, len, &pos, freq, cum, lut) < 0) return -1;
+        if (pos + 16 > len) return -1;
+        uint32_t R[4];
+        memcpy(R, buf + pos, 16);
+        pos += 16;
+        for (long i = 0; i < out_len; i++) {
+            int j = i & 3;
+            uint32_t x = R[j];
+            uint32_t m = x & 4095;
+            uint8_t s = lut[m];
+            out[i] = s;
+            x = (uint32_t)freq[s] * (x >> 12) + m - cum[s];
+            while (x < (1u << 23) && pos < len)
+                x = (x << 8) | buf[pos++];
+            R[j] = x;
+        }
+        return 0;
+    }
+    if (order != 1) return -1;
+    // order-1: lazily allocated per-context tables
+    struct Ctx {
+        uint16_t freq[256];
+        uint32_t cum[257];
+        uint8_t lut[4096];
+    };
+    // RAII holder: per-call pools destroy worker threads, so the
+    // 1.4MB table block must free on thread exit, not leak per thread
+    struct CtxHolder {
+        Ctx* p = nullptr;
+        ~CtxHolder() { free(p); }
+    };
+    static thread_local CtxHolder holder;
+    static thread_local uint8_t present[256];
+    if (!holder.p) {
+        holder.p = (Ctx*)malloc(256 * sizeof(Ctx));
+        if (!holder.p) return -4;
+    }
+    Ctx* const ctxs = holder.p;
+    memset(present, 0, 256);
+    if (pos >= len) return -1;
+    int ctx = buf[pos++];
+    int last_ctx = ctx;
+    int rle = 0;
+    while (1) {
+        if (ctx < 0 || ctx > 255) return -1;
+        memset(ctxs[ctx].lut, 0, 4096);
+        if (rans_freqs0(buf, len, &pos, ctxs[ctx].freq, ctxs[ctx].cum,
+                        ctxs[ctx].lut) < 0)
+            return -1;
+        present[ctx] = 1;
+        if (rle > 0) {
+            rle--;
+            ctx++;
+        } else {
+            if (pos >= len) return -1;
+            ctx = buf[pos++];
+            if (ctx == last_ctx + 1) {
+                if (pos >= len) return -1;
+                rle = buf[pos++];
+            }
+            last_ctx = ctx;
+        }
+        if (ctx == 0 && rle == 0) break;
+    }
+    if (pos + 16 > len) return -1;
+    uint32_t R[4];
+    memcpy(R, buf + pos, 16);
+    pos += 16;
+    long F = out_len >> 2;
+    long idx[4] = {0, F, 2 * F, 3 * F};
+    long ends[4] = {F, 2 * F, 3 * F, out_len};
+    uint8_t last[4] = {0, 0, 0, 0};
+    while (1) {
+        int done = 1;
+        for (int j = 0; j < 4; j++) {
+            if (idx[j] >= ends[j]) continue;
+            done = 0;
+            uint32_t x = R[j];
+            uint8_t c = last[j];
+            if (!present[c]) return -9;
+            uint32_t m = x & 4095;
+            uint8_t s = ctxs[c].lut[m];
+            out[idx[j]] = s;
+            x = (uint32_t)ctxs[c].freq[s] * (x >> 12) + m - ctxs[c].cum[s];
+            while (x < (1u << 23) && pos < len)
+                x = (x << 8) | buf[pos++];
+            R[j] = x;
+            last[j] = s;
+            idx[j]++;
+        }
+        if (done) break;
+    }
+    return 0;
+}
+
 // CIGAR op properties: MIDNSHP=X
 static const int CONSUMES_REF[9] = {1, 0, 1, 1, 0, 0, 0, 1, 1};
 static const int CONSUMES_QUERY[9] = {1, 1, 0, 0, 1, 0, 0, 1, 1};

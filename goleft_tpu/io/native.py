@@ -73,6 +73,19 @@ def get_lib() -> ctypes.CDLL | None:
         except OSError as e:
             log.warning("native load failed: %s", e)
             return None
+        try:
+            _register_restypes(lib)
+        except AttributeError as e:
+            # stale prebuilt library missing a newer symbol: honor the
+            # module contract (pure-Python fallback on ANY failure)
+            log.warning("native library is stale (%s) — rebuild "
+                        "build/libgoleftio.so; using Python codecs", e)
+            return None
+        _lib = lib
+        return _lib
+
+
+def _register_restypes(lib) -> None:
         lib.bgzf_scan.restype = ctypes.c_long
         lib.bgzf_inflate_all.restype = ctypes.c_long
         lib.bgzf_inflate_range.restype = ctypes.c_long
@@ -81,14 +94,13 @@ def get_lib() -> ctypes.CDLL | None:
         lib.bam_window_reduce_stream.restype = ctypes.c_long
         lib.bam_window_acc_stream.restype = ctypes.c_long
         lib.bgzf_deflate_block.restype = ctypes.c_long
+        lib.rans4x8_decode.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
         lib.bai_scan.restype = ctypes.c_long
         lib.format_xy_json.restype = ctypes.c_long
         lib.format_float_matrix_rows.restype = ctypes.c_long
-        _lib = lib
-        return _lib
 
 
 def _as_u8(data) -> np.ndarray:
@@ -263,6 +275,27 @@ def bam_decode(body: np.ndarray, offset: int, target_tid: int,
         out["consumed"] = int(consumed.value)
         out["done"] = bool(done.value)
         return out
+
+
+def rans4x8_decode(data, pos: int, order: int,
+                   out_len: int) -> bytes | None:
+    """CRAM 4x8 rANS decode (orders 0/1) in C; None when native is
+    unavailable (callers fall back to the pure-Python decoders).
+    Raises ValueError on malformed streams / missing o1 contexts."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    out = np.empty(out_len, dtype=np.uint8)
+    r = lib.rans4x8_decode(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
+        ctypes.c_int(order), _ptr(out), ctypes.c_long(out_len),
+    )
+    if r == -9:
+        raise ValueError("cram: rans missing order-1 context")
+    if r < 0:
+        raise ValueError("cram: malformed rans stream")
+    return out.tobytes()
 
 
 def bgzf_deflate_block(chunk: bytes, level: int) -> bytes | None:

@@ -193,3 +193,53 @@ def test_rans_order1_missing_context_fails_loudly():
         out = rans_decode(bytes(blob))
         if out != payload:
             raise ValueError("corrupt stream must not decode silently")
+
+
+@pytest.mark.native_io
+def test_native_rans_matches_python_decoders():
+    """The C rans4x8 decoder must agree byte-for-byte with the pure-
+    Python reference decoders on fuzzed encoder output, including the
+    adjacent-symbol RLE tables and two-byte u7 frequencies."""
+    import numpy as np
+
+    from goleft_tpu.io import native
+    from goleft_tpu.io.cram import (
+        _rans_decode_0, _rans_decode_1, rans_encode_0, rans_encode_1,
+    )
+
+    if native.get_lib() is None:
+        pytest.skip("native unavailable")
+    rng = np.random.default_rng(9)
+    cases = [
+        bytes(rng.integers(0, 256, 4000, dtype=np.uint8)),
+        bytes(rng.integers(60, 70, 9000, dtype=np.uint8)),  # RLE symbols
+        bytes([255] * 100 + [0] * 100 + list(range(250, 256)) * 40),
+        bytes(rng.choice([0, 127, 128, 255], size=5000).astype(np.uint8)),
+        b"ACGT" * 2000,
+    ]
+    for data in cases:
+        e0 = rans_encode_0(data)
+        want0 = _rans_decode_0(memoryview(e0), 9, len(data))
+        got0 = native.rans4x8_decode(e0, 9, 0, len(data))
+        assert got0 == want0 == data
+        if len(data) >= 4:
+            e1 = rans_encode_1(data)
+            want1 = _rans_decode_1(memoryview(e1), 9, len(data))
+            got1 = native.rans4x8_decode(e1, 9, 1, len(data))
+            assert got1 == want1 == data
+
+
+@pytest.mark.native_io
+def test_native_rans_rejects_truncation():
+    import numpy as np
+
+    from goleft_tpu.io import native
+    from goleft_tpu.io.cram import rans_encode_1
+
+    if native.get_lib() is None:
+        pytest.skip("native unavailable")
+    data = bytes(np.random.default_rng(10).integers(0, 50, 2000,
+                                                    dtype=np.uint8))
+    enc = rans_encode_1(data)
+    with pytest.raises(ValueError):
+        native.rans4x8_decode(enc[:12], 9, 1, len(data))
