@@ -452,8 +452,12 @@ def _decompress(method: int, data: bytes, raw_size: int) -> bytes:
         from .rans_nx16 import decode as nx16_decode
 
         return nx16_decode(data, raw_size)
-    if method in (M_ARITH, M_FQZCOMP, M_TOK3):
-        name = {M_ARITH: "adaptive arithmetic", M_FQZCOMP: "fqzcomp",
+    if method == M_ARITH:
+        from .arith import decode as arith_decode
+
+        return arith_decode(data, raw_size)
+    if method in (M_FQZCOMP, M_TOK3):
+        name = {M_FQZCOMP: "fqzcomp",
                 M_TOK3: "name tokeniser"}[method]
         raise ValueError(
             f"cram: 3.1 block codec '{name}' (method {method}) is not "
@@ -502,6 +506,11 @@ def write_block(method: int, ctype: int, cid: int, data: bytes,
 
         comp = nx16_encode(data, order=rans_order if len(data) >= 16
                            else 0)
+    elif method == M_ARITH:
+        from .arith import encode as arith_encode
+
+        comp = arith_encode(data, order=rans_order if len(data) >= 16
+                            else 0)
     elif method == M_RANS and (rans_order == 0 or len(data) < 4):
         comp = rans_encode_0(data)
     elif method == M_RANS:

@@ -16,7 +16,7 @@ import pytest
 from goleft_tpu.io import cram
 from goleft_tpu.io.bam import BamReader, open_bam_file, parse_cigar
 from goleft_tpu.io.cram import (
-    CramFile, CramWriter, M_GZIP, M_RANS, M_RANSNX16, M_RAW,
+    CramFile, CramWriter, M_ARITH, M_GZIP, M_RANS, M_RANSNX16, M_RAW,
     rans_decode, rans_encode_0, read_itf8, read_ltf8, write_itf8,
     write_ltf8,
 )
@@ -101,7 +101,8 @@ def _write_cram(path, reads, ref_names=("chr1", "chr2"),
 @pytest.mark.parametrize("method,rans_order,minor",
                          [(M_RAW, 0, 0), (M_GZIP, 0, 0), (M_RANS, 0, 0),
                           (M_RANS, 1, 0), (M_RANSNX16, 0, 1),
-                          (M_RANSNX16, 1, 1)])
+                          (M_RANSNX16, 1, 1),
+                          (M_ARITH, 0, 1), (M_ARITH, 1, 1)])
 def test_cram_matches_bam_twin_columns(tmp_path, method, rans_order,
                                        minor):
     rng = np.random.default_rng(9)

@@ -103,10 +103,9 @@ def test_nosz_requires_external_size():
 
 
 def test_unsupported_31_codecs_error_clearly():
-    from goleft_tpu.io.cram import _decompress, M_ARITH, M_FQZCOMP, M_TOK3
+    from goleft_tpu.io.cram import _decompress, M_FQZCOMP, M_TOK3
 
-    for m, nm in ((M_ARITH, "arithmetic"), (M_FQZCOMP, "fqzcomp"),
-                  (M_TOK3, "tokeniser")):
+    for m, nm in ((M_FQZCOMP, "fqzcomp"), (M_TOK3, "tokeniser")):
         with pytest.raises(ValueError, match=nm):
             _decompress(m, b"\x00\x01\x02", 3)
 
