@@ -1,0 +1,153 @@
+"""fqzcomp quality codec (CRAM 3.1 block method 7) twin tests.
+
+Same validation strategy as the rANS/arith codecs: an in-repo encoder
+fuzzes the decoder across the parameter surface (variable/fixed
+lengths, dedup, reversal, qmap, context tables), plus mutation fuzz
+asserting corrupt streams die with ValueError, never a crash or hang.
+"""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import fqzcomp as fq
+
+
+def _mkquals(rng, n_rec, ln_lo, ln_hi, fixed=None, maxq=45):
+    lens, out = [], bytearray()
+    for _ in range(n_rec):
+        ln = fixed if fixed else int(rng.integers(ln_lo, ln_hi))
+        lens.append(ln)
+        q = np.clip(np.cumsum(rng.integers(-2, 3, ln)) + 30, 0, maxq)
+        out += bytes(q.astype(np.uint8))
+    return lens, bytes(out)
+
+
+def test_roundtrip_variable_lengths():
+    rng = np.random.default_rng(0)
+    lens, quals = _mkquals(rng, 200, 50, 151)
+    enc = fq.encode(lens, quals)
+    assert fq.decode(enc, len(quals)) == quals
+    # correlated quality strings compress well below raw
+    assert len(enc) < 0.75 * len(quals)
+
+
+def test_roundtrip_fixed_length_mode():
+    rng = np.random.default_rng(1)
+    p = fq.default_params(45)
+    p.pflags &= ~fq.P_DO_LEN  # only the first record stores a length
+    lens, quals = _mkquals(rng, 100, 0, 0, fixed=100)
+    enc = fq.encode(lens, quals, params=p)
+    assert fq.decode(enc, len(quals)) == quals
+    # fixed-length mode must be smaller than per-record lengths
+    enc_var = fq.encode(lens, quals)
+    assert len(enc) <= len(enc_var)
+
+
+def test_roundtrip_dedup():
+    rng = np.random.default_rng(2)
+    p = fq.default_params(45)
+    p.pflags |= fq.P_DO_DEDUP
+    base_lens, base = _mkquals(rng, 5, 80, 120)
+    tail = base[-base_lens[-1]:]
+    lens = base_lens + [base_lens[-1]] * 3
+    quals = base + tail * 3
+    enc = fq.encode(lens, quals, params=p)
+    assert fq.decode(enc, len(quals)) == quals
+
+
+def test_roundtrip_reversal():
+    rng = np.random.default_rng(3)
+    lens, quals = _mkquals(rng, 120, 60, 120)
+    rev = [bool(rng.integers(0, 2)) for _ in lens]
+    enc = fq.encode(lens, quals, do_rev=True, rev=rev)
+    assert fq.decode(enc, len(quals)) == quals
+
+
+def test_roundtrip_qmap():
+    rng = np.random.default_rng(4)
+    vals = [0, 10, 20, 30, 40]
+    p = fq.default_params(4)
+    p.pflags |= fq.P_HAVE_QMAP
+    p.max_sym = len(vals)
+    p.qmap = vals
+    lens = [60] * 50
+    quals = bytes(rng.choice(vals, size=3000).astype(np.uint8))
+    enc = fq.encode(lens, quals, params=p)
+    assert fq.decode(enc, len(quals)) == quals
+    # 5 uniform-random symbols: entropy bound is log2(5)/8 ≈ 0.29 of
+    # raw; the context model dilutes adaptation on uncorrelated data,
+    # so allow headroom above the bound
+    assert len(enc) < len(quals) * 0.45
+
+
+def test_roundtrip_delta_context():
+    # enable the delta context with an explicitly transmitted table
+    # (HAVE_DTAB), exercising the table wire format end to end
+    rng = np.random.default_rng(5)
+    p = fq.default_params(45)
+    p.dbits, p.dshift, p.dloc = 3, 2, 13
+    p.pflags |= fq.P_HAVE_DTAB
+    p.dtab = fq._default_table(256, 3, 2)
+    lens, quals = _mkquals(rng, 80, 70, 140)
+    enc = fq.encode(lens, quals, params=p)
+    assert fq.decode(enc, len(quals)) == quals
+
+
+def test_table_rle_roundtrip():
+    for vals in ([0] * 256,
+                 list(range(64)) * 4,
+                 [5] * 100 + [7] * 156):
+        blob = fq._write_table(vals)
+        got, pos = fq._read_table(blob, 0, len(vals))
+        assert got == vals and pos == len(blob)
+
+
+def test_version_and_truncation_errors():
+    rng = np.random.default_rng(6)
+    lens, quals = _mkquals(rng, 10, 40, 60)
+    enc = fq.encode(lens, quals)
+    with pytest.raises(ValueError, match="version"):
+        fq.decode(b"\x07" + enc[1:], len(quals))
+    for cut in (0, 1, 5, len(enc) // 2):
+        with pytest.raises(ValueError):
+            fq.decode(enc[:cut], len(quals))
+
+
+def test_record_overflow_rejected():
+    rng = np.random.default_rng(7)
+    lens, quals = _mkquals(rng, 10, 40, 60)
+    enc = fq.encode(lens, quals)
+    # declare a smaller block than the records claim
+    with pytest.raises(ValueError, match="overflow|truncated|corrupt"):
+        fq.decode(enc, len(quals) - 10)
+
+
+def test_zero_length_record_rejected_at_encode():
+    # the decoder refuses zero-length records (they would never
+    # advance), so the encoder must refuse to produce them
+    with pytest.raises(ValueError, match="positive"):
+        fq.encode([0, 3], b"abc")
+
+
+def test_mutation_fuzz_never_crashes():
+    rng = np.random.default_rng(8)
+    lens, quals = _mkquals(rng, 30, 40, 90)
+    enc = bytearray(fq.encode(lens, quals))
+    for _ in range(80):
+        mut = bytearray(enc)
+        k = rng.integers(0, len(mut))
+        mut[k] ^= 1 << rng.integers(0, 8)
+        try:
+            out = fq.decode(bytes(mut), len(quals))
+            assert len(out) == len(quals)
+        except ValueError:
+            pass  # loud, typed failure is the contract
+
+
+def test_cram_block_integration():
+    from goleft_tpu.io.cram import M_FQZCOMP, _decompress
+
+    rng = np.random.default_rng(9)
+    lens, quals = _mkquals(rng, 50, 60, 120)
+    enc = fq.encode(lens, quals)
+    assert _decompress(M_FQZCOMP, enc, len(quals)) == quals
