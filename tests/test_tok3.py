@@ -1,0 +1,113 @@
+"""Name-tokeniser codec (CRAM 3.1 block method 8) twin tests.
+
+Same validation strategy as the other 3.1 codecs: an in-repo encoder
+fuzzes the decoder across name shapes (instrument-style coordinates,
+zero-padded counters, duplicates, huge digit runs, empty names) and
+both stream-compressor backends, plus mutation fuzz asserting corrupt
+streams die with ValueError, never a crash.
+"""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io import tok3
+
+
+def _illumina_names(rng, n):
+    out = []
+    for i in range(n):
+        tile = 1101 + int(rng.integers(0, 4))
+        x = int(rng.integers(1000, 30000))
+        y = int(rng.integers(1000, 30000))
+        out.append(f"A00111:123:HXXYZ:1:{tile}:{x}:{y}".encode())
+    return out
+
+
+def _roundtrip(names, **kw):
+    enc = tok3.encode(names, **kw)
+    sep = b"\n" if kw.get("newline_sep") else b"\x00"
+    want = sep.join(names) + sep if names else b""
+    assert tok3.decode(enc, len(want)) == want
+    return enc, want
+
+
+@pytest.mark.parametrize("use_arith", [False, True])
+def test_roundtrip_instrument_names(use_arith):
+    rng = np.random.default_rng(0)
+    names = _illumina_names(rng, 1500)
+    enc, want = _roundtrip(names, use_arith=use_arith)
+    # shared prefixes tokenize to MATCH: far below raw
+    assert len(enc) < 0.45 * len(want)
+
+
+def test_roundtrip_name_shapes():
+    names = [b"", b"read_001", b"read_001", b"0042", b"0043",
+             b"x" * 300, b"99999999999999999999",
+             b"99999999999999999999", b"q:0007", b"q:0008",
+             b"q:10000", b"...", b"a1b2c3", b"a1b2c4",
+             b"SRR.1", b"SRR.2", b"SRR.300"]
+    for nl in (False, True):
+        _roundtrip(names, newline_sep=nl)
+
+
+def test_roundtrip_sequential_counters_use_delta():
+    names = [f"read{i}".encode() for i in range(1, 4000)]
+    enc, want = _roundtrip(names)
+    # pure +1 counters: almost everything rides the DDELTA stream
+    assert len(enc) < 0.05 * len(want)
+
+
+def test_roundtrip_zero_padded_counters():
+    names = [f"s{i:06d}".encode() for i in range(990, 1200)]
+    _roundtrip(names)
+    # width change across a padding boundary
+    names = [b"v009", b"v010", b"v100", b"v099"]
+    _roundtrip(names)
+
+
+def test_roundtrip_duplicates():
+    names = [b"dupname"] * 50 + [b"other"] + [b"dupname"] * 3
+    enc, want = _roundtrip(names)
+
+
+def test_tokenize_shapes():
+    toks = tok3._tokenize(b"A00:7:0042x")
+    assert toks == [(tok3.T_ALPHA, b"A"), (tok3.T_DIGITS0, b"00"),
+                    (tok3.T_CHAR, b":"), (tok3.T_DIGITS, b"7"),
+                    (tok3.T_CHAR, b":"), (tok3.T_DIGITS0, b"0042"),
+                    (tok3.T_ALPHA, b"x")]
+
+
+def test_stored_size_mismatch_rejected():
+    enc = tok3.encode([b"abc", b"abd"])
+    with pytest.raises(ValueError, match="declared block size"):
+        tok3.decode(enc, 3)
+
+
+def test_truncation_and_mutation_fuzz():
+    rng = np.random.default_rng(1)
+    names = _illumina_names(rng, 60)
+    enc = bytearray(tok3.encode(names))
+    want_len = sum(len(n) + 1 for n in names)
+    for cut in (0, 2, 5, len(enc) // 2, len(enc) - 1):
+        with pytest.raises(ValueError):
+            tok3.decode(bytes(enc[:cut]), want_len)
+    for _ in range(80):
+        mut = bytearray(enc)
+        k = rng.integers(0, len(mut))
+        mut[k] ^= 1 << rng.integers(0, 8)
+        try:
+            out = tok3.decode(bytes(mut), want_len)
+            assert len(out) == want_len
+        except ValueError:
+            pass  # loud, typed failure is the contract
+
+
+def test_cram_block_integration():
+    from goleft_tpu.io.cram import M_TOK3, _decompress
+
+    rng = np.random.default_rng(2)
+    names = _illumina_names(rng, 200)
+    enc = tok3.encode(names)
+    want = b"\x00".join(names) + b"\x00"
+    assert _decompress(M_TOK3, enc, len(want)) == want
