@@ -481,7 +481,8 @@ class Block:
     data: bytes  # uncompressed
 
 
-def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
+def read_block(buf: memoryview, pos: int,
+               v2: bool = False) -> tuple[Block, int]:
     start = pos
     method = buf[pos]
     ctype = buf[pos + 1]
@@ -491,13 +492,15 @@ def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
     rsize, pos = read_itf8(buf, pos)
     raw = bytes(buf[pos:pos + csize])
     pos += csize
-    want_crc = struct.unpack_from("<I", buf, pos)[0]
-    # CRC covers the block's bytes exactly as stored (a spec-legal
-    # non-minimal ITF8 must not be re-canonicalized before checking)
-    got_crc = zlib.crc32(bytes(buf[start:pos]))
-    pos += 4
-    if got_crc != want_crc:
-        raise ValueError("cram: block CRC mismatch")
+    if not v2:  # CRAM 2.x blocks carry no CRC trailer
+        want_crc = struct.unpack_from("<I", buf, pos)[0]
+        # CRC covers the block's bytes exactly as stored (a spec-legal
+        # non-minimal ITF8 must not be re-canonicalized before
+        # checking)
+        got_crc = zlib.crc32(bytes(buf[start:pos]))
+        pos += 4
+        if got_crc != want_crc:
+            raise ValueError("cram: block CRC mismatch")
     data = _decompress(method, raw, rsize)
     if len(data) != rsize:
         raise ValueError("cram: block size mismatch after decompression")
@@ -505,7 +508,7 @@ def read_block(buf: memoryview, pos: int) -> tuple[Block, int]:
 
 
 def write_block(method: int, ctype: int, cid: int, data: bytes,
-                rans_order: int = 0) -> bytes:
+                rans_order: int = 0, v2: bool = False) -> bytes:
     if method == M_RANSNX16:
         from .rans_nx16 import encode as nx16_encode
 
@@ -526,6 +529,8 @@ def write_block(method: int, ctype: int, cid: int, data: bytes,
         comp = data
     head = bytes([method, ctype]) + write_itf8(cid) + \
         write_itf8(len(comp)) + write_itf8(len(data))
+    if v2:  # CRAM 2.x blocks carry no CRC trailer
+        return head + comp
     return head + comp + struct.pack("<I", zlib.crc32(head + comp))
 
 
@@ -876,14 +881,18 @@ class SliceHeader:
     md5: bytes
 
     @staticmethod
-    def parse(data: bytes) -> "SliceHeader":
+    def parse(data: bytes, v2: bool = False) -> "SliceHeader":
         buf = memoryview(data)
         pos = 0
         ref_id, pos = read_itf8(buf, pos)
         start, pos = read_itf8(buf, pos)
         span, pos = read_itf8(buf, pos)
         nrec, pos = read_itf8(buf, pos)
-        counter, pos = read_ltf8(buf, pos)
+        # ITF8 in 2.x, LTF8 from 3.0 (same as the container header)
+        if v2:
+            counter, pos = read_itf8(buf, pos)
+        else:
+            counter, pos = read_ltf8(buf, pos)
         nblocks, pos = read_itf8(buf, pos)
         ncids, pos = read_itf8(buf, pos)
         cids = []
@@ -895,10 +904,11 @@ class SliceHeader:
         return SliceHeader(ref_id, start, span, nrec, counter, nblocks,
                            cids, emb, md5)
 
-    def serialize(self) -> bytes:
+    def serialize(self, v2: bool = False) -> bytes:
+        wc = write_itf8 if v2 else write_ltf8
         out = write_itf8(self.ref_id) + write_itf8(self.start) + \
             write_itf8(self.span) + write_itf8(self.n_records) + \
-            write_ltf8(self.counter) + write_itf8(self.n_blocks) + \
+            wc(self.counter) + write_itf8(self.n_blocks) + \
             write_itf8(len(self.content_ids))
         for c in self.content_ids:
             out += write_itf8(c)
@@ -1118,14 +1128,19 @@ class ContainerHeader:
     landmarks: list[int]
 
     @staticmethod
-    def parse(buf: memoryview, pos: int) -> tuple["ContainerHeader", int]:
+    def parse(buf: memoryview, pos: int,
+              v2: bool = False) -> tuple["ContainerHeader", int]:
         (length,) = struct.unpack_from("<i", buf, pos)
         pos += 4
         ref_id, pos = read_itf8(buf, pos)
         start, pos = read_itf8(buf, pos)
         span, pos = read_itf8(buf, pos)
         nrec, pos = read_itf8(buf, pos)
-        counter, pos = read_ltf8(buf, pos)
+        # the record counter widened to LTF8 in 3.0; 2.x stores ITF8
+        if v2:
+            counter, pos = read_itf8(buf, pos)
+        else:
+            counter, pos = read_ltf8(buf, pos)
         nbases, pos = read_ltf8(buf, pos)
         nblocks, pos = read_itf8(buf, pos)
         nland, pos = read_itf8(buf, pos)
@@ -1133,42 +1148,47 @@ class ContainerHeader:
         for _ in range(nland):
             v, pos = read_itf8(buf, pos)
             lands.append(v)
-        pos += 4  # header crc32 (v3)
+        if not v2:
+            pos += 4  # header crc32 (v3 only)
         return ContainerHeader(length, ref_id, start, span, nrec, counter,
                                nbases, nblocks, lands), pos
 
     @staticmethod
     def build(length, ref_id, start, span, nrec, counter, nbases,
-              nblocks, landmarks) -> bytes:
+              nblocks, landmarks, v2: bool = False) -> bytes:
+        wc = write_itf8 if v2 else write_ltf8
         body = write_itf8(ref_id) + write_itf8(start) + \
-            write_itf8(span) + write_itf8(nrec) + write_ltf8(counter) + \
+            write_itf8(span) + write_itf8(nrec) + wc(counter) + \
             write_ltf8(nbases) + write_itf8(nblocks) + \
             write_itf8(len(landmarks))
         for v in landmarks:
             body += write_itf8(v)
         head = struct.pack("<i", length) + body
+        if v2:
+            return head
         return head + struct.pack("<I", zlib.crc32(head))
 
 
 def _container_records(buf: memoryview, pos: int,
-                       hdr: ContainerHeader) -> list[CramRecord]:
+                       hdr: ContainerHeader,
+                       v2: bool = False) -> list[CramRecord]:
     """Decode every record in the container starting at its first block."""
     end = pos + hdr.length
     try:
-        block, pos = read_block(buf, pos)
+        block, pos = read_block(buf, pos, v2)
         if block.content_type != CT_COMP_HEADER:
             raise ValueError("cram: expected compression header block")
         comp = CompressionHeader.parse(block.data)
         records: list[CramRecord] = []
         while pos < end:
-            sh_block, pos = read_block(buf, pos)
+            sh_block, pos = read_block(buf, pos, v2)
             if sh_block.content_type != CT_SLICE_HEADER:
                 raise ValueError("cram: expected slice header block")
-            sl = SliceHeader.parse(sh_block.data)
+            sl = SliceHeader.parse(sh_block.data, v2)
             core = b""
             externals: dict[int, bytes] = {}
             for _ in range(sl.n_blocks):
-                b, pos = read_block(buf, pos)
+                b, pos = read_block(buf, pos, v2)
                 if b.content_type == CT_CORE:
                     core = b.data
                 elif b.content_type == CT_EXTERNAL:
@@ -1203,17 +1223,19 @@ class CramFile:
         if bytes(buf[:4]) != CRAM_MAGIC:
             raise ValueError("not a CRAM file (bad magic)")
         self.major, self.minor = buf[4], buf[5]
-        if self.major != 3:
-            # 2.x containers use different block/slice layouts; 3.0 and
-            # 3.1 share the container format (3.1 adds block codecs,
-            # handled per block in _decompress)
+        if self.major not in (2, 3):
             raise ValueError(
                 f"cram: unsupported major version {self.major} "
-                "(3.0/3.1 supported; re-encode 2.x with samtools)"
+                "(2.x and 3.0/3.1 supported; re-encode with samtools)"
             )
+        # 2.x shares the 3.0 container/slice layout minus the CRC32
+        # trailers on container headers and blocks (the CRAM 2.1 spec
+        # predates them); 3.1 adds block codecs, handled per block in
+        # _decompress
+        self._v2 = self.major == 2
         pos = 26  # magic + version + 20-byte file id
-        hdr, pos = ContainerHeader.parse(buf, pos)
-        first_block, _ = read_block(buf, pos)
+        hdr, pos = ContainerHeader.parse(buf, pos, self._v2)
+        first_block, _ = read_block(buf, pos, self._v2)
         if first_block.content_type != CT_FILE_HEADER:
             raise ValueError("cram: first container must hold SAM header")
         text = _sam_header_text(first_block.data)
@@ -1256,7 +1278,7 @@ class CramFile:
         n = len(buf)
         while pos + 4 <= n:
             try:
-                hdr, body = ContainerHeader.parse(buf, pos)
+                hdr, body = ContainerHeader.parse(buf, pos, self._v2)
             except (IndexError, struct.error) as e:
                 # memoryview reads past a truncated/corrupt container
                 # raise raw slicing errors; surface the module's own
@@ -1274,7 +1296,8 @@ class CramFile:
 
     def records(self, offset: int | None = None):
         for hdr, body in self._iter_containers(offset):
-            yield from _container_records(self._buf, body, hdr)
+            yield from _container_records(self._buf, body, hdr,
+                                          self._v2)
 
     def _region_offsets(self, tid: int, start: int, end: int):
         """Container offsets overlapping 0-based [start, end) from the
@@ -1307,7 +1330,8 @@ class CramFile:
                     if body in seen:
                         break
                     seen.add(body)
-                    recs.extend(_container_records(self._buf, body, hdr))
+                    recs.extend(_container_records(self._buf, body, hdr,
+                                                   self._v2))
                     break  # one container per crai offset
         else:
             # no .crai: decode the whole file ONCE and answer every
@@ -1331,7 +1355,8 @@ class CramFile:
     def stream_columns(self, window_bytes: int = 0, chunk_records: int = 0):
         """Per-container column chunks (bounded by container size)."""
         for hdr, body in self._iter_containers():
-            recs = _container_records(self._buf, body, hdr)
+            recs = _container_records(self._buf, body, hdr,
+                                      self._v2)
             cols = _records_to_columns(recs, None, 0, 1 << 60)
             if cols.n_reads:
                 yield cols
@@ -1417,6 +1442,20 @@ EOF_CONTAINER = bytes([
     0x01, 0x00, 0x01, 0x00, 0xee, 0x63, 0x01, 0x4b,
 ])
 
+# the 2.x EOF marker: same empty container (ref -1, start 0x454F46
+# "EOF", one 6-byte raw compression-header block of empty maps) in the
+# CRC-less 2.x layout with its ITF8 record counter — validated by
+# exact byte comparison at open by other readers
+EOF_CONTAINER_V2 = bytes([
+    0x0b, 0x00, 0x00, 0x00,              # container length 11
+    0xff, 0xff, 0xff, 0xff, 0x0f,        # ref id -1 (itf8)
+    0xe0, 0x45, 0x4f, 0x46,              # start 0x454F46 "EOF"
+    0x00, 0x00, 0x00, 0x00,              # span, nrec, counter, bases
+    0x01, 0x00,                          # 1 block, 0 landmarks
+    0x00, 0x01, 0x00, 0x06, 0x06,        # raw comp-header block, 6 bytes
+    0x01, 0x00, 0x01, 0x00, 0x01, 0x00,  # empty preservation/maps
+])
+
 # external block content ids for the fixture writer's series
 _W_IDS = {
     "BF": 1, "CF": 2, "RL": 3, "AP": 4, "RG": 5, "RN": 6, "MF": 7,
@@ -1440,17 +1479,20 @@ class CramWriter:
     def __init__(self, fh, header_text: str, ref_names: list[str],
                  ref_lens: list[int], records_per_container: int = 10000,
                  block_method: int = M_GZIP, ap_delta: bool = True,
-                 rans_order: int = 0, minor: int = 0):
+                 rans_order: int = 0, minor: int = 0, major: int = 3):
+        if major not in (2, 3):
+            raise ValueError("cram: writer supports major 2 and 3")
         self._fh = fh
         self.ref_names = list(ref_names)
         self._rpc = records_per_container
         self._method = block_method
         self._rans_order = rans_order
         self._ap_delta = ap_delta
+        self._v2 = major == 2
         self._pending: list[dict] = []
         self._counter = 0
         self._offsets: list[tuple[int, int, int, int, int]] = []
-        fh.write(CRAM_MAGIC + bytes([3, minor])
+        fh.write(CRAM_MAGIC + bytes([major, minor])
                  + b"goleft-tpu-cram\x00\x00\x00\x00\x00")
         sq = "".join(
             f"@SQ\tSN:{n}\tLN:{ln}\n"
@@ -1459,9 +1501,11 @@ class CramWriter:
         text = (header_text if "@SQ" in header_text
                 else header_text + sq).encode()
         blob = struct.pack("<i", len(text)) + text
-        block = write_block(M_RAW, CT_FILE_HEADER, 0, blob)
+        block = write_block(M_RAW, CT_FILE_HEADER, 0, blob,
+                            v2=self._v2)
         self._fh.write(ContainerHeader.build(
-            len(block), 0, 0, 0, 0, 0, 0, 1, [0]) + block)
+            len(block), 0, 0, 0, 0, 0, 0, 1, [0],
+            v2=self._v2) + block)
 
     def write_record(self, tid: int, pos0: int,
                      cigar: list[tuple[int, int]], mapq: int = 60,
@@ -1597,20 +1641,23 @@ class CramWriter:
             ref_id, first_pos, span, len(recs), self._counter,
             1 + len(used), list(used), -1, b"\x00" * 16,
         )
-        blocks = write_block(M_RAW, CT_SLICE_HEADER, 0, sl.serialize())
-        blocks += write_block(M_RAW, CT_CORE, 0, b"")
+        blocks = write_block(M_RAW, CT_SLICE_HEADER, 0,
+                             sl.serialize(v2=self._v2), v2=self._v2)
+        blocks += write_block(M_RAW, CT_CORE, 0, b"", v2=self._v2)
         for cid in used:
             blocks += write_block(self._method, CT_EXTERNAL, cid,
                                   ext_payload[cid],
-                                  rans_order=self._rans_order)
+                                  rans_order=self._rans_order,
+                                  v2=self._v2)
         comp_block = write_block(M_RAW, CT_COMP_HEADER, 0,
-                                 comp.serialize())
+                                 comp.serialize(), v2=self._v2)
         body = comp_block + blocks
         container_off = self._fh.tell()
         n_bases = sum(ints["RL"])
         self._fh.write(ContainerHeader.build(
             len(body), ref_id, first_pos, span, len(recs),
             self._counter, n_bases, 2 + len(used), [len(comp_block)],
+            v2=self._v2,
         ))
         self._fh.write(body)
         self._offsets.append(
@@ -1620,7 +1667,7 @@ class CramWriter:
 
     def close(self) -> None:
         self._flush()
-        self._fh.write(EOF_CONTAINER)
+        self._fh.write(EOF_CONTAINER_V2 if self._v2 else EOF_CONTAINER)
 
     def write_crai(self, path: str) -> None:
         """Companion .crai (gzipped 6-column TSV, spec appendix)."""
