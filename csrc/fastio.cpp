@@ -227,6 +227,25 @@ long bgzf_deflate_block(const uint8_t* data, long len, int level,
 // the output into quarters with per-stream context carry. Returns 0,
 // or negative: -1 malformed/truncated stream, -9 missing o1 context.
 
+// order-1 context tables shared by the 4x8 and Nx16 ports (they never
+// run concurrently on one thread): 1.4MB per thread, lazily
+// allocated, freed on thread exit — per-call pools destroy worker
+// threads, so a bare thread_local pointer would leak per thread
+struct RansCtx {
+    uint16_t freq[256];
+    uint32_t cum[257];
+    uint8_t lut[4096];
+};
+struct RansCtxPool {
+    RansCtx* p = nullptr;
+    ~RansCtxPool() { free(p); }
+    RansCtx* get() {
+        if (!p) p = (RansCtx*)malloc(256 * sizeof(RansCtx));
+        return p;
+    }
+};
+static thread_local RansCtxPool g_rans_ctxs;
+
 static inline long rans_u7(const uint8_t* buf, long len, long* pos,
                            uint32_t* v) {
     if (*pos >= len) return -1;
@@ -304,25 +323,10 @@ long rans4x8_decode(const uint8_t* buf, long len, long pos, int order,
         return 0;
     }
     if (order != 1) return -1;
-    // order-1: lazily allocated per-context tables
-    struct Ctx {
-        uint16_t freq[256];
-        uint32_t cum[257];
-        uint8_t lut[4096];
-    };
-    // RAII holder: per-call pools destroy worker threads, so the
-    // 1.4MB table block must free on thread exit, not leak per thread
-    struct CtxHolder {
-        Ctx* p = nullptr;
-        ~CtxHolder() { free(p); }
-    };
-    static thread_local CtxHolder holder;
+    // order-1: lazily allocated per-context tables (shared pool)
     static thread_local uint8_t present[256];
-    if (!holder.p) {
-        holder.p = (Ctx*)malloc(256 * sizeof(Ctx));
-        if (!holder.p) return -4;
-    }
-    Ctx* const ctxs = holder.p;
+    RansCtx* const ctxs = g_rans_ctxs.get();
+    if (!ctxs) return -4;
     memset(present, 0, 256);
     if (pos >= len) return -1;
     int ctx = buf[pos++];
@@ -1217,6 +1221,198 @@ long format_class_rows(const char* chrom, long chrom_len,
         out[w++] = '\n';
     }
     return w;
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------------
+// C port of io/rans_nx16.py::_decode_rans0/_decode_rans1 (CRAM 3.1
+// block method 5 — the pure-Python loops dominate foreign-3.1 CRAM
+// decode wall). Layout per io/rans_nx16.py: uint7 varints, ascending
+// symbol alphabet with adjacent-run RLE, frequencies normalized to
+// 4096 (o0) / 1<<shift (o1), N interleaved states (4 or 32) with one
+// 16-bit renormalization step below 1<<15; order-0 decodes
+// round-robin, order-1 fills N contiguous slices (last state carries
+// the tail) with per-slice context carry. The C path is an
+// accelerator only: any nonzero return makes the caller fall back to
+// the pure-Python decoder, which owns the lenient cases (tables
+// needing renormalization, shift > 12, non-minimal varints past 5
+// bytes) and every error message.
+
+static inline long nx16_u7(const uint8_t* buf, long len, long* pos,
+                           uint32_t* v) {
+    uint64_t acc = 0;  // 5 groups carry 35 bits: must not wrap u32
+    for (int k = 0; k < 5; k++) {
+        if (*pos >= len) return -1;
+        uint8_t b = buf[(*pos)++];
+        acc = (acc << 7) | (b & 0x7F);
+        if (!(b & 0x80)) {
+            if (acc > 0xFFFFFFFFull) return -2;
+            *v = (uint32_t)acc;
+            return 0;
+        }
+    }
+    return -2;  // longer non-minimal form: let Python handle it
+}
+
+static long nx16_alphabet(const uint8_t* buf, long len, long* pos,
+                          uint8_t* syms, int* n_syms) {
+    int n = 0, rle = 0, last = -2;
+    if (*pos >= len) return -1;
+    int sym = buf[(*pos)++];
+    while (1) {
+        if (n >= 256 || sym > 255) return -1;
+        syms[n++] = (uint8_t)sym;
+        if (rle > 0) {
+            rle--;
+            sym++;
+        } else {
+            last = sym;
+            if (*pos >= len) return -1;
+            sym = buf[(*pos)++];
+            if (sym == last + 1) {
+                if (*pos >= len) return -1;
+                rle = buf[(*pos)++];
+            }
+        }
+        if (rle == 0 && sym == 0) break;
+    }
+    *n_syms = n;
+    return 0;
+}
+
+extern "C" {
+
+long ransnx16_decode0(const uint8_t* buf, long len, long pos,
+                      uint8_t* out, long out_len, int n_states) {
+    if (out_len == 0) return 0;
+    if (n_states != 4 && n_states != 32) return -1;
+    uint8_t syms[256];
+    int n;
+    if (nx16_alphabet(buf, len, &pos, syms, &n) < 0) return -1;
+    uint16_t freq[256];
+    uint32_t cum[257];
+    static thread_local uint8_t lut[4096];
+    memset(freq, 0, sizeof(freq));
+    memset(lut, 0, sizeof(lut));
+    for (int i = 0; i < n; i++) {
+        uint32_t f;
+        long r = nx16_u7(buf, len, &pos, &f);
+        if (r < 0) return r;
+        if (f > 4096) return -2;
+        freq[syms[i]] = (uint16_t)f;
+    }
+    uint32_t c = 0;
+    for (int s = 0; s < 256; s++) {
+        cum[s] = c;
+        c += freq[s];
+    }
+    cum[256] = c;
+    // validate the FINAL array sum (duplicate alphabet symbols
+    // overwrite entries; Python normalizes from the final array, so
+    // anything but an exact 4096 goes to the lenient Python path)
+    if (c != 4096) return -2;
+    for (int s = 0; s < 256; s++)
+        if (freq[s]) memset(lut + cum[s], s, freq[s]);
+    if (pos + 4L * n_states > len) return -1;
+    uint32_t R[32];
+    memcpy(R, buf + pos, 4L * n_states);
+    pos += 4L * n_states;
+    for (long i = 0; i < out_len; i++) {
+        int j = (int)(i % n_states);
+        uint32_t x = R[j];
+        uint32_t m = x & 4095;
+        uint8_t s = lut[m];
+        out[i] = s;
+        x = (uint32_t)freq[s] * (x >> 12) + m - cum[s];
+        if (x < (1u << 15) && pos + 1 < len) {
+            x = (x << 16) | buf[pos] | ((uint32_t)buf[pos + 1] << 8);
+            pos += 2;
+        }
+        R[j] = x;
+    }
+    return 0;
+}
+
+long ransnx16_decode1(const uint8_t* buf, long len, long pos,
+                      const uint8_t* tbl, long tlen, long tpos,
+                      int table_inline, int shift,
+                      uint8_t* out, long out_len, int n_states) {
+    if (out_len == 0) return 0;
+    if (n_states != 4 && n_states != 32) return -1;
+    if (shift < 1 || shift > 12) return -2;  // lut capped at 4096
+    const uint32_t target = 1u << shift;
+    static thread_local uint8_t present[256];
+    RansCtx* const ctxs = g_rans_ctxs.get();
+    if (!ctxs) return -4;
+    memset(present, 0, 256);
+    const uint8_t* tb = table_inline ? buf : tbl;
+    long tl = table_inline ? len : tlen;
+    long tp = table_inline ? pos : tpos;
+    uint8_t syms[256];
+    int n;
+    if (nx16_alphabet(tb, tl, &tp, syms, &n) < 0) return -1;
+    for (int ci = 0; ci < n; ci++) {
+        RansCtx* cx = &ctxs[syms[ci]];
+        memset(cx->freq, 0, sizeof(cx->freq));
+        memset(cx->lut, 0, target);
+        for (int si = 0; si < n; si++) {
+            uint32_t f;
+            long r = nx16_u7(tb, tl, &tp, &f);
+            if (r < 0) return r;
+            if (f > target) return -2;
+            cx->freq[syms[si]] = (uint16_t)f;
+        }
+        uint32_t cum = 0;
+        for (int s = 0; s < 256; s++) {
+            cx->cum[s] = cum;
+            cum += cx->freq[s];
+        }
+        cx->cum[256] = cum;
+        // final-array sum, as in nx16 o0: rows either sum to the
+        // target or are all-zero (Python keeps zero rows as-is)
+        if (cum != 0 && cum != target) return -2;
+        for (int s = 0; s < 256; s++)
+            if (cx->freq[s]) memset(cx->lut + cx->cum[s], s, cx->freq[s]);
+        present[syms[ci]] = 1;
+    }
+    if (table_inline) pos = tp;
+    if (pos + 4L * n_states > len) return -1;
+    uint32_t R[32];
+    memcpy(R, buf + pos, 4L * n_states);
+    pos += 4L * n_states;
+    long F = out_len / n_states;
+    long idx[32], ends[32];
+    uint8_t lastc[32];
+    for (int j = 0; j < n_states; j++) {
+        idx[j] = j * F;
+        ends[j] = (j == n_states - 1) ? out_len : (j + 1) * F;
+        lastc[j] = 0;
+    }
+    const uint32_t mask = target - 1;
+    while (1) {
+        int done = 1;
+        for (int j = 0; j < n_states; j++) {
+            if (idx[j] >= ends[j]) continue;
+            done = 0;
+            uint32_t x = R[j];
+            RansCtx* cx = &ctxs[lastc[j]];
+            if (!present[lastc[j]]) return -9;
+            uint32_t m = x & mask;
+            uint8_t s = cx->lut[m];
+            out[idx[j]] = s;
+            x = (uint32_t)cx->freq[s] * (x >> shift) + m - cx->cum[s];
+            if (x < (1u << 15) && pos + 1 < len) {
+                x = (x << 16) | buf[pos] | ((uint32_t)buf[pos + 1] << 8);
+                pos += 2;
+            }
+            R[j] = x;
+            lastc[j] = s;
+            idx[j]++;
+        }
+        if (done) break;
+    }
+    return 0;
 }
 
 }  // extern "C"

@@ -95,6 +95,8 @@ def _register_restypes(lib) -> None:
         lib.bam_window_acc_stream.restype = ctypes.c_long
         lib.bgzf_deflate_block.restype = ctypes.c_long
         lib.rans4x8_decode.restype = ctypes.c_long
+        lib.ransnx16_decode0.restype = ctypes.c_long
+        lib.ransnx16_decode1.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -296,6 +298,44 @@ def rans4x8_decode(data, pos: int, order: int,
     if r < 0:
         raise ValueError("cram: malformed rans stream")
     return out.tobytes()
+
+
+def ransnx16_decode0(data, pos: int, out_len: int,
+                     n_states: int) -> bytes | None:
+    """rANS-Nx16 order-0 decode in C; None when native is unavailable
+    OR the stream needs the lenient pure-Python path (which also owns
+    every error message) — callers always fall back on None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    out = np.empty(out_len, dtype=np.uint8)
+    r = lib.ransnx16_decode0(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
+        _ptr(out), ctypes.c_long(out_len), ctypes.c_int(n_states),
+    )
+    return out.tobytes() if r == 0 else None
+
+
+def ransnx16_decode1(data, pos: int, table, table_pos: int,
+                     table_inline: bool, shift: int, out_len: int,
+                     n_states: int) -> bytes | None:
+    """rANS-Nx16 order-1 decode in C (table either inline ahead of the
+    states or in a separately decompressed buffer); None → fall back
+    to the pure-Python decoder."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    tbl = buf if table_inline else _as_u8(table)
+    out = np.empty(out_len, dtype=np.uint8)
+    r = lib.ransnx16_decode1(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
+        _ptr(tbl), ctypes.c_long(len(tbl)), ctypes.c_long(table_pos),
+        ctypes.c_int(1 if table_inline else 0), ctypes.c_int(shift),
+        _ptr(out), ctypes.c_long(out_len), ctypes.c_int(n_states),
+    )
+    return out.tobytes() if r == 0 else None
 
 
 def bgzf_deflate_block(chunk: bytes, level: int) -> bytes | None:

@@ -134,6 +134,11 @@ def _read_freqs0(buf, pos: int):
 
 
 def _decode_rans0(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    from . import native
+
+    fast = native.ransnx16_decode0(buf, pos, out_len, n_states)
+    if fast is not None:
+        return fast
     freqs, pos = _read_freqs0(buf, pos)
     cum = np.zeros(257, dtype=np.int64)
     np.cumsum(freqs, out=cum[1:])
@@ -193,6 +198,8 @@ def _encode_rans0(data: bytes, n_states: int = 4) -> bytes:
 # ------------------------------------------------------------ order 1
 
 def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    from . import native
+
     head = buf[pos]
     pos += 1
     shift = head >> 4
@@ -208,8 +215,14 @@ def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
         table = _decode_rans0(buf, pos, ulen, 4)
         pos += clen
         tbuf, tpos = memoryview(table), 0
+        fast = native.ransnx16_decode1(buf, pos, table, 0, False,
+                                       shift, out_len, n_states)
     else:
         tbuf, tpos = buf, pos
+        fast = native.ransnx16_decode1(buf, pos, None, 0, True,
+                                       shift, out_len, n_states)
+    if fast is not None:
+        return fast
     target = 1 << shift
     syms, tpos = _read_alphabet(tbuf, tpos)
     freqs = np.zeros((256, 256), dtype=np.int64)

@@ -44,6 +44,7 @@ def test_pack_unpack_2bit():
     assert rx._unpack(packed, pmap, len(data)) == data
 
 
+@pytest.mark.native_io
 @pytest.mark.parametrize("order", [0, 1])
 @pytest.mark.parametrize("rle", [False, True])
 @pytest.mark.parametrize("pack", [False, True])
@@ -102,6 +103,39 @@ def test_nosz_requires_external_size():
         rx.decode(stripped)
 
 
+@pytest.mark.native_io
+def test_native_decoder_matches_python_bytes(monkeypatch):
+    # the C port (csrc/fastio.cpp::ransnx16_decode0/1) must produce
+    # byte-identical output to the pure-Python decoder on the same
+    # streams, including the compressed-o1-table and RLE/PACK paths
+    from goleft_tpu.io import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(11)
+    deltas = rng.choice([0, 0, 0, 1, 2, 5], size=30000)
+    cases = [
+        bytes(rng.choice([65, 67, 71, 84], p=[.4, .3, .2, .1],
+                         size=20000).astype(np.uint8)),
+        bytes((np.cumsum(deltas) % 120).astype(np.uint8)),
+        b"A" * 5000 + bytes(rng.integers(0, 8, 800, dtype=np.uint8)),
+    ]
+    for data in cases:
+        for order in (0, 1):
+            for x32 in (False, True):
+                for rle in (False, True):
+                    enc = rx.encode(data, order=order, x32=x32,
+                                    use_rle=rle, use_pack=True)
+                    got_native = rx.decode(enc, len(data))
+                    with monkeypatch.context() as m:
+                        m.setattr(native, "ransnx16_decode0",
+                                  lambda *a, **k: None)
+                        m.setattr(native, "ransnx16_decode1",
+                                  lambda *a, **k: None)
+                        got_py = rx.decode(enc, len(data))
+                    assert got_native == got_py == data
+
+
 def test_unknown_block_method_errors_clearly():
     # methods 0-8 all decode now; anything beyond is a clear error
     from goleft_tpu.io.cram import _decompress
@@ -126,7 +160,7 @@ def test_order1_compressed_table_path():
     # still beats CAT; decode must agree
     rng = np.random.default_rng(5)
     deltas = rng.choice([0, 0, 0, 1, 2, 5], size=20000)
-    data = bytes(np.cumsum(deltas).astype(np.int64) % 120)
+    data = bytes((np.cumsum(deltas) % 120).astype(np.uint8))
     enc = rx.encode(data, order=1)
     # head byte of the o1 payload: after flags + size varint
     szlen = len(rx.write_uint7(len(data)))
