@@ -1416,3 +1416,170 @@ long ransnx16_decode1(const uint8_t* buf, long len, long pos,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------------
+// C port of io/arith.py::_decode_body (CRAM 3.1 block method 6 — the
+// adaptive-model loops are the slowest pure-Python codec path; the
+// name tokeniser's streams can ride this coder too). Carry-counting
+// range decoder (32-bit range, 5-byte preload, byte renorm below
+// 2^24) + adaptive models (+16 per update, halve past 2^16-16,
+// adjacent swap), order 0/1 byte models and the integrated RLE run
+// models keyed by literal symbol / shared continuation context —
+// exactly the state machine io/arith.py documents. Accelerator only:
+// nonzero return → caller falls back to the pure-Python decoder,
+// which owns every error message.
+
+struct AModel {
+    uint8_t sym[256];
+    uint16_t freq[256];
+    uint32_t total;
+    uint16_t nsym;
+    uint8_t live;
+};
+
+static inline void amodel_init(AModel* m, int nsym) {
+    for (int i = 0; i < nsym; i++) {
+        m->sym[i] = (uint8_t)i;
+        m->freq[i] = 1;
+    }
+    m->total = nsym;
+    m->nsym = (uint16_t)nsym;
+    m->live = 1;
+}
+
+struct ARange {
+    const uint8_t* buf;
+    long len;
+    long pos;
+    uint32_t code;
+    uint32_t range;
+};
+
+static inline void arange_init(ARange* rc, const uint8_t* buf, long len,
+                               long pos) {
+    rc->buf = buf;
+    rc->len = len;
+    rc->pos = pos;
+    rc->code = 0;
+    rc->range = 0xFFFFFFFFu;
+    for (int i = 0; i < 5; i++) {
+        uint8_t b = rc->pos < len ? buf[rc->pos] : 0;
+        rc->pos++;
+        rc->code = (rc->code << 8) | b;
+    }
+}
+
+static inline void amodel_bump(AModel* m, int i) {
+    m->freq[i] += 16;
+    m->total += 16;
+    if (m->total > (1u << 16) - 16) {
+        uint32_t total = 0;
+        for (int j = 0; j < m->nsym; j++) {
+            uint16_t f = m->freq[j];
+            f -= f >> 1;
+            m->freq[j] = f;
+            total += f;
+        }
+        m->total = total;
+    }
+    if (i && m->freq[i] > m->freq[i - 1]) {
+        uint16_t tf = m->freq[i];
+        m->freq[i] = m->freq[i - 1];
+        m->freq[i - 1] = tf;
+        uint8_t ts = m->sym[i];
+        m->sym[i] = m->sym[i - 1];
+        m->sym[i - 1] = ts;
+    }
+}
+
+// returns symbol, or -1 on a corrupt stream
+static inline int amodel_decode(AModel* m, ARange* rc) {
+    rc->range /= m->total;
+    uint32_t f = rc->code / rc->range;
+    if (f >= m->total) return -1;
+    uint32_t acc = 0;
+    int i = 0;
+    while (acc + m->freq[i] <= f) {
+        acc += m->freq[i];
+        i++;
+        if (i >= m->nsym) return -1;
+    }
+    rc->code -= acc * rc->range;
+    rc->range *= m->freq[i];
+    while (rc->range < (1u << 24)) {
+        uint8_t b = rc->pos < rc->len ? rc->buf[rc->pos] : 0;
+        rc->pos++;
+        rc->code = (rc->code << 8) | b;
+        rc->range <<= 8;
+    }
+    int s = m->sym[i];
+    amodel_bump(m, i);
+    return s;
+}
+
+extern "C" {
+
+long arith_decode_body(const uint8_t* buf, long len, long pos,
+                       uint8_t* out, long out_len, int order, int rle) {
+    if (out_len == 0) return 0;
+    if (pos >= len) return -1;
+    int nsym = buf[pos];
+    pos++;
+    if (nsym == 0) nsym = 256;
+    // byte models (1 for o0, 256 lazily-initialized for o1) plus 257
+    // run models (one per literal symbol + the shared continuation
+    // context): ~400KB, heap-held per thread like the rANS pools
+    struct Pool {
+        AModel* p = nullptr;
+        ~Pool() { free(p); }
+    };
+    static thread_local Pool pool;
+    const int N_BYTE = 256, N_RUN = 257;
+    if (!pool.p) {
+        pool.p = (AModel*)malloc((N_BYTE + N_RUN) * sizeof(AModel));
+        if (!pool.p) return -4;
+    }
+    AModel* byte_m = pool.p;
+    AModel* run_m = pool.p + N_BYTE;
+    for (int i = 0; i < N_BYTE + N_RUN; i++) pool.p[i].live = 0;
+    ARange rc;
+    arange_init(&rc, buf, len, pos);
+    long i = 0;
+    int prev = 0;
+    if (!rle) {
+        for (; i < out_len; i++) {
+            AModel* m = &byte_m[order ? prev : 0];
+            if (!m->live) amodel_init(m, nsym);
+            int s = amodel_decode(m, &rc);
+            if (s < 0) return -1;
+            out[i] = (uint8_t)s;
+            prev = s;
+        }
+        return 0;
+    }
+    while (i < out_len) {
+        AModel* m = &byte_m[order ? prev : 0];
+        if (!m->live) amodel_init(m, nsym);
+        int s = amodel_decode(m, &rc);
+        if (s < 0) return -1;
+        prev = s;
+        long run = 0;
+        int ctx = s;
+        while (1) {
+            AModel* rm = &run_m[ctx];
+            if (!rm->live) amodel_init(rm, 256);
+            int part = amodel_decode(rm, &rc);
+            if (part < 0) return -1;
+            run += part;
+            if (part != 255) break;
+            if (run > out_len) return -1;  // truncated-stream loop bound
+            ctx = 256;
+        }
+        if (i + run + 1 > out_len) return -1;
+        memset(out + i, s, run + 1);
+        i += run + 1;
+    }
+    return 0;
+}
+
+}  // extern "C"

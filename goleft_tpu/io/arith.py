@@ -203,6 +203,11 @@ def _model_nsym(header_byte: int) -> int:
 
 def _decode_body(buf, pos: int, out_len: int, order: int,
                  rle: bool) -> bytes:
+    from . import native
+
+    fast = native.arith_decode_body(buf, pos, out_len, order, rle)
+    if fast is not None:
+        return fast
     nsym = _model_nsym(buf[pos])
     pos += 1
     rc = RangeDecoder(buf, pos)

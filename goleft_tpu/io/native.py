@@ -97,6 +97,7 @@ def _register_restypes(lib) -> None:
         lib.rans4x8_decode.restype = ctypes.c_long
         lib.ransnx16_decode0.restype = ctypes.c_long
         lib.ransnx16_decode1.restype = ctypes.c_long
+        lib.arith_decode_body.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -313,6 +314,24 @@ def ransnx16_decode0(data, pos: int, out_len: int,
     r = lib.ransnx16_decode0(
         _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
         _ptr(out), ctypes.c_long(out_len), ctypes.c_int(n_states),
+    )
+    return out.tobytes() if r == 0 else None
+
+
+def arith_decode_body(data, pos: int, out_len: int, order: int,
+                      rle: bool) -> bytes | None:
+    """Adaptive-arithmetic coded-body decode in C (order 0/1, with or
+    without the integrated RLE run models); None → fall back to the
+    pure-Python decoder, which owns every error message."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    out = np.empty(out_len, dtype=np.uint8)
+    r = lib.arith_decode_body(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
+        _ptr(out), ctypes.c_long(out_len),
+        ctypes.c_int(1 if order else 0), ctypes.c_int(1 if rle else 0),
     )
     return out.tobytes() if r == 0 else None
 

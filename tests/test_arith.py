@@ -178,6 +178,36 @@ def test_mutation_fuzz_never_crashes():
                     pass
 
 
+@pytest.mark.native_io
+def test_native_decoder_matches_python_bytes(monkeypatch):
+    # the C port (csrc/fastio.cpp::arith_decode_body) must produce
+    # byte-identical output to the pure-Python adaptive coder — the
+    # models mutate on every symbol, so any divergence compounds
+    from goleft_tpu.io import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(12)
+    cases = [
+        bytes(rng.choice([65, 67, 71, 84], p=[.4, .3, .2, .1],
+                         size=20000).astype(np.uint8)),
+        bytes((np.cumsum(rng.choice([0, 0, 1, 3], size=15000)) % 200)
+              .astype(np.uint8)),
+        b"Q" * 70000 + bytes(rng.integers(0, 4, 500, dtype=np.uint8)),
+    ]
+    for data in cases:
+        for order in (0, 1):
+            for rle in (False, True):
+                enc = arith.encode(data, order=order, use_rle=rle)
+                got_native = arith.decode(enc, len(data))
+                with monkeypatch.context() as m:
+                    m.setattr(native, "arith_decode_body",
+                              lambda *a, **k: None)
+                    got_py = arith.decode(enc, len(data))
+                assert got_native == got_py == data
+
+
+@pytest.mark.native_io
 def test_cram_block_integration():
     from goleft_tpu.io.cram import M_ARITH, CT_EXTERNAL, read_block, \
         write_block
