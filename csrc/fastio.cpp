@@ -1583,3 +1583,262 @@ long arith_decode_body(const uint8_t* buf, long len, long pos,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------------
+// C port of io/fqzcomp.py::_decode (CRAM 3.1 block method 7): full
+// stream decode — version/gflags, parameter sets (selector table,
+// qmap, transmitted or shift-clamp default context tables), and the
+// record loop (selector, 4-byte lengths through dedicated models,
+// reversal flags applied after decode, dedup copies, quality symbols
+// from the 16-bit mixed context). Reuses the arith coder's AModel /
+// ARange. Accelerator only: nonzero return → the pure-Python decoder
+// (which owns every error message) takes over.
+
+struct FqzParam {
+    uint32_t seed;
+    uint8_t pflags;
+    int max_sym;
+    int qbits, qshift, pbits, pshift, dbits, dshift;
+    int qloc, sloc, ploc, dloc;
+    int have_qmap;
+    uint8_t qmap[256];
+    uint32_t qtab[256];
+    uint32_t ptab[1024];
+    uint32_t dtab[256];
+};
+
+static long fqz_table(const uint8_t* buf, long len, long* pos,
+                      uint32_t* out, int size) {
+    int n = 0;
+    while (n < size) {
+        uint32_t v, r;
+        long rc = nx16_u7(buf, len, pos, &v);  // same uint7 varint
+        if (rc < 0) return rc;
+        rc = nx16_u7(buf, len, pos, &r);
+        if (rc < 0) return rc;
+        if (r == 0 || n + (long)r > size) return -1;
+        for (uint32_t k = 0; k < r; k++) out[n++] = v;
+    }
+    return 0;
+}
+
+static void fqz_default_table(uint32_t* out, int size, int bits,
+                              int shift) {
+    if (bits < 1) bits = 1;
+    uint32_t cap = (1u << bits) - 1;
+    for (int v = 0; v < size; v++) {
+        uint32_t x = (uint32_t)v >> shift;
+        out[v] = x < cap ? x : cap;
+    }
+}
+
+static long fqz_param_parse(const uint8_t* buf, long len, long* pos,
+                            FqzParam* p) {
+    if (*pos + 9 > len) return -1;
+    p->seed = buf[*pos] | ((uint32_t)buf[*pos + 1] << 8);
+    p->pflags = buf[*pos + 2];
+    p->max_sym = buf[*pos + 3];
+    const uint8_t* nib = buf + *pos + 4;
+    *pos += 9;
+    p->qbits = nib[0] >> 4; p->qshift = nib[0] & 15;
+    p->pbits = nib[1] >> 4; p->pshift = nib[1] & 15;
+    p->dbits = nib[2] >> 4; p->dshift = nib[2] & 15;
+    p->qloc = nib[3] >> 4;  p->sloc = nib[3] & 15;
+    p->ploc = nib[4] >> 4;  p->dloc = nib[4] & 15;
+    p->have_qmap = (p->pflags & 0x10) != 0;
+    if (p->have_qmap) {
+        if (*pos + p->max_sym > len) return -1;
+        memcpy(p->qmap, buf + *pos, p->max_sym);
+        *pos += p->max_sym;
+    }
+    long r;
+    if (p->qbits && (p->pflags & 0x80)) {
+        if ((r = fqz_table(buf, len, pos, p->qtab, 256)) < 0) return r;
+    } else {
+        fqz_default_table(p->qtab, 256, p->qbits, p->qshift);
+    }
+    if (p->pbits && (p->pflags & 0x20)) {
+        if ((r = fqz_table(buf, len, pos, p->ptab, 1024)) < 0) return r;
+    } else {
+        fqz_default_table(p->ptab, 1024, p->pbits, p->pshift);
+    }
+    if (p->dbits && (p->pflags & 0x40)) {
+        if ((r = fqz_table(buf, len, pos, p->dtab, 256)) < 0) return r;
+    } else {
+        fqz_default_table(p->dtab, 256, p->dbits, p->dshift);
+    }
+    return 0;
+}
+
+static inline uint32_t fqz_mix(const FqzParam* p, uint32_t qhist,
+                               long remaining, uint32_t delta,
+                               uint32_t sel) {
+    uint32_t ctx = p->seed;
+    if (p->qbits)
+        ctx += (qhist & ((1u << p->qbits) - 1)) << p->qloc;
+    if (p->pbits) {
+        long rr = remaining < 1023 ? remaining : 1023;
+        ctx += p->ptab[rr] << p->ploc;
+    }
+    if (p->dbits) {
+        uint32_t dd = delta < 255 ? delta : 255;
+        ctx += p->dtab[dd] << p->dloc;
+    }
+    if (p->pflags & 0x08)
+        ctx += sel << p->sloc;
+    return ctx & 0xFFFF;
+}
+
+extern "C" {
+
+long fqzcomp_decode(const uint8_t* buf, long len, uint8_t* out,
+                    long out_len) {
+    if (out_len == 0) return 0;
+    if (len < 2 || buf[0] != 5) return -1;
+    int gflags = buf[1];
+    long pos = 2;
+    int nparam = 1;
+    if (gflags & 0x01) {  // MULTI_PARAM
+        if (pos >= len) return -1;
+        nparam = buf[pos++];
+    }
+    if (nparam == 0) return -1;
+    int max_sel = nparam - 1;
+    uint32_t stab[256];
+    if (gflags & 0x02) {  // HAVE_STAB
+        if (pos >= len) return -1;
+        max_sel = buf[pos++];
+        if (fqz_table(buf, len, &pos, stab, 256) < 0) return -1;
+    } else {
+        for (int i = 0; i < 256; i++)
+            stab[i] = i < nparam ? i : nparam - 1;
+    }
+    // everything below frees through this holder on every exit path
+    struct Scratch {
+        FqzParam* params = nullptr;
+        AModel** qual = nullptr;     // 65536 lazily-allocated models
+        long* revs = nullptr;        // (start, len) pairs
+        ~Scratch() {
+            free(params);
+            if (qual) {
+                for (int i = 0; i < 65536; i++) free(qual[i]);
+                free(qual);
+            }
+            free(revs);
+        }
+    } s;
+    s.params = (FqzParam*)malloc(nparam * sizeof(FqzParam));
+    if (!s.params) return -4;
+    for (int i = 0; i < nparam; i++) {
+        long r = fqz_param_parse(buf, len, &pos, &s.params[i]);
+        if (r < 0) return r;
+    }
+    int nsym = 0;
+    for (int i = 0; i < nparam; i++)
+        if (s.params[i].max_sym > nsym) nsym = s.params[i].max_sym;
+    nsym += 1;
+    if (nsym > 256) return -1;
+    s.qual = (AModel**)calloc(65536, sizeof(AModel*));
+    if (!s.qual) return -4;
+    AModel sel_m, len_m[4], rev_m, dup_m;
+    int have_sel = max_sel > 0;
+    if (have_sel) amodel_init(&sel_m, max_sel + 1);
+    for (int j = 0; j < 4; j++) amodel_init(&len_m[j], 256);
+    amodel_init(&rev_m, 2);
+    amodel_init(&dup_m, 2);
+    long n_revs = 0, cap_revs = 0;
+    ARange rc;
+    arange_init(&rc, buf, len, pos);
+    long i = 0;
+    uint32_t sel = 0;
+    FqzParam* p = &s.params[0];
+    long rec_len = 0, last_len = 0, remaining = 0;
+    uint32_t qhist = 0, delta = 0;
+    int prevq = 0;
+    while (i < out_len) {
+        if (remaining == 0) {
+            if (have_sel) {
+                int sv = amodel_decode(&sel_m, &rc);
+                if (sv < 0 || stab[sv] >= (uint32_t)nparam) return -1;
+                sel = (uint32_t)sv;
+                p = &s.params[stab[sv]];
+            }
+            if ((p->pflags & 0x04) || last_len == 0) {  // DO_LEN
+                uint32_t l = 0;
+                for (int j = 0; j < 4; j++) {
+                    int b = amodel_decode(&len_m[j], &rc);
+                    if (b < 0) return -1;
+                    l |= (uint32_t)b << (8 * j);
+                }
+                rec_len = (long)l;
+                last_len = rec_len;
+            } else {
+                rec_len = last_len;
+            }
+            if (rec_len == 0 || i + rec_len > out_len) return -1;
+            if (gflags & 0x04) {  // DO_REV
+                int rv = amodel_decode(&rev_m, &rc);
+                if (rv < 0) return -1;
+                if (rv) {
+                    if (n_revs == cap_revs) {
+                        cap_revs = cap_revs ? cap_revs * 2 : 64;
+                        long* nr = (long*)realloc(
+                            s.revs, cap_revs * 2 * sizeof(long));
+                        if (!nr) return -4;
+                        s.revs = nr;
+                    }
+                    s.revs[n_revs * 2] = i;
+                    s.revs[n_revs * 2 + 1] = rec_len;
+                    n_revs++;
+                }
+            }
+            if (p->pflags & 0x02) {  // DO_DEDUP
+                int dv = amodel_decode(&dup_m, &rc);
+                if (dv < 0) return -1;
+                if (dv) {
+                    if (i < rec_len) return -1;
+                    memmove(out + i, out + i - rec_len, rec_len);
+                    i += rec_len;
+                    continue;
+                }
+            }
+            remaining = rec_len;
+            qhist = 0;
+            prevq = 0;
+            delta = 0;
+        }
+        uint32_t ctx = fqz_mix(p, qhist, remaining, delta, sel);
+        AModel* qm = s.qual[ctx];
+        if (!qm) {
+            qm = (AModel*)malloc(sizeof(AModel));
+            if (!qm) return -4;
+            amodel_init(qm, nsym);
+            s.qual[ctx] = qm;
+        }
+        int q = amodel_decode(qm, &rc);
+        if (q < 0) return -1;
+        if (p->have_qmap) {
+            if (q >= p->max_sym) return -1;
+            out[i] = p->qmap[q];
+        } else {
+            out[i] = (uint8_t)q;
+        }
+        qhist = (qhist << p->qshift) + p->qtab[q];
+        if (p->dbits)
+            delta += (uint32_t)(prevq != q);
+        prevq = q;
+        remaining--;
+        i++;
+    }
+    for (long r = 0; r < n_revs; r++) {
+        long a = s.revs[r * 2], ln = s.revs[r * 2 + 1];
+        for (long x = a, y = a + ln - 1; x < y; x++, y--) {
+            uint8_t t = out[x];
+            out[x] = out[y];
+            out[y] = t;
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"

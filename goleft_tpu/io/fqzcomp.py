@@ -197,6 +197,12 @@ def _mix_context(p: _Params, qhist: int, remaining: int, delta: int,
 def decode(data: bytes, expected_len: int) -> bytes:
     """Decode one fqzcomp stream into ``expected_len`` quality bytes
     (the CRAM block header's raw size is authoritative)."""
+    if expected_len is not None:
+        from . import native
+
+        fast = native.fqzcomp_decode(data, expected_len)
+        if fast is not None:
+            return fast
     try:
         return _decode(data, expected_len)
     except IndexError:
@@ -308,12 +314,20 @@ def default_params(max_sym: int) -> _Params:
 
 def encode(lengths: list[int], quals: bytes,
            params: _Params | None = None, do_rev: bool = False,
-           rev: list[bool] | None = None) -> bytes:
+           rev: list[bool] | None = None,
+           param_sets: list[_Params] | None = None,
+           selectors: list[int] | None = None) -> bytes:
     """Encode per-record quality strings (fixture writer + fuzz twin).
 
     ``lengths`` gives each record's quality-string length; their sum
-    must equal ``len(quals)``.
+    must equal ``len(quals)``. Passing ``param_sets`` (with a
+    per-record ``selectors`` list) emits a MULTI_PARAM + HAVE_STAB
+    stream with an identity selector table, exercising the decoder's
+    selector machinery.
     """
+    if param_sets is not None:
+        return _encode_multi(lengths, quals, param_sets, selectors,
+                             do_rev, rev)
     if sum(lengths) != len(quals):
         raise ValueError("fqzcomp: lengths do not sum to the payload")
     if any(ln <= 0 for ln in lengths):
@@ -360,6 +374,83 @@ def encode(lengths: list[int], quals: bytes,
         for b in rec:
             q = inv[b] if inv is not None else b
             ctx = _mix_context(p, qhist, remaining, delta, 0)
+            models.qmodel(ctx).encode(rc, q)
+            qhist = ((qhist << p.qshift) + p.qtab[q]) & 0xFFFFFFFF
+            if p.dbits:
+                delta += prevq != q
+            prevq = q
+            remaining -= 1
+    return bytes(head) + rc.finish()
+
+
+def _encode_multi(lengths: list[int], quals: bytes,
+                  param_sets: list["_Params"],
+                  selectors: list[int] | None,
+                  do_rev: bool, rev: list[bool] | None) -> bytes:
+    """Multi-parameter twin: MULTI_PARAM + HAVE_STAB with an identity
+    selector table, per-record selector through the selector model,
+    the decoder's global last_len rule, and the selector term in the
+    context mix when a set carries DO_SEL."""
+    if sum(lengths) != len(quals):
+        raise ValueError("fqzcomp: lengths do not sum to the payload")
+    if any(ln <= 0 for ln in lengths):
+        raise ValueError("fqzcomp: record lengths must be positive")
+    nparam = len(param_sets)
+    if not 1 <= nparam <= 255:
+        raise ValueError("fqzcomp: 1..255 parameter sets")
+    if selectors is None or len(selectors) != len(lengths):
+        raise ValueError("fqzcomp: need one selector per record")
+    if any(not 0 <= s < nparam for s in selectors):
+        raise ValueError("fqzcomp: selector out of range")
+    max_sel = nparam - 1
+    stab = list(range(nparam)) + [nparam - 1] * (256 - nparam)
+    gflags = G_MULTI_PARAM | G_HAVE_STAB | (G_DO_REV if do_rev else 0)
+    head = bytearray([VERSION, gflags, nparam, max_sel])
+    head += _write_table(stab)
+    for p in param_sets:
+        head += p.serialize()
+    nsym = max(p.max_sym for p in param_sets) + 1
+    models = _Models(nsym, max_sel)
+    rc = RangeEncoder()
+    invs = [({v: s for s, v in enumerate(p.qmap)}
+             if p.qmap is not None else None) for p in param_sets]
+    off = 0
+    last_len = 0
+    prev_rec = None
+    for r, ln in enumerate(lengths):
+        rec = quals[off:off + ln]
+        off += ln
+        sel = selectors[r]
+        models.sel.encode(rc, sel)
+        p = param_sets[stab[sel]]
+        rflag = bool(rev[r]) if (do_rev and rev) else False
+        if rflag:
+            rec = rec[::-1]
+        if (p.pflags & P_DO_LEN) or last_len == 0:
+            models.len[0].encode(rc, ln & 0xFF)
+            models.len[1].encode(rc, (ln >> 8) & 0xFF)
+            models.len[2].encode(rc, (ln >> 16) & 0xFF)
+            models.len[3].encode(rc, (ln >> 24) & 0xFF)
+            last_len = ln
+        elif ln != last_len:
+            raise ValueError("fqzcomp: fixed-length set needs equal "
+                             "record lengths")
+        if do_rev:
+            models.rev.encode(rc, 1 if rflag else 0)
+        if p.pflags & P_DO_DEDUP:
+            is_dup = rec == prev_rec
+            models.dup.encode(rc, 1 if is_dup else 0)
+            prev_rec = rec
+            if is_dup:
+                continue
+        inv = invs[stab[sel]]
+        qhist = 0
+        prevq = 0
+        delta = 0
+        remaining = ln
+        for b in rec:
+            q = inv[b] if inv is not None else b
+            ctx = _mix_context(p, qhist, remaining, delta, sel)
             models.qmodel(ctx).encode(rc, q)
             qhist = ((qhist << p.qshift) + p.qtab[q]) & 0xFFFFFFFF
             if p.dbits:

@@ -98,6 +98,7 @@ def _register_restypes(lib) -> None:
         lib.ransnx16_decode0.restype = ctypes.c_long
         lib.ransnx16_decode1.restype = ctypes.c_long
         lib.arith_decode_body.restype = ctypes.c_long
+        lib.fqzcomp_decode.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -332,6 +333,22 @@ def arith_decode_body(data, pos: int, out_len: int, order: int,
         _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(pos),
         _ptr(out), ctypes.c_long(out_len),
         ctypes.c_int(1 if order else 0), ctypes.c_int(1 if rle else 0),
+    )
+    return out.tobytes() if r == 0 else None
+
+
+def fqzcomp_decode(data, out_len: int) -> bytes | None:
+    """fqzcomp full-stream decode in C; None → fall back to the
+    pure-Python decoder, which owns every error message (including
+    the zero-length case, whose header checks C skips)."""
+    lib = get_lib()
+    if lib is None or out_len == 0:
+        return None
+    buf = _as_u8(data)
+    out = np.empty(out_len, dtype=np.uint8)
+    r = lib.fqzcomp_decode(
+        _ptr(buf), ctypes.c_long(len(buf)), _ptr(out),
+        ctypes.c_long(out_len),
     )
     return out.tobytes() if r == 0 else None
 

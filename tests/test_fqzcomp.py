@@ -144,6 +144,88 @@ def test_mutation_fuzz_never_crashes():
             pass  # loud, typed failure is the contract
 
 
+@pytest.mark.native_io
+def test_native_decoder_matches_python_bytes(monkeypatch):
+    # the C port (csrc/fastio.cpp::fqzcomp_decode) must produce
+    # byte-identical output to the pure-Python decoder across the
+    # parameter surface — the context models mutate per symbol, so
+    # any divergence compounds
+    from goleft_tpu.io import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(13)
+
+    def check(lens, quals, **kw):
+        enc = fq.encode(lens, quals, **kw)
+        got_native = fq.decode(enc, len(quals))
+        with monkeypatch.context() as m:
+            m.setattr(native, "fqzcomp_decode", lambda *a, **k: None)
+            got_py = fq.decode(enc, len(quals))
+        assert got_native == got_py == quals
+
+    lens, quals = _mkquals(rng, 150, 50, 151)
+    check(lens, quals)
+    check(lens, quals, do_rev=True,
+          rev=[bool(rng.integers(0, 2)) for _ in lens])
+    p = fq.default_params(45)
+    p.pflags &= ~fq.P_DO_LEN
+    fl, fq_q = _mkquals(rng, 60, 0, 0, fixed=90)
+    check(fl, fq_q, params=p)
+    p = fq.default_params(45)
+    p.pflags |= fq.P_DO_DEDUP
+    base_lens, base = _mkquals(rng, 4, 70, 110)
+    tail = base[-base_lens[-1]:]
+    check(base_lens + [base_lens[-1]] * 2, base + tail * 2, params=p)
+    vals = [2, 12, 22, 37]
+    p = fq.default_params(3)
+    p.pflags |= fq.P_HAVE_QMAP
+    p.max_sym = len(vals)
+    p.qmap = vals
+    check([80] * 40, bytes(rng.choice(vals, size=3200)
+                           .astype(np.uint8)), params=p)
+    p = fq.default_params(45)
+    p.dbits, p.dshift, p.dloc = 3, 2, 13
+    p.pflags |= fq.P_HAVE_DTAB
+    p.dtab = fq._default_table(256, 3, 2)
+    dl, dq = _mkquals(rng, 70, 60, 130)
+    check(dl, dq, params=p)
+    # MULTI_PARAM + HAVE_STAB + DO_SEL: per-record parameter-set
+    # switching through the selector model and the sel context term
+    p0 = fq.default_params(45)
+    p0.pflags |= fq.P_DO_SEL
+    p0.sloc = 14
+    p1 = fq.default_params(45)
+    p1.pflags |= fq.P_DO_SEL
+    p1.sloc = 14
+    p1.seed = 7
+    p1.qbits = 7
+    ml, mq = _mkquals(rng, 120, 50, 140)
+    sels = [int(rng.integers(0, 2)) for _ in ml]
+    check(ml, mq, param_sets=[p0, p1], selectors=sels)
+
+
+def test_roundtrip_multi_param_selectors():
+    # pure-Python round trip of the selector machinery, independent of
+    # the native lib
+    rng = np.random.default_rng(14)
+    lens, quals = _mkquals(rng, 100, 60, 120)
+    p0 = fq.default_params(45)
+    p0.pflags |= fq.P_DO_SEL
+    p0.sloc = 14
+    p1 = fq.default_params(45)
+    p1.pflags |= fq.P_DO_SEL
+    p1.sloc = 14
+    p1.seed = 99
+    sels = [i % 2 for i in range(len(lens))]
+    enc = fq.encode(lens, quals, param_sets=[p0, p1], selectors=sels)
+    assert fq.decode(enc, len(quals)) == quals
+    # header really is MULTI_PARAM + HAVE_STAB
+    assert enc[1] & fq.G_MULTI_PARAM and enc[1] & fq.G_HAVE_STAB
+    assert enc[2] == 2  # two parameter sets
+
+
+@pytest.mark.native_io
 def test_cram_block_integration():
     from goleft_tpu.io.cram import M_FQZCOMP, _decompress
 
