@@ -165,6 +165,66 @@ def _thread_scaling_entry() -> dict:
         return {"error": str(e)}
 
 
+def _cram31_codec_entry(quick: bool) -> dict:
+    """Decode throughput of the clean-room CRAM 3.1 block codecs
+    through their product entrypoints (C fast path with pure-Python
+    fallback; foreign 3.1 CRAMs are decode-bound on these). Never
+    raises — like _thread_scaling_entry, a failure here must not
+    discard the rest of the suite's entries."""
+    try:
+        return _cram31_codec_entry_inner(quick)
+    except Exception as e:  # pragma: no cover - keep bench robust
+        return {"error": str(e)}
+
+
+def _cram31_codec_entry_inner(quick: bool) -> dict:
+    from goleft_tpu.io import arith, native
+    from goleft_tpu.io import fqzcomp as fqz
+    from goleft_tpu.io import rans_nx16 as rx
+
+    n = 262_144 if quick else 1_048_576
+    rng = np.random.default_rng(3)
+    data = bytes(rng.choice([65, 67, 71, 84], p=[.4, .3, .2, .1],
+                            size=n).astype(np.uint8))
+    lens, quals = [], bytearray()
+    while len(quals) < n:
+        ln = int(rng.integers(60, 151))
+        lens.append(ln)
+        quals += bytes(np.clip(np.cumsum(rng.integers(-2, 3, ln)) + 30,
+                               0, 45).astype(np.uint8))
+    quals = bytes(quals)
+    cases = [
+        ("rans_nx16_o0", rx.encode(data, order=0), rx.decode, data),
+        ("rans_nx16_o1", rx.encode(data, order=1), rx.decode, data),
+        ("arith_o0", arith.encode(data, order=0), arith.decode, data),
+        ("arith_o1", arith.encode(data, order=1), arith.decode, data),
+        ("fqzcomp", fqz.encode(lens, quals), fqz.decode, quals),
+    ]
+    native_lib = native.get_lib() is not None
+    # best-of-N after a warmup (the first call pays ctypes load); on
+    # the pure-Python fallback one rep bounds total bench time
+    reps = 3 if native_lib else 1
+    entries = {}
+    for name, enc, dec, want in cases:
+        out = dec(enc, len(want))  # warmup
+        dt = min(_timed(dec, enc, len(want)) for _ in range(reps))
+        if out != want:
+            raise AssertionError(f"codec bench mismatch: {name}")
+        entries[name] = {
+            "payload_mb": round(len(want) / 1e6, 2),
+            "ratio": round(len(enc) / len(want), 3),
+            "decode_mb_per_sec": round(len(want) / dt / 1e6, 1),
+        }
+    return {
+        "native_lib": native_lib,
+        "payload": "ACGT-skewed bytes / correlated quality strings",
+        "codecs": entries,
+        "note": "CRAM 3.1 block methods 5-7 via their product decode "
+                "entrypoints (csrc fast path, pure-Python fallback); "
+                "method 8 (names) rides the same two coders",
+    }
+
+
 def _merge_details(details: dict) -> dict:
     """Merge new entries into BENCH_details.json (preserving entries
     other modes wrote) and echo to stderr."""
@@ -370,6 +430,7 @@ def bench_suite(quick: bool) -> dict:
     # multi-core claim (see tests/test_thread_scaling.py — same
     # measurement, judge-visible here)
     out["decode_thread_scaling"] = _thread_scaling_entry()
+    out["cram31_codec_decode"] = _cram31_codec_entry(quick)
 
     from goleft_tpu.models.emdepth import MAX_ITER, N_LAMBDA
 
@@ -567,6 +628,7 @@ def host_suite(quick: bool) -> dict:
                 "html/png; reference README cites ~30s for 30 samples",
     }
     out["decode_thread_scaling"] = _thread_scaling_entry()
+    out["cram31_codec_decode"] = _cram31_codec_entry(quick)
     return out
 
 
