@@ -527,8 +527,17 @@ def write_block(method: int, ctype: int, cid: int, data: bytes,
         comp = gzip.compress(data, 6)
     else:
         comp = data
+    return _write_block_pre(method, ctype, cid, comp, len(data), v2)
+
+
+def _write_block_pre(method: int, ctype: int, cid: int, comp: bytes,
+                     raw_size: int, v2: bool = False) -> bytes:
+    """Frame an already-compressed payload (write_block's tail, and
+    the direct entry for the specialized codecs — tok3 names, fqzcomp
+    qualities — which compress with record structure write_block
+    cannot know)."""
     head = bytes([method, ctype]) + write_itf8(cid) + \
-        write_itf8(len(comp)) + write_itf8(len(data))
+        write_itf8(len(comp)) + write_itf8(raw_size)
     if v2:  # CRAM 2.x blocks carry no CRC trailer
         return head + comp
     return head + comp + struct.pack("<I", zlib.crc32(head + comp))
@@ -1479,7 +1488,8 @@ class CramWriter:
     def __init__(self, fh, header_text: str, ref_names: list[str],
                  ref_lens: list[int], records_per_container: int = 10000,
                  block_method: int = M_GZIP, ap_delta: bool = True,
-                 rans_order: int = 0, minor: int = 0, major: int = 3):
+                 rans_order: int = 0, minor: int = 0, major: int = 3,
+                 series_methods: dict[str, int] | None = None):
         if major not in (2, 3):
             raise ValueError("cram: writer supports major 2 and 3")
         self._fh = fh
@@ -1489,6 +1499,26 @@ class CramWriter:
         self._rans_order = rans_order
         self._ap_delta = ap_delta
         self._v2 = major == 2
+        # per-series block-method overrides, e.g. the htslib 3.1 shape
+        # {"RN": M_TOK3, "QS": M_FQZCOMP}: RN switches to a \0 stop
+        # byte and the tokeniser; QS compresses the per-record quality
+        # payload through fqzcomp. Only combinations with a real
+        # encoder are accepted — anything else would write a method
+        # byte over a payload that codec cannot decode.
+        general = {M_RAW, M_GZIP, M_RANS, M_RANSNX16, M_ARITH}
+        if block_method not in general:
+            raise ValueError(
+                "cram: block_method must be a general-purpose codec "
+                "(raw/gzip/rans4x8/rans-nx16/arith); use "
+                "series_methods for RN:tok3 / QS:fqzcomp")
+        self._series_methods = dict(series_methods or {})
+        for k, m in self._series_methods.items():
+            if m in general or (k == "RN" and m == M_TOK3) or \
+                    (k == "QS" and m == M_FQZCOMP):
+                continue
+            raise ValueError(
+                f"cram: no encoder for series {k!r} with method {m} "
+                "(tok3 is RN-only, fqzcomp is QS-only)")
         self._pending: list[dict] = []
         self._counter = 0
         self._offsets: list[tuple[int, int, int, int, int]] = []
@@ -1510,12 +1540,21 @@ class CramWriter:
     def write_record(self, tid: int, pos0: int,
                      cigar: list[tuple[int, int]], mapq: int = 60,
                      flag: int = 0, name: str = "r", mate_tid: int = -1,
-                     mate_pos: int = -1, tlen: int = 0) -> None:
-        """pos0 is 0-based (BamWriter-compatible); CRAM stores 1-based."""
+                     mate_pos: int = -1, tlen: int = 0,
+                     quals: bytes | None = None) -> None:
+        """pos0 is 0-based (BamWriter-compatible); CRAM stores 1-based.
+        ``quals`` (one byte per query base) stores the record's quality
+        string (CF_QS_STORED) in the QS series."""
+        if quals is not None:
+            q_len = sum(ln for ln, op in cigar if op in (0, 1, 4, 7, 8))
+            if len(quals) != q_len or not quals:
+                raise ValueError(
+                    "cram: quals must be non-empty and match the "
+                    "query length")
         self._pending.append(dict(
             tid=tid, pos=pos0 + 1, cigar=cigar, mapq=mapq, flag=flag,
             name=name, mate_tid=mate_tid, mate_pos=mate_pos + 1,
-            tlen=tlen,
+            tlen=tlen, quals=quals,
         ))
         if len(self._pending) >= self._rpc or (
             len(self._pending) > 1
@@ -1536,7 +1575,12 @@ class CramWriter:
         self._pending = []
         ids = _W_IDS
         ints: dict[str, list[int]] = {k: [] for k in ids}
+        rn_tok3 = self._series_methods.get("RN") == M_TOK3
+        rn_stop = 0x00 if rn_tok3 else 0x09
         names = bytearray()
+        name_list: list[bytes] = []
+        qs_payload = bytearray()
+        qs_lens: list[int] = []
         sc_bytes = bytearray()
         in_bytes = bytearray()
         ref_id = recs[0]["tid"]
@@ -1548,6 +1592,10 @@ class CramWriter:
                         if op in (0, 1, 4, 7, 8))  # M I S = X
             bf = r["flag"] & ~(BAM_MREVERSE | BAM_MUNMAP)
             cf = CF_DETACHED | CF_NO_SEQ
+            if r.get("quals") is not None:
+                cf |= CF_QS_STORED
+                qs_payload += r["quals"]
+                qs_lens.append(len(r["quals"]))
             ints["BF"].append(bf)
             ints["CF"].append(cf)
             ints["RL"].append(q_len)
@@ -1557,7 +1605,9 @@ class CramWriter:
             else:
                 ints["AP"].append(r["pos"])
             ints["RG"].append(-1)
-            names += r["name"].encode() + b"\t"
+            nm = r["name"].encode()
+            names += nm + bytes([rn_stop])
+            name_list.append(nm)
             mf = ((MF_MATE_REVERSE if r["flag"] & BAM_MREVERSE else 0)
                   | (MF_MATE_UNMAPPED if r["flag"] & BAM_MUNMAP else 0))
             ints["MF"].append(mf)
@@ -1616,7 +1666,7 @@ class CramWriter:
         for key, cid in ids.items():
             if key == "RN":
                 comp.encodings[key] = Encoding(
-                    E_BYTE_ARRAY_STOP, {"stop": 0x09, "id": cid})
+                    E_BYTE_ARRAY_STOP, {"stop": rn_stop, "id": cid})
             elif key in ("SC", "IN"):
                 comp.encodings[key] = Encoding(
                     E_BYTE_ARRAY_STOP, {"stop": 0x00, "id": cid})
@@ -1627,6 +1677,8 @@ class CramWriter:
         for key, cid in ids.items():
             if key == "RN":
                 ext_payload[cid] = bytes(names)
+            elif key == "QS":
+                ext_payload[cid] = bytes(qs_payload)
             elif key == "SC":
                 ext_payload[cid] = bytes(sc_bytes)
             elif key == "IN":
@@ -1636,6 +1688,7 @@ class CramWriter:
                     write_itf8(v) for v in ints[key]
                 )
         used = [cid for cid, payload in ext_payload.items() if payload]
+        key_of = {cid: key for key, cid in ids.items()}
 
         sl = SliceHeader(
             ref_id, first_pos, span, len(recs), self._counter,
@@ -1645,10 +1698,27 @@ class CramWriter:
                              sl.serialize(v2=self._v2), v2=self._v2)
         blocks += write_block(M_RAW, CT_CORE, 0, b"", v2=self._v2)
         for cid in used:
-            blocks += write_block(self._method, CT_EXTERNAL, cid,
-                                  ext_payload[cid],
-                                  rans_order=self._rans_order,
-                                  v2=self._v2)
+            key = key_of[cid]
+            method = self._series_methods.get(key, self._method)
+            payload = ext_payload[cid]
+            if method == M_TOK3 and key == "RN":
+                from .tok3 import encode as tok3_encode
+
+                comp_bytes = tok3_encode(name_list)
+                blocks += _write_block_pre(M_TOK3, CT_EXTERNAL, cid,
+                                           comp_bytes, len(payload),
+                                           self._v2)
+            elif method == M_FQZCOMP and key == "QS":
+                from .fqzcomp import encode as fqz_encode
+
+                comp_bytes = fqz_encode(qs_lens, bytes(payload))
+                blocks += _write_block_pre(M_FQZCOMP, CT_EXTERNAL, cid,
+                                           comp_bytes, len(payload),
+                                           self._v2)
+            else:
+                blocks += write_block(method, CT_EXTERNAL, cid, payload,
+                                      rans_order=self._rans_order,
+                                      v2=self._v2)
         comp_block = write_block(M_RAW, CT_COMP_HEADER, 0,
                                  comp.serialize(), v2=self._v2)
         body = comp_block + blocks

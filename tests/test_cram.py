@@ -155,6 +155,82 @@ def test_cram_v2_matches_bam_twin_columns(tmp_path, minor):
     np.testing.assert_array_equal(cols.pos, want0.pos)
 
 
+def test_cram_31_specialized_series_codecs_twin(tmp_path):
+    # the htslib 3.1 shape: read names through the tokeniser (method
+    # 8), per-record qualities through fqzcomp (method 7), everything
+    # else through rANS-Nx16 — decoded inside real containers, not
+    # just via block framing
+    rng = np.random.default_rng(21)
+    reads = _twin_reads(rng, n=1200)
+    bam_p = str(tmp_path / "t.bam")
+    cram_p = str(tmp_path / "t31.cram")
+    write_bam(bam_p, reads, ref_names=("chr1", "chr2"),
+              ref_lens=(120_000, 50_000))
+    hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
+    from goleft_tpu.io.bam import parse_cigar
+
+    with open(cram_p, "wb") as fh:
+        with CramWriter(
+                fh, hdr, ["chr1", "chr2"], [120_000, 50_000],
+                records_per_container=400, minor=1,
+                block_method=cram.M_RANSNX16, rans_order=1,
+                series_methods={"RN": cram.M_TOK3,
+                                "QS": cram.M_FQZCOMP}) as w:
+            for i, (tid, pos, cig, mq, fl) in enumerate(reads):
+                cig_ops = parse_cigar(cig)
+                q_len = sum(ln for ln, op in cig_ops
+                            if op in (0, 1, 4, 7, 8))
+                quals = bytes(
+                    np.clip(np.cumsum(rng.integers(-2, 3, q_len)) + 30,
+                            0, 45).astype(np.uint8)) if q_len else None
+                w.write_record(tid, pos, cig_ops, mapq=mq, flag=fl,
+                               name=f"A00:1:{1100 + i % 4}:{i}",
+                               quals=quals)
+        w.write_crai(cram_p + ".crai")
+
+    # the blocks really carry methods 7 and 8
+    import mmap
+
+    with open(cram_p, "rb") as fh:
+        buf = memoryview(mmap.mmap(fh.fileno(), 0,
+                                   access=mmap.ACCESS_READ))
+    cf = CramFile(buf, crai_path=cram_p + ".crai")
+    methods = set()
+    for hdr_c, body in cf._iter_containers():
+        pos = body
+        end = body + hdr_c.length
+        while pos < end:
+            blk, pos = cram.read_block(buf, pos)
+            methods.add(blk.method)
+    assert cram.M_TOK3 in methods and cram.M_FQZCOMP in methods
+    assert cram.M_RANSNX16 in methods
+
+    # and the decoded columns match the BAM twin byte for byte
+    want = BamReader.from_file(bam_p).read_columns()
+    got = cf.read_columns()
+    for f in ("tid", "pos", "end", "mapq", "flag", "read_len",
+              "seg_start", "seg_end", "seg_read"):
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f), err_msg=f)
+
+
+def test_writer_rejects_undecodable_method_combos(tmp_path):
+    # a (series, method) pair without a real encoder must fail at
+    # construction, not write an undecodable file
+    import io as _io
+
+    hdr = "@HD\tVN:1.6\n"
+    with pytest.raises(ValueError, match="no encoder"):
+        CramWriter(_io.BytesIO(), hdr, ["c"], [100],
+                   series_methods={"RN": cram.M_FQZCOMP})
+    with pytest.raises(ValueError, match="no encoder"):
+        CramWriter(_io.BytesIO(), hdr, ["c"], [100],
+                   series_methods={"QS": cram.M_TOK3})
+    with pytest.raises(ValueError, match="general-purpose"):
+        CramWriter(_io.BytesIO(), hdr, ["c"], [100],
+                   block_method=cram.M_TOK3)
+
+
 def test_v2_counter_is_itf8_and_eof_marker_parses():
     # the record counter widened to LTF8 in 3.0; 2.x stores ITF8 —
     # a counter past 2^28 encodes differently in the two forms, so a
