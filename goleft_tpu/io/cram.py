@@ -436,16 +436,27 @@ def rans_encode_1(data: bytes) -> bytes:
 def _decompress(method: int, data: bytes, raw_size: int) -> bytes:
     if method == M_RAW:
         return data
-    if method == M_GZIP:
-        return gzip.decompress(data)
-    if method == M_BZIP2:
-        import bz2
-
-        return bz2.decompress(data)
-    if method == M_LZMA:
+    if method in (M_GZIP, M_BZIP2, M_LZMA):
         import lzma
 
-        return lzma.decompress(data)
+        try:
+            if method == M_GZIP:
+                return gzip.decompress(data)
+            if method == M_BZIP2:
+                import bz2
+
+                return bz2.decompress(data)
+            return lzma.decompress(data)
+        except (OSError, ValueError, zlib.error, EOFError,
+                lzma.LZMAError) as e:
+            # LZMAError is not an OSError; truncated bz2 raises a bare
+            # ValueError — re-wrap both so the message carries the
+            # module's 'cram:' context
+            # stdlib decompressors raise their own error types on a
+            # corrupt payload; surface the module's typed error
+            raise ValueError(
+                f"cram: corrupt block payload (method {method}: {e})"
+            ) from None
     if method == M_RANS:
         return rans_decode(data)
     if method in (M_RANSNX16, M_ARITH, M_FQZCOMP, M_TOK3):
@@ -1203,11 +1214,14 @@ def _container_records(buf: memoryview, pos: int,
                 elif b.content_type == CT_EXTERNAL:
                     externals[b.content_id] = b.data
             records.extend(decode_slice(comp, sl, core, externals))
-    except (IndexError, struct.error) as e:
+    except (IndexError, KeyError, struct.error) as e:
         # truncated mid-container: raw memoryview/struct errors become
-        # the module's clean error surface
+        # the module's clean error surface. KeyError covers corrupt
+        # content ids steering the decoder at a block that is not in
+        # the slice — in the CRC-less 2.x layout nothing upstream
+        # catches that corruption first
         raise ValueError(
-            f"cram: truncated container body at byte {pos}"
+            f"cram: truncated or corrupt container body at byte {pos}"
         ) from e
     return records
 
@@ -1243,8 +1257,16 @@ class CramFile:
         # _decompress
         self._v2 = self.major == 2
         pos = 26  # magic + version + 20-byte file id
-        hdr, pos = ContainerHeader.parse(buf, pos, self._v2)
-        first_block, _ = read_block(buf, pos, self._v2)
+        try:
+            hdr, pos = ContainerHeader.parse(buf, pos, self._v2)
+            first_block, _ = read_block(buf, pos, self._v2)
+        except (IndexError, struct.error) as e:
+            # a file truncated inside the header container raises raw
+            # memoryview/struct errors; surface the module's clean
+            # error type like every other parse path
+            raise ValueError(
+                "cram: truncated or corrupt file header"
+            ) from e
         if first_block.content_type != CT_FILE_HEADER:
             raise ValueError("cram: first container must hold SAM header")
         text = _sam_header_text(first_block.data)

@@ -369,6 +369,58 @@ def test_corrupt_cram_clear_error(tmp_path):
         open_bam_file(str(p))
 
 
+@pytest.mark.parametrize("flavor", ["v31_specialized", "v2"])
+def test_whole_file_mutation_fuzz_typed_errors(tmp_path, flavor):
+    """Bit-flip and truncate complete CRAM files (the 3.1 shape with
+    tok3/fqzcomp blocks, and the CRC-less 2.x layout) through the full
+    reader: every outcome must be a clean decode or a typed
+    ValueError/SystemExit — never a crash, hang, or raw struct error."""
+    from goleft_tpu.io.bam import parse_cigar
+
+    rng = np.random.default_rng(31)
+    reads = _twin_reads(rng, n=400)
+    hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
+    p = str(tmp_path / "m.cram")
+    kw = (dict(minor=1, block_method=cram.M_RANSNX16, rans_order=1,
+               series_methods={"RN": cram.M_TOK3,
+                               "QS": cram.M_FQZCOMP})
+          if flavor == "v31_specialized" else dict(major=2, minor=1))
+    with open(p, "wb") as fh:
+        with CramWriter(fh, hdr, ["chr1", "chr2"], [120_000, 50_000],
+                        records_per_container=150, **kw) as w:
+            for i, (tid, pos, cig, mq, fl) in enumerate(reads):
+                cig_ops = parse_cigar(cig)
+                q_len = sum(ln for ln, op in cig_ops
+                            if op in (0, 1, 4, 7, 8))
+                quals = (bytes(rng.integers(0, 45, q_len)
+                               .astype(np.uint8))
+                         if q_len and flavor == "v31_specialized"
+                         else None)
+                w.write_record(tid, pos, cig_ops, mapq=mq, flag=fl,
+                               name=f"r{i}", quals=quals)
+    blob = bytearray(open(p, "rb").read())
+    bad = str(tmp_path / "bad.cram")
+    for trial in range(60):
+        mut = bytearray(blob)
+        k = int(rng.integers(6, len(mut)))  # keep the magic intact
+        mut[k] ^= 1 << int(rng.integers(0, 8))
+        with open(bad, "wb") as fh:
+            fh.write(bytes(mut))
+        try:
+            h = open_bam_file(bad)
+            h.read_columns()
+        except (ValueError, SystemExit):
+            pass  # typed failure is the contract
+    for cut in (7, 30, len(blob) // 3, len(blob) - 9):
+        with open(bad, "wb") as fh:
+            fh.write(bytes(blob[:cut]))
+        try:
+            h = open_bam_file(bad)
+            h.read_columns()
+        except (ValueError, SystemExit):
+            pass
+
+
 @pytest.mark.parametrize("order", [0, 1])
 def test_rans_order_fuzz(order):
     """Both rANS orders round-trip across distributions (incl. the
