@@ -1,11 +1,11 @@
 # Native io library + sanitizer/test targets.
 # The Python side builds build/libgoleftio.so lazily; these targets are
-# for CI-style hardening runs (SURVEY.md §5: host C++ under ASan).
+# for CI-style hardening runs (SURVEY.md §5: host C++ under ASan/TSan).
 
 CXX ?= g++
 SRC = csrc/fastio.cpp
 
-.PHONY: native asan test test-native-asan clean
+.PHONY: native asan tsan test test-native-asan test-native-tsan clean
 
 native: build/libgoleftio.so
 
@@ -35,9 +35,27 @@ test:
 # encodes the real invariant (no XLA execution under ASan; the allocator
 # interposition crashes inside the JAX runtime)
 test-native-asan: build/libgoleftio_asan.so
-	GOLEFT_TPU_ASAN_LIB=$(PWD)/build/libgoleftio_asan.so \
+	GOLEFT_TPU_ASAN_LIB=$(CURDIR)/build/libgoleftio_asan.so \
 	LD_PRELOAD=$(shell $(CXX) -print-file-name=libasan.so) \
 	ASAN_OPTIONS=detect_leaks=0 \
+	python -m pytest tests/ -q -m native_io
+
+build/libgoleftio_tsan.so: $(SRC)
+	mkdir -p build
+	$(CXX) -O1 -g -fsanitize=thread -shared -fPIC $(SRC) $(EXTRA) -lz $(DEFLATE_LIBS) -o $@
+
+tsan: build/libgoleftio_tsan.so
+
+# ThreadSanitizer run over the same native_io suite — the decode
+# threads share the lib's thread_local pools and per-call scratch, and
+# the threaded-cohort / thread-scaling tests drive real concurrent
+# native calls, which is exactly what TSan instruments. Reuses the
+# GOLEFT_TPU_ASAN_LIB override (it just points native.py at a
+# sanitizer build; the sanitizer flavor is the build's concern).
+test-native-tsan: build/libgoleftio_tsan.so
+	GOLEFT_TPU_ASAN_LIB=$(CURDIR)/build/libgoleftio_tsan.so \
+	LD_PRELOAD=$(shell $(CXX) -print-file-name=libtsan.so) \
+	TSAN_OPTIONS=report_bugs=1:halt_on_error=1 \
 	python -m pytest tests/ -q -m native_io
 
 clean:

@@ -449,14 +449,13 @@ def _decompress(method: int, data: bytes, raw_size: int) -> bytes:
             return lzma.decompress(data)
         except (OSError, ValueError, zlib.error, EOFError,
                 lzma.LZMAError) as e:
-            # LZMAError is not an OSError; truncated bz2 raises a bare
-            # ValueError — re-wrap both so the message carries the
-            # module's 'cram:' context
             # stdlib decompressors raise their own error types on a
-            # corrupt payload; surface the module's typed error
+            # corrupt payload (LZMAError is not an OSError; truncated
+            # bz2 raises a bare ValueError) — re-wrap with the
+            # module's 'cram:' context
             raise ValueError(
                 f"cram: corrupt block payload (method {method}: {e})"
-            ) from None
+            ) from e
     if method == M_RANS:
         return rans_decode(data)
     if method in (M_RANSNX16, M_ARITH, M_FQZCOMP, M_TOK3):
@@ -701,6 +700,14 @@ class Decoder:
         self.enc = enc
         self.core = core
         self.ext = externals
+        if enc.codec in (E_EXTERNAL, E_BYTE_ARRAY_STOP) \
+                and enc.params["id"] not in externals:
+            # validate at construction so a corrupt content id (which
+            # nothing upstream catches in the CRC-less 2.x layout)
+            # fails typed instead of KeyError-ing mid-record
+            raise ValueError(
+                f"cram: slice references missing external block "
+                f"{enc.params['id']}")
         if enc.codec == E_HUFFMAN:
             self._build_huffman()
         elif enc.codec == E_BYTE_ARRAY_LEN:
@@ -1214,12 +1221,11 @@ def _container_records(buf: memoryview, pos: int,
                 elif b.content_type == CT_EXTERNAL:
                     externals[b.content_id] = b.data
             records.extend(decode_slice(comp, sl, core, externals))
-    except (IndexError, KeyError, struct.error) as e:
+    except (IndexError, struct.error) as e:
         # truncated mid-container: raw memoryview/struct errors become
-        # the module's clean error surface. KeyError covers corrupt
-        # content ids steering the decoder at a block that is not in
-        # the slice — in the CRC-less 2.x layout nothing upstream
-        # catches that corruption first
+        # the module's clean error surface (missing external ids are
+        # validated at Decoder construction, so a KeyError here would
+        # be a genuine bug and must surface as one)
         raise ValueError(
             f"cram: truncated or corrupt container body at byte {pos}"
         ) from e
