@@ -1842,3 +1842,254 @@ long fqzcomp_decode(const uint8_t* buf, long len, uint8_t* out,
 }
 
 }  // extern "C"
+
+// ------------------------------------------------------------------
+// C port of io/tok3.py's name assembly (CRAM 3.1 block method 8).
+// The per-(position, field) streams are already decompressed by the
+// C-backed rANS-Nx16/arith decoders on the Python side; this routine
+// replays the token machine over them: DUP copies a whole earlier
+// name, DIFF rebuilds token-by-token (MATCH copies the template
+// token, DDELTA/DDELTA0 add a u8 to its numeric value — DDELTA0
+// keeping the template's zero-padded width - DIGITS/DIGITS0/ALPHA/
+// CHAR read fresh payloads). Streams arrive as one concatenated blob
+// with a 256x13 (position, field) offset/length table, -1 = absent.
+// Accelerator only: nonzero return → the pure-Python assembly (which
+// owns every error message) takes over.
+
+#define TOK3_SLOTS (256 * 13)
+#define T3_TYPE 0
+#define T3_ALPHA 1
+#define T3_CHAR 2
+#define T3_DIGITS0 3
+#define T3_DZLEN 4
+#define T3_DUP 5
+#define T3_DIFF 6
+#define T3_DIGITS 7
+#define T3_DDELTA 8
+#define T3_DDELTA0 9
+#define T3_MATCH 10
+#define T3_NOP 11
+#define T3_END 12
+
+struct Tok3Tok {
+    int32_t start;  // offset of the token text in `out`
+    int32_t len;
+    uint8_t type;   // T3_ALPHA / T3_CHAR / T3_DIGITS / T3_DIGITS0
+};
+
+extern "C" {
+
+long tok3_assemble(const uint8_t* blob, const int64_t* offs,
+                   const int64_t* lens, long n_names, uint8_t sep,
+                   uint8_t* out, long out_cap) {
+    // every valid name contributes at least its separator byte, so
+    // a name count beyond out_cap (attacker-controlled varint) can
+    // never assemble — reject before sizing any scratch from it
+    if (n_names < 0 || n_names > out_cap) return -1;
+    long cur[TOK3_SLOTS];
+    memset(cur, 0, sizeof(cur));
+    struct Scratch {
+        Tok3Tok* toks = nullptr;
+        int64_t* name_tok0 = nullptr;  // first token index per name
+        int32_t* name_ntok = nullptr;
+        int64_t* name_start = nullptr;  // offset of name in out
+        int32_t* name_len = nullptr;
+        ~Scratch() {
+            free(toks);
+            free(name_tok0);
+            free(name_ntok);
+            free(name_start);
+            free(name_len);
+        }
+    } s;
+    long tok_cap = 4096, n_toks = 0;
+    s.toks = (Tok3Tok*)malloc(tok_cap * sizeof(Tok3Tok));
+    s.name_tok0 = (int64_t*)malloc(n_names * sizeof(int64_t));
+    s.name_ntok = (int32_t*)malloc(n_names * sizeof(int32_t));
+    s.name_start = (int64_t*)malloc(n_names * sizeof(int64_t));
+    s.name_len = (int32_t*)malloc(n_names * sizeof(int32_t));
+    if (!s.toks || !s.name_tok0 || !s.name_ntok || !s.name_start ||
+        !s.name_len)
+        return -4;
+
+#define SLOT(p, f) ((p) * 13 + (f))
+#define HAVE(sl) (offs[sl] >= 0)
+#define TAKE1(sl, v)                                   \
+    do {                                               \
+        if (!HAVE(sl) || cur[sl] >= lens[sl]) return -1; \
+        (v) = blob[offs[sl] + cur[sl]++];              \
+    } while (0)
+
+    long w = 0;  // write position in out
+    for (long n = 0; n < n_names; n++) {
+        int t0;
+        TAKE1(SLOT(0, T3_TYPE), t0);
+        uint32_t dist;
+        if (t0 == T3_DUP || t0 == T3_DIFF) {
+            int sl = SLOT(0, t0);
+            if (!HAVE(sl) || cur[sl] + 4 > lens[sl]) return -1;
+            memcpy(&dist, blob + offs[sl] + cur[sl], 4);
+            cur[sl] += 4;
+        } else {
+            return -1;
+        }
+        long src = n - 1 - (long)dist;
+        if (t0 == T3_DUP) {
+            if (src < 0 || src >= n) return -1;
+            long ln = s.name_len[src];
+            if (w + ln + 1 > out_cap) return -1;
+            memcpy(out + w, out + s.name_start[src], ln);
+            s.name_tok0[n] = s.name_tok0[src];
+            s.name_ntok[n] = s.name_ntok[src];
+            s.name_start[n] = w;
+            s.name_len[n] = (int32_t)ln;
+            w += ln;
+            out[w++] = sep;
+            continue;
+        }
+        if (n && (src < 0 || src >= n)) return -1;
+        // keep the template as an INDEX: the token arena reallocs
+        // while this name decodes, so a pointer would dangle
+        long tmpl0 = n ? s.name_tok0[src] : 0;
+        int tmpl_n = n ? s.name_ntok[src] : 0;
+        long my_tok0 = n_toks;
+        long name_w0 = w;
+        int t = 1;
+        while (1) {
+            if (t >= 256) return -1;  // stream keys are single bytes
+            int typ;
+            TAKE1(SLOT(t, T3_TYPE), typ);
+            if (typ == T3_END) break;
+            if (typ == T3_NOP) {
+                t++;
+                continue;
+            }
+            if (n_toks == tok_cap) {
+                tok_cap *= 2;
+                Tok3Tok* nt = (Tok3Tok*)realloc(
+                    s.toks, tok_cap * sizeof(Tok3Tok));
+                if (!nt) return -4;
+                s.toks = nt;
+            }
+            Tok3Tok* me = &s.toks[n_toks];
+            const Tok3Tok* tm = (t - 1 < tmpl_n)
+                ? &s.toks[tmpl0 + t - 1] : nullptr;
+            long start = w;
+            if (typ == T3_MATCH) {
+                if (!tm) return -1;
+                if (w + tm->len > out_cap) return -1;
+                memcpy(out + w, out + tm->start, tm->len);
+                w += tm->len;
+                me->type = tm->type;
+            } else if (typ == T3_ALPHA) {
+                int sl = SLOT(t, T3_ALPHA);
+                if (!HAVE(sl)) return -1;
+                const uint8_t* base = blob + offs[sl];
+                long p = cur[sl];
+                while (p < lens[sl] && base[p] != 0) p++;
+                if (p >= lens[sl]) return -1;  // unterminated
+                long ln = p - cur[sl];
+                if (w + ln > out_cap) return -1;
+                memcpy(out + w, base + cur[sl], ln);
+                w += ln;
+                cur[sl] = p + 1;
+                me->type = T3_ALPHA;
+            } else if (typ == T3_CHAR) {
+                int c;
+                TAKE1(SLOT(t, T3_CHAR), c);
+                if (w + 1 > out_cap) return -1;
+                out[w++] = (uint8_t)c;
+                me->type = T3_CHAR;
+            } else if (typ == T3_DIGITS || typ == T3_DDELTA) {
+                uint32_t v;
+                uint64_t vv;
+                if (typ == T3_DIGITS) {
+                    int sl = SLOT(t, T3_DIGITS);
+                    if (!HAVE(sl) || cur[sl] + 4 > lens[sl]) return -1;
+                    memcpy(&v, blob + offs[sl] + cur[sl], 4);
+                    cur[sl] += 4;
+                    vv = v;
+                } else {
+                    if (!tm || (tm->type != T3_DIGITS &&
+                                tm->type != T3_DIGITS0))
+                        return -1;
+                    int d;
+                    TAKE1(SLOT(t, T3_DDELTA), d);
+                    // parse the template's decimal value; the sum can
+                    // exceed u32 (the Python reference prints the full
+                    // value), so keep 64 bits through the formatting
+                    uint64_t tv = 0;
+                    for (int k = 0; k < tm->len; k++) {
+                        uint8_t c = out[tm->start + k];
+                        if (c < '0' || c > '9') return -1;
+                        tv = tv * 10 + (c - '0');
+                        if (tv > 0xFFFFFFFFull) return -1;
+                    }
+                    vv = tv + (uint64_t)d;
+                }
+                char dec[24];
+                int ln = snprintf(dec, sizeof(dec), "%llu",
+                                  (unsigned long long)vv);
+                if (ln <= 0 || w + ln > out_cap) return -1;
+                memcpy(out + w, dec, ln);
+                w += ln;
+                me->type = T3_DIGITS;
+            } else if (typ == T3_DIGITS0 || typ == T3_DDELTA0) {
+                uint32_t v;
+                uint64_t vv;
+                int z;
+                if (typ == T3_DIGITS0) {
+                    int sl = SLOT(t, T3_DIGITS0);
+                    if (!HAVE(sl) || cur[sl] + 4 > lens[sl]) return -1;
+                    memcpy(&v, blob + offs[sl] + cur[sl], 4);
+                    cur[sl] += 4;
+                    TAKE1(SLOT(t, T3_DZLEN), z);
+                    vv = v;
+                } else {
+                    if (!tm || (tm->type != T3_DIGITS &&
+                                tm->type != T3_DIGITS0))
+                        return -1;
+                    int d;
+                    TAKE1(SLOT(t, T3_DDELTA0), d);
+                    uint64_t tv = 0;
+                    for (int k = 0; k < tm->len; k++) {
+                        uint8_t c = out[tm->start + k];
+                        if (c < '0' || c > '9') return -1;
+                        tv = tv * 10 + (c - '0');
+                        if (tv > 0xFFFFFFFFull) return -1;
+                    }
+                    vv = tv + (uint64_t)d;
+                    z = tm->len;
+                }
+                char dec[24];
+                int ln = snprintf(dec, sizeof(dec), "%llu",
+                                  (unsigned long long)vv);
+                if (ln <= 0 || ln > z || z > 255) return -1;
+                if (w + z > out_cap) return -1;
+                memset(out + w, '0', z - ln);
+                memcpy(out + w + (z - ln), dec, ln);
+                w += z;
+                me->type = T3_DIGITS0;
+            } else {
+                return -1;  // unknown token type
+            }
+            me->start = (int32_t)start;
+            me->len = (int32_t)(w - start);
+            n_toks++;
+            t++;
+        }
+        s.name_tok0[n] = my_tok0;
+        s.name_ntok[n] = (int32_t)(n_toks - my_tok0);
+        s.name_start[n] = name_w0;
+        s.name_len[n] = (int32_t)(w - name_w0);
+        if (w + 1 > out_cap) return -1;
+        out[w++] = sep;
+    }
+    if (w != out_cap) return -1;  // must fill the declared size exactly
+    return 0;
+#undef SLOT
+#undef HAVE
+#undef TAKE1
+}
+
+}  // extern "C"

@@ -99,6 +99,7 @@ def _register_restypes(lib) -> None:
         lib.ransnx16_decode1.restype = ctypes.c_long
         lib.arith_decode_body.restype = ctypes.c_long
         lib.fqzcomp_decode.restype = ctypes.c_long
+        lib.tok3_assemble.restype = ctypes.c_long
         lib.format_matrix_rows.restype = ctypes.c_long
         lib.format_depth_rows.restype = ctypes.c_long
         lib.format_class_rows.restype = ctypes.c_long
@@ -349,6 +350,51 @@ def fqzcomp_decode(data, out_len: int) -> bytes | None:
     r = lib.fqzcomp_decode(
         _ptr(buf), ctypes.c_long(len(buf)), _ptr(out),
         ctypes.c_long(out_len),
+    )
+    return out.tobytes() if r == 0 else None
+
+
+def tok3_assemble(streams: dict, n_names: int, sep: int,
+                  out_len: int) -> bytes | None:
+    """Name assembly over already-decompressed tok3 streams in C;
+    ``streams`` maps (position, field) → raw bytes. None → fall back
+    to the pure-Python assembly, which owns every error message."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # n_names/out_len come from attacker-controlled varints; absurd
+    # values must fall back to the Python path's typed errors rather
+    # than raise OverflowError from ctypes or MemoryError from the
+    # allocation (every name contributes at least its separator, so
+    # valid inputs satisfy n_names <= out_len)
+    if not 0 <= n_names <= out_len or out_len > (1 << 40):
+        return None
+    offs = np.full(256 * 13, -1, dtype=np.int64)
+    lens = np.zeros(256 * 13, dtype=np.int64)
+    parts = []
+    off = 0
+    for (p, f), raw in streams.items():
+        if not 0 <= p < 256 or not 0 <= f < 13:
+            return None
+        slot = p * 13 + f
+        offs[slot] = off
+        lens[slot] = len(raw)
+        parts.append(raw)
+        off += len(raw)
+    blob = np.frombuffer(b"".join(parts), dtype=np.uint8) if parts \
+        else np.empty(0, dtype=np.uint8)
+    try:
+        out = np.empty(out_len, dtype=np.uint8)
+    except MemoryError:
+        # a huge declared size the host cannot hold: the Python
+        # assembly fails with its own typed error long before
+        # allocating this much
+        return None
+    r = lib.tok3_assemble(
+        _ptr(blob), offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_long(n_names), ctypes.c_ubyte(sep),
+        _ptr(out), ctypes.c_long(out_len),
     )
     return out.tobytes() if r == 0 else None
 

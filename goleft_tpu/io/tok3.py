@@ -263,7 +263,7 @@ def _decode(data: bytes, expected_len: int | None) -> bytes:
             f"tok3: stored size {ulen} != declared block size "
             f"{expected_len}"
         )
-    streams: dict[tuple[int, int], _Reader] = {}
+    raw_streams: dict[tuple[int, int], bytes] = {}
     while pos < len(buf):
         p = buf[pos]
         f = buf[pos + 1]
@@ -272,8 +272,16 @@ def _decode(data: bytes, expected_len: int | None) -> bytes:
         if pos + clen > len(buf):
             raise ValueError("tok3: truncated stream chunk")
         raw = _decompress_stream(bytes(buf[pos:pos + clen]), use_arith)
-        streams[(p, f)] = _Reader(raw)
+        raw_streams[(p, f)] = raw
         pos += clen
+
+    from . import native
+
+    fast = native.tok3_assemble(raw_streams, n_names, sep[0], ulen)
+    if fast is not None:
+        return fast
+
+    streams = {k: _Reader(v) for k, v in raw_streams.items()}
 
     def stream(p: int, f: int) -> _Reader:
         r = streams.get((p, f))

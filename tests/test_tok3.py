@@ -84,6 +84,7 @@ def test_stored_size_mismatch_rejected():
         tok3.decode(enc, 3)
 
 
+@pytest.mark.native_io
 def test_truncation_and_mutation_fuzz():
     rng = np.random.default_rng(1)
     names = _illumina_names(rng, 60)
@@ -103,6 +104,41 @@ def test_truncation_and_mutation_fuzz():
             pass  # loud, typed failure is the contract
 
 
+@pytest.mark.native_io
+def test_native_assembly_matches_python_bytes(monkeypatch):
+    # the C assembler (csrc/fastio.cpp::tok3_assemble) must produce
+    # byte-identical output to the pure-Python token machine,
+    # including DUP chains, zero-pad widths, delta overflow past u32,
+    # and huge-digit ALPHA degradation
+    from goleft_tpu.io import native
+
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(3)
+    batches = [
+        _illumina_names(rng, 800),
+        [f"s{i:06d}".encode() for i in range(990, 1400)],
+        [b"dup"] * 30 + [b"x9"] + [b"dup"] * 5,
+        [b"", b"read_001", b"read_001", b"0042", b"0043",
+         b"99999999999999999999", b"99999999999999999999",
+         b"q:0007", b"q:0008", b"q:10000", b"v009", b"v010"],
+        [b"n4294967290", b"n4294967295"],  # delta rides past u32
+    ]
+    for names in batches:
+        for ua in (False, True):
+            for nl in (False, True):
+                enc = tok3.encode(names, use_arith=ua, newline_sep=nl)
+                sep = b"\n" if nl else b"\x00"
+                want = sep.join(names) + sep
+                got_native = tok3.decode(enc, len(want))
+                with monkeypatch.context() as m:
+                    m.setattr(native, "tok3_assemble",
+                              lambda *a, **k: None)
+                    got_py = tok3.decode(enc, len(want))
+                assert got_native == got_py == want
+
+
+@pytest.mark.native_io
 def test_cram_block_integration():
     from goleft_tpu.io.cram import M_TOK3, _decompress
 
