@@ -178,7 +178,7 @@ def _cram31_codec_entry(quick: bool) -> dict:
 
 
 def _cram31_codec_entry_inner(quick: bool) -> dict:
-    from goleft_tpu.io import arith, native
+    from goleft_tpu.io import arith, native, tok3
     from goleft_tpu.io import fqzcomp as fqz
     from goleft_tpu.io import rans_nx16 as rx
 
@@ -193,12 +193,19 @@ def _cram31_codec_entry_inner(quick: bool) -> dict:
         quals += bytes(np.clip(np.cumsum(rng.integers(-2, 3, ln)) + 30,
                                0, 45).astype(np.uint8))
     quals = bytes(quals)
+    n_names = n // 35
+    names = [(f"A00111:123:HXXYZ:1:{1101 + int(rng.integers(0, 4))}:"
+              f"{int(rng.integers(1000, 30000))}:"
+              f"{int(rng.integers(1000, 30000))}").encode()
+             for _ in range(n_names)]
+    names_raw = b"\x00".join(names) + b"\x00"
     cases = [
         ("rans_nx16_o0", rx.encode(data, order=0), rx.decode, data),
         ("rans_nx16_o1", rx.encode(data, order=1), rx.decode, data),
         ("arith_o0", arith.encode(data, order=0), arith.decode, data),
         ("arith_o1", arith.encode(data, order=1), arith.decode, data),
         ("fqzcomp", fqz.encode(lens, quals), fqz.decode, quals),
+        ("tok3_names", tok3.encode(names), tok3.decode, names_raw),
     ]
     native_lib = native.get_lib() is not None
     # best-of-N after a warmup (the first call pays ctypes load); on
@@ -217,11 +224,11 @@ def _cram31_codec_entry_inner(quick: bool) -> dict:
         }
     return {
         "native_lib": native_lib,
-        "payload": "ACGT-skewed bytes / correlated quality strings",
+        "payload": "ACGT-skewed bytes / correlated quality strings / instrument-style read names (tok3)",
         "codecs": entries,
-        "note": "CRAM 3.1 block methods 5-7 via their product decode "
-                "entrypoints (csrc fast path, pure-Python fallback); "
-                "method 8 (names) rides the same two coders",
+        "note": "CRAM 3.1 block methods 5-8 via their product decode "
+                "entrypoints (csrc fast path incl. the tok3 name "
+                "assembler, pure-Python fallback)",
     }
 
 
