@@ -659,6 +659,81 @@ class Encoding:
         return write_itf8(self.codec) + write_itf8(len(body)) + body
 
 
+class BitWriter:
+    """MSB-first writer (the BitReader's exact inverse) for the
+    fixture writer's core-bit series."""
+
+    __slots__ = ("out", "acc", "nbits")
+
+    def __init__(self) -> None:
+        self.out = bytearray()
+        self.acc = 0
+        self.nbits = 0
+
+    def write(self, value: int, n: int) -> None:
+        for i in range(n - 1, -1, -1):
+            self.acc = (self.acc << 1) | ((value >> i) & 1)
+            self.nbits += 1
+            if self.nbits == 8:
+                self.out.append(self.acc)
+                self.acc = 0
+                self.nbits = 0
+
+    def finish(self) -> bytes:
+        if self.nbits:
+            self.out.append(self.acc << (8 - self.nbits))
+            self.acc = 0
+            self.nbits = 0
+        return bytes(self.out)
+
+
+def _huffman_lengths(values) -> tuple[list[int], list[int]]:
+    """Canonical-Huffman code lengths for a value multiset (ascending
+    alphabet; single-symbol alphabets get the spec's 0-bit code)."""
+    import heapq
+    import itertools
+    from collections import Counter
+
+    freq = Counter(values)
+    if len(freq) == 1:
+        return [next(iter(freq))], [0]
+    cnt = itertools.count()
+    heap = [(f, next(cnt), s) for s, f in freq.items()]
+    heapq.heapify(heap)
+    while len(heap) > 1:
+        f1, _, n1 = heapq.heappop(heap)
+        f2, _, n2 = heapq.heappop(heap)
+        heapq.heappush(heap, (f1 + f2, next(cnt), (n1, n2)))
+    lengths: dict[int, int] = {}
+
+    def walk(node, depth):
+        if isinstance(node, tuple):
+            walk(node[0], depth + 1)
+            walk(node[1], depth + 1)
+        else:
+            lengths[node] = depth
+
+    walk(heap[0][2], 0)
+    alphabet = sorted(lengths)
+    return alphabet, [lengths[s] for s in alphabet]
+
+
+def _canonical_codes(alphabet, lengths) -> dict[int, tuple[int, int]]:
+    """symbol → (code, length), assigned exactly like the decoder's
+    _build_huffman (sorted by (length, symbol))."""
+    order = sorted(range(len(alphabet)),
+                   key=lambda i: (lengths[i], alphabet[i]))
+    codes: dict[int, tuple[int, int]] = {}
+    code = 0
+    prev = lengths[order[0]]
+    for i in order:
+        code <<= lengths[i] - prev
+        prev = lengths[i]
+        codes[alphabet[i]] = (code, lengths[i])
+        code += 1
+    return codes
+
+
 class _ExternalStream:
     __slots__ = ("data", "pos")
 
@@ -721,20 +796,13 @@ class Decoder:
             self.hf_single = alphabet[0]
             return
         self.hf_single = None
-        # canonical codes: sort by (code length, symbol value) — the
-        # spec/htslib tie-break; appearance order would swap codes for
-        # equal-length symbols listed out of order
-        order = sorted(range(len(alphabet)),
-                       key=lambda i: (lengths[i], alphabet[i]))
-        code = 0
-        prev_len = lengths[order[0]]
-        table = {}
-        for i in order:
-            code <<= lengths[i] - prev_len
-            prev_len = lengths[i]
-            table[(lengths[i], code)] = alphabet[i]
-            code += 1
-        self.hf_table = table
+        # canonical assignment shared with the writer
+        # (_canonical_codes): sort by (code length, symbol value) —
+        # the spec/htslib tie-break; appearance order would swap
+        # codes for equal-length symbols listed out of order
+        codes = _canonical_codes(alphabet, lengths)
+        self.hf_table = {(ln, code): sym
+                         for sym, (code, ln) in codes.items()}
         self.hf_maxlen = max(lengths)
 
     def read_int(self) -> int:
@@ -1517,7 +1585,8 @@ class CramWriter:
                  ref_lens: list[int], records_per_container: int = 10000,
                  block_method: int = M_GZIP, ap_delta: bool = True,
                  rans_order: int = 0, minor: int = 0, major: int = 3,
-                 series_methods: dict[str, int] | None = None):
+                 series_methods: dict[str, int] | None = None,
+                 core_series: tuple = ()):
         if major not in (2, 3):
             raise ValueError("cram: writer supports major 2 and 3")
         self._fh = fh
@@ -1547,6 +1616,15 @@ class CramWriter:
             raise ValueError(
                 f"cram: no encoder for series {k!r} with method {m} "
                 "(tok3 is RN-only, fqzcomp is QS-only)")
+        # core-bit series: integer series coded as canonical HUFFMAN
+        # bits in the CORE block (the layout real htslib CRAMs use for
+        # BF/TL/MQ) instead of EXTERNAL ITF8 streams
+        self._core_series = tuple(core_series)
+        for k in self._core_series:
+            if k not in ("BF", "RL", "MQ"):
+                raise ValueError(
+                    "cram: core_series supports BF/RL/MQ (the integer "
+                    "series this fixture writer emits per record)")
         self._pending: list[dict] = []
         self._counter = 0
         self._offsets: list[tuple[int, int, int, int, int]] = []
@@ -1691,8 +1769,15 @@ class CramWriter:
             rn_included=True, ap_delta=self._ap_delta, ref_required=False,
             tag_dict=[[]],
         )
+        huff_codes: dict[str, dict[int, tuple[int, int]]] = {}
         for key, cid in ids.items():
-            if key == "RN":
+            if key in self._core_series and ints[key]:
+                alphabet, lengths = _huffman_lengths(ints[key])
+                comp.encodings[key] = Encoding(
+                    E_HUFFMAN, {"alphabet": alphabet,
+                                "lengths": lengths})
+                huff_codes[key] = _canonical_codes(alphabet, lengths)
+            elif key == "RN":
                 comp.encodings[key] = Encoding(
                     E_BYTE_ARRAY_STOP, {"stop": rn_stop, "id": cid})
             elif key in ("SC", "IN"):
@@ -1701,8 +1786,27 @@ class CramWriter:
             else:
                 comp.encodings[key] = Encoding(E_EXTERNAL, {"id": cid})
 
+        # core bits, in the exact order decode_slice consumes them:
+        # BF then RL per record, MQ only for mapped records
+        core_bytes = b""
+        if huff_codes:
+            bw = BitWriter()
+            mq_vals = iter(ints["MQ"])
+            for i, r in enumerate(recs):
+                per_rec = [("BF", ints["BF"][i]), ("RL", ints["RL"][i])]
+                if not (r["flag"] & 0x4):
+                    per_rec.append(("MQ", next(mq_vals)))
+                for key, v in per_rec:
+                    codes = huff_codes.get(key)
+                    if codes is not None:
+                        code, ln = codes[v]
+                        bw.write(code, ln)
+            core_bytes = bw.finish()
+
         ext_payload: dict[int, bytes] = {}
         for key, cid in ids.items():
+            if key in huff_codes:
+                continue  # series lives in the core block
             if key == "RN":
                 ext_payload[cid] = bytes(names)
             elif key == "QS":
@@ -1724,7 +1828,8 @@ class CramWriter:
         )
         blocks = write_block(M_RAW, CT_SLICE_HEADER, 0,
                              sl.serialize(v2=self._v2), v2=self._v2)
-        blocks += write_block(M_RAW, CT_CORE, 0, b"", v2=self._v2)
+        blocks += write_block(M_RAW, CT_CORE, 0, core_bytes,
+                              v2=self._v2)
         for cid in used:
             key = key_of[cid]
             method = self._series_methods.get(key, self._method)

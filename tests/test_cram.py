@@ -214,6 +214,69 @@ def test_cram_31_specialized_series_codecs_twin(tmp_path):
             getattr(got, f), getattr(want, f), err_msg=f)
 
 
+@pytest.mark.parametrize("method", [cram.M_GZIP, cram.M_RANSNX16])
+def test_cram_core_bit_huffman_series_twin(tmp_path, method):
+    # BF/RL/MQ coded as canonical-HUFFMAN bits in the CORE block (the
+    # layout real htslib CRAMs use) instead of EXTERNAL ITF8 streams:
+    # exercises the BitReader + multi-symbol HUFFMAN integration the
+    # isolated codec vectors cannot
+    from goleft_tpu.io.bam import parse_cigar
+
+    rng = np.random.default_rng(33)
+    reads = _twin_reads(rng, n=1500)
+    bam_p = str(tmp_path / "t.bam")
+    cram_p = str(tmp_path / "tc.cram")
+    write_bam(bam_p, reads, ref_names=("chr1", "chr2"),
+              ref_lens=(120_000, 50_000))
+    hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
+    with open(cram_p, "wb") as fh:
+        with CramWriter(fh, hdr, ["chr1", "chr2"], [120_000, 50_000],
+                        records_per_container=300, block_method=method,
+                        minor=1 if method == cram.M_RANSNX16 else 0,
+                        rans_order=1,
+                        core_series=("BF", "RL", "MQ")) as w:
+            for i, (tid, pos, cig, mq, fl) in enumerate(reads):
+                w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
+                               flag=fl, name=f"r{i}")
+    import mmap
+
+    with open(cram_p, "rb") as fh:
+        buf = memoryview(mmap.mmap(fh.fileno(), 0,
+                                   access=mmap.ACCESS_READ))
+    cf = CramFile(buf)
+    # the comp header really declares HUFFMAN and the core block
+    # really carries bits
+    saw_huffman = saw_core_bits = False
+    for hdr_c, body in cf._iter_containers():
+        pos = body
+        end = body + hdr_c.length
+        blk, pos = cram.read_block(buf, pos)
+        comp = cram.CompressionHeader.parse(blk.data)
+        enc = comp.encodings.get("BF")
+        if enc is not None and enc.codec == cram.E_HUFFMAN:
+            saw_huffman = True
+        while pos < end:
+            b, pos = cram.read_block(buf, pos)
+            if b.content_type == cram.CT_CORE and len(b.data):
+                saw_core_bits = True
+    assert saw_huffman and saw_core_bits
+
+    want = BamReader.from_file(bam_p).read_columns()
+    got = cf.read_columns()
+    for f in ("tid", "pos", "end", "mapq", "flag", "read_len",
+              "seg_start", "seg_end", "seg_read"):
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f), err_msg=f)
+
+
+def test_core_series_rejects_unsupported_keys():
+    import io as _io
+
+    with pytest.raises(ValueError, match="core_series"):
+        CramWriter(_io.BytesIO(), "@HD\tVN:1.6\n", ["c"], [100],
+                   core_series=("AP",))
+
+
 def test_writer_rejects_undecodable_method_combos(tmp_path):
     # a (series, method) pair without a real encoder must fail at
     # construction, not write an undecodable file
