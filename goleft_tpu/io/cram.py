@@ -1586,7 +1586,7 @@ class CramWriter:
                  block_method: int = M_GZIP, ap_delta: bool = True,
                  rans_order: int = 0, minor: int = 0, major: int = 3,
                  series_methods: dict[str, int] | None = None,
-                 core_series: tuple = ()):
+                 core_series: tuple = (), with_tags: bool = False):
         if major not in (2, 3):
             raise ValueError("cram: writer supports major 2 and 3")
         self._fh = fh
@@ -1625,6 +1625,7 @@ class CramWriter:
                 raise ValueError(
                     "cram: core_series supports BF/RL/MQ (the integer "
                     "series this fixture writer emits per record)")
+        self._with_tags = with_tags
         self._pending: list[dict] = []
         self._counter = 0
         self._offsets: list[tuple[int, int, int, int, int]] = []
@@ -1769,6 +1770,20 @@ class CramWriter:
             rn_included=True, ap_delta=self._ap_delta, ref_required=False,
             tag_dict=[[]],
         )
+        tag_cid = max(ids.values()) + 1  # past every series block id
+        if self._with_tags:
+            # one NM:C tag per record through BYTE_ARRAY_LEN — the
+            # nested-encoding shape real htslib CRAMs use for tag
+            # values: length from a 0-bit single-symbol HUFFMAN (every
+            # 'C' value is 1 byte), bytes from their own EXTERNAL
+            # block
+            comp.tag_dict = [[("NM", "C")]]
+            key = (ord("N") << 16) | (ord("M") << 8) | ord("C")
+            comp.tag_encodings[key] = Encoding(E_BYTE_ARRAY_LEN, {
+                "len_enc": Encoding(E_HUFFMAN, {"alphabet": [1],
+                                                "lengths": [0]}),
+                "val_enc": Encoding(E_EXTERNAL, {"id": tag_cid}),
+            })
         huff_codes: dict[str, dict[int, tuple[int, int]]] = {}
         for key, cid in ids.items():
             if key in self._core_series and ints[key]:
@@ -1819,6 +1834,11 @@ class CramWriter:
                 ext_payload[cid] = b"".join(
                     write_itf8(v) for v in ints[key]
                 )
+        if self._with_tags:
+            # stand-in per-record NM value (any byte works — the
+            # decoder consumes tag values for stream alignment only)
+            ext_payload[tag_cid] = bytes(
+                min(len(r["cigar"]), 255) for r in recs)
         used = [cid for cid, payload in ext_payload.items() if payload]
         key_of = {cid: key for key, cid in ids.items()}
 
@@ -1831,7 +1851,7 @@ class CramWriter:
         blocks += write_block(M_RAW, CT_CORE, 0, core_bytes,
                               v2=self._v2)
         for cid in used:
-            key = key_of[cid]
+            key = key_of.get(cid)  # None for the tag-value block
             method = self._series_methods.get(key, self._method)
             payload = ext_payload[cid]
             if method == M_TOK3 and key == "RN":

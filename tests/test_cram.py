@@ -9,6 +9,7 @@ decoder is validated against this repo's own order-0 encoder.
 """
 
 import io
+import os
 
 import numpy as np
 import pytest
@@ -155,6 +156,29 @@ def test_cram_v2_matches_bam_twin_columns(tmp_path, minor):
     np.testing.assert_array_equal(cols.pos, want0.pos)
 
 
+def _scan_blocks(cram_p):
+    """(comp headers, all blocks) read straight off the file bytes."""
+    import mmap
+
+    with open(cram_p, "rb") as fh:
+        buf = memoryview(mmap.mmap(fh.fileno(), 0,
+                                   access=mmap.ACCESS_READ))
+    cf = CramFile(buf, crai_path=cram_p + ".crai"
+                  if os.path.exists(cram_p + ".crai") else None)
+    comps, blocks = [], []
+    for hdr_c, body in cf._iter_containers():
+        pos = body
+        end = body + hdr_c.length
+        first = True
+        while pos < end:
+            blk, pos = cram.read_block(buf, pos)
+            blocks.append(blk)
+            if first and blk.content_type == cram.CT_COMP_HEADER:
+                comps.append(cram.CompressionHeader.parse(blk.data))
+            first = False
+    return cf, comps, blocks
+
+
 def test_cram_31_specialized_series_codecs_twin(tmp_path):
     # the htslib 3.1 shape: read names through the tokeniser (method
     # 8), per-record qualities through fqzcomp (method 7), everything
@@ -189,19 +213,8 @@ def test_cram_31_specialized_series_codecs_twin(tmp_path):
         w.write_crai(cram_p + ".crai")
 
     # the blocks really carry methods 7 and 8
-    import mmap
-
-    with open(cram_p, "rb") as fh:
-        buf = memoryview(mmap.mmap(fh.fileno(), 0,
-                                   access=mmap.ACCESS_READ))
-    cf = CramFile(buf, crai_path=cram_p + ".crai")
-    methods = set()
-    for hdr_c, body in cf._iter_containers():
-        pos = body
-        end = body + hdr_c.length
-        while pos < end:
-            blk, pos = cram.read_block(buf, pos)
-            methods.add(blk.method)
+    cf, _, blocks = _scan_blocks(cram_p)
+    methods = {b.method for b in blocks}
     assert cram.M_TOK3 in methods and cram.M_FQZCOMP in methods
     assert cram.M_RANSNX16 in methods
 
@@ -238,28 +251,49 @@ def test_cram_core_bit_huffman_series_twin(tmp_path, method):
             for i, (tid, pos, cig, mq, fl) in enumerate(reads):
                 w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
                                flag=fl, name=f"r{i}")
-    import mmap
-
-    with open(cram_p, "rb") as fh:
-        buf = memoryview(mmap.mmap(fh.fileno(), 0,
-                                   access=mmap.ACCESS_READ))
-    cf = CramFile(buf)
     # the comp header really declares HUFFMAN and the core block
     # really carries bits
-    saw_huffman = saw_core_bits = False
-    for hdr_c, body in cf._iter_containers():
-        pos = body
-        end = body + hdr_c.length
-        blk, pos = cram.read_block(buf, pos)
-        comp = cram.CompressionHeader.parse(blk.data)
-        enc = comp.encodings.get("BF")
-        if enc is not None and enc.codec == cram.E_HUFFMAN:
-            saw_huffman = True
-        while pos < end:
-            b, pos = cram.read_block(buf, pos)
-            if b.content_type == cram.CT_CORE and len(b.data):
-                saw_core_bits = True
-    assert saw_huffman and saw_core_bits
+    cf, comps, blocks = _scan_blocks(cram_p)
+    assert any(c.encodings.get("BF") is not None
+               and c.encodings["BF"].codec == cram.E_HUFFMAN
+               for c in comps)
+    assert any(b.content_type == cram.CT_CORE and len(b.data)
+               for b in blocks)
+
+    want = BamReader.from_file(bam_p).read_columns()
+    got = cf.read_columns()
+    for f in ("tid", "pos", "end", "mapq", "flag", "read_len",
+              "seg_start", "seg_end", "seg_read"):
+        np.testing.assert_array_equal(
+            getattr(got, f), getattr(want, f), err_msg=f)
+
+
+def test_cram_tag_values_via_byte_array_len_twin(tmp_path):
+    # per-record NM:C tags through BYTE_ARRAY_LEN (0-bit HUFFMAN
+    # length + EXTERNAL bytes) — the nested-encoding shape htslib
+    # uses for tag values; the decoder must consume them for stream
+    # alignment without disturbing the columns
+    from goleft_tpu.io.bam import parse_cigar
+
+    rng = np.random.default_rng(35)
+    reads = _twin_reads(rng, n=1000)
+    bam_p = str(tmp_path / "t.bam")
+    cram_p = str(tmp_path / "tt.cram")
+    write_bam(bam_p, reads, ref_names=("chr1", "chr2"),
+              ref_lens=(120_000, 50_000))
+    hdr = "@HD\tVN:1.6\tSO:coordinate\n@RG\tID:rg1\tSM:sampleA\n"
+    with open(cram_p, "wb") as fh:
+        with CramWriter(fh, hdr, ["chr1", "chr2"], [120_000, 50_000],
+                        records_per_container=300, with_tags=True,
+                        core_series=("BF", "RL", "MQ")) as w:
+            for i, (tid, pos, cig, mq, fl) in enumerate(reads):
+                w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
+                               flag=fl, name=f"r{i}")
+    # the comp header really declares the tag line + BYTE_ARRAY_LEN
+    cf, comps, _ = _scan_blocks(cram_p)
+    assert comps[0].tag_dict == [[("NM", "C")]]
+    key = (ord("N") << 16) | (ord("M") << 8) | ord("C")
+    assert comps[0].tag_encodings[key].codec == cram.E_BYTE_ARRAY_LEN
 
     want = BamReader.from_file(bam_p).read_columns()
     got = cf.read_columns()
