@@ -6,6 +6,8 @@ library): decode cohort reads per shard (lazy native io), batch the
 windowed depth matrix on device (cohortdepth machinery), run the batched
 EM copy-number caller with the 30kb streaming merge, and emit
   chrom  start  end  sample  CN  log2FC
+plus, optionally, the merged calls as VCF 4.2 (--vcf) and the cn.mops
+posterior-CN / information-gain tracks (--mops-out / --gain-out).
 """
 
 from __future__ import annotations
@@ -46,8 +48,25 @@ def collect_matrix(blocks, n_win: int, n_samples: int):
 
 def run_cnv(bams, reference=None, fai=None, window: int = 1000,
             mapq: int = 1, chrom: str = "", processes: int = 8,
-            out=None, matrix_out=None, engine: str = "auto"):
+            out=None, matrix_out=None, engine: str = "auto",
+            vcf_out=None, mops_out=None, gain_out=None):
     out = out or sys.stdout
+    contig_lengths = None
+    if vcf_out:
+        # read the .fai up front: a missing/unreadable index must fail
+        # instantly, not after the whole cohort decode has run
+        # (cohortdepth auto-generates it from the reference, so do the
+        # same here before reading)
+        import os
+
+        from ..io.fai import read_fai, write_fai
+
+        fai_path = fai or (reference + ".fai" if reference else None)
+        if fai_path:
+            if not os.path.exists(fai_path) and reference:
+                write_fai(reference)
+            contig_lengths = {r.name: r.length
+                              for r in read_fai(fai_path)}
     names, n_win, blocks = cohort_matrix_blocks(
         bams, reference=reference, fai=fai, window=window, mapq=mapq,
         chrom=chrom, processes=processes, engine=engine,
@@ -57,7 +76,9 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
     chroms, starts, ends, depths = collect_matrix(blocks, n_win,
                                                   len(names))
     return call_cnvs(chroms, starts, ends, depths, names, out=out,
-                     matrix_out=matrix_out)
+                     matrix_out=matrix_out, vcf_out=vcf_out,
+                     mops_out=mops_out, gain_out=gain_out,
+                     contig_lengths=contig_lengths)
 
 
 def main(argv=None):
@@ -74,6 +95,13 @@ def main(argv=None):
     p.add_argument("-p", "--processes", type=int, default=8)
     p.add_argument("--matrix-out", default=None,
                    help="also write the per-window CN matrix here")
+    p.add_argument("--vcf", default=None,
+                   help="also write merged CNV calls as VCF 4.2 "
+                        "(<DEL>/<DUP> symbolic alleles, GT:CN:L2FC)")
+    p.add_argument("--mops-out", default=None,
+                   help="write the cn.mops posterior-CN matrix here")
+    p.add_argument("--gain-out", default=None,
+                   help="write per-window cn.mops information gain here")
     p.add_argument("--engine", choices=("auto", "hybrid", "device"),
                    default="auto",
                    help="cohort matrix engine (see cohortdepth --engine)")
@@ -81,7 +109,8 @@ def main(argv=None):
     a = p.parse_args(argv)
     run_cnv(a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
             mapq=a.mapq, chrom=a.chrom, processes=a.processes,
-            matrix_out=a.matrix_out, engine=a.engine)
+            matrix_out=a.matrix_out, engine=a.engine, vcf_out=a.vcf,
+            mops_out=a.mops_out, gain_out=a.gain_out)
 
 
 if __name__ == "__main__":

@@ -121,13 +121,74 @@ def _batched_em(depths: np.ndarray, med=None, medmed=None,
 
 
 def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
-                matrix_out: str | None = None):
+                matrix_out: str | None = None,
+                vcf_out: str | None = None,
+                mops_out: str | None = None,
+                gain_out: str | None = None):
     return call_cnvs(*read_matrix(matrix_path), out=out,
-                     normalize=normalize, matrix_out=matrix_out)
+                     normalize=normalize, matrix_out=matrix_out,
+                     vcf_out=vcf_out, mops_out=mops_out,
+                     gain_out=gain_out)
+
+
+def _mops_outputs(chroms, starts, ends, depths, samples, med, medmed,
+                  dtype, mops_out: str | None, gain_out: str | None):
+    """cn.mops posterior outputs over the same normalized matrix the EM
+    consumes: per-window posterior CN matrix (argmax over the α_ik
+    posterior, models/mops.py) and/or per-window information gain
+    (windows where the cohort deviates from all-CN2 — the cn.mops
+    segmentation statistic, mops.go:126-137). Streams in EM_CHUNK
+    batches with the ragged tail padded to the chunk shape (ones, like
+    _batched_em) so mops_batch compiles exactly once; this optional
+    pass runs the matrix through the device a second time, separate
+    from the EM's double-buffered loop."""
+    from ..models import mops
+
+    fhs = {}
+    if mops_out:
+        fhs["cn"] = xopen(mops_out, "w")
+        fhs["cn"].write("#chrom\tstart\tend\t" + "\t".join(samples)
+                        + "\n")
+    if gain_out:
+        fhs["gain"] = xopen(gain_out, "w")
+        fhs["gain"].write("#chrom\tstart\tend\tgain\n")
+    try:
+        B = len(depths)
+        for lo in range(0, B, EM_CHUNK):
+            chunk = _norm_chunk(depths[lo : lo + EM_CHUNK], med, medmed,
+                                dtype)
+            n = len(chunk)
+            if B > EM_CHUNK and n < EM_CHUNK:
+                pad = np.ones((EM_CHUNK - n, depths.shape[1]),
+                              chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
+            r = mops.mops_batch(chunk)
+            if "cn" in fhs:
+                cn = np.asarray(mops.posterior_cn(r["aik"]))[:n]
+                for i in range(len(cn)):
+                    b = lo + i
+                    fhs["cn"].write(
+                        f"{chroms[b]}\t{starts[b]}\t{ends[b]}\t"
+                        + "\t".join(str(int(c)) for c in cn[i]) + "\n"
+                    )
+            if "gain" in fhs:
+                g = np.asarray(mops.information_gain(r["aik"]))[:n]
+                for i in range(len(g)):
+                    b = lo + i
+                    fhs["gain"].write(
+                        f"{chroms[b]}\t{starts[b]}\t{ends[b]}\t"
+                        f"{float(g[i]):.4f}\n"
+                    )
+    finally:
+        for fh in fhs.values():
+            fh.close()
 
 
 def call_cnvs(chroms, starts, ends, depths, samples, out=None,
-              normalize: bool = True, matrix_out: str | None = None):
+              normalize: bool = True, matrix_out: str | None = None,
+              vcf_out: str | None = None, mops_out: str | None = None,
+              gain_out: str | None = None,
+              contig_lengths: dict | None = None):
     """EM copy-number calls from in-memory matrix arrays (the device
     pipeline's native feed — ``cnv`` passes cohortdepth's blocks here
     directly, no text round-trip)."""
@@ -150,6 +211,9 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
         med[med == 0] = 1.0
         medmed = float(np.median(med))
 
+    if mops_out or gain_out:
+        _mops_outputs(chroms, starts, ends, depths, samples, med,
+                      medmed, dt, mops_out, gain_out)
     lambdas, cns = _batched_em(depths, med, medmed, dt,
                                want_cn=matrix_out is not None)
     if matrix_out:
@@ -192,6 +256,11 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
     emit(cache.clear(None), cur)
     for chrom, s, e, sample, cn, fc in results:
         out.write(f"{chrom}\t{s}\t{e}\t{sample}\t{cn}\t{fc:.3f}\n")
+    if vcf_out:
+        from ..utils.vcf import write_cnv_vcf
+
+        write_cnv_vcf(vcf_out, results, samples,
+                      contig_lengths=contig_lengths)
     return results
 
 
@@ -204,10 +273,18 @@ def main(argv=None):
                    help="input is already normalized")
     p.add_argument("--matrix-out", default=None,
                    help="also write the per-window CN matrix here")
+    p.add_argument("--vcf", default=None,
+                   help="also write merged CNV calls as VCF 4.2 "
+                        "(<DEL>/<DUP> symbolic alleles, GT:CN:L2FC)")
+    p.add_argument("--mops-out", default=None,
+                   help="write the cn.mops posterior-CN matrix here")
+    p.add_argument("--gain-out", default=None,
+                   help="write per-window cn.mops information gain here")
     p.add_argument("matrix", help="depthwed-style matrix (tsv/gz)")
     a = p.parse_args(argv)
     run_emdepth(a.matrix, normalize=not a.no_normalize,
-                matrix_out=a.matrix_out)
+                matrix_out=a.matrix_out, vcf_out=a.vcf,
+                mops_out=a.mops_out, gain_out=a.gain_out)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,120 @@
+"""CNV calls → VCF 4.2.
+
+The reference stops at tab text for its CNV prototypes (emdepth emits
+`chrom start end sample CN` structs, dcnv a normalized bed); downstream
+tooling (truvari, bcftools, IGV) speaks VCF, so the productized `cnv` /
+`emdepth` commands can also emit symbolic-allele records
+(`<DEL>`/`<DUP>` with END/SVLEN INFO and per-sample GT:CN:L2FC), one
+record per distinct (chrom, start, end, svtype) event with every cohort
+sample genotyped (non-carriers 0/0:2:.).
+
+Reference parity note: no VCF writer exists in /root/reference — this is
+a capability extension, mapped from emdepth's CNV struct
+(emdepth/emdepth.go:330-346: chrom/start/end/sample/CN/log2FC).
+"""
+
+from __future__ import annotations
+
+from ..utils.xopen import xopen
+
+_HEADER_LINES = [
+    "##ALT=<ID=DEL,Description=\"Deletion relative to the cohort "
+    "median depth\">",
+    "##ALT=<ID=DUP,Description=\"Duplication relative to the cohort "
+    "median depth\">",
+    "##INFO=<ID=SVTYPE,Number=1,Type=String,Description=\"CNV type "
+    "(DEL or DUP)\">",
+    "##INFO=<ID=END,Number=1,Type=Integer,Description=\"End of the "
+    "event (1-based inclusive)\">",
+    "##INFO=<ID=SVLEN,Number=1,Type=Integer,Description=\"Signed event "
+    "length (negative for DEL)\">",
+    "##INFO=<ID=NCARRIER,Number=1,Type=Integer,Description=\"Samples "
+    "carrying this event\">",
+    "##FORMAT=<ID=GT,Number=1,Type=String,Description=\"Genotype "
+    "(0/1 het, 1/1 hom-del at CN 0; 0/0 non-carrier)\">",
+    "##FORMAT=<ID=CN,Number=1,Type=Integer,Description=\"Median EM "
+    "copy number over the event's windows (2 on a carrier marks a "
+    "mixed-direction merged run; see L2FC)\">",
+    "##FORMAT=<ID=L2FC,Number=1,Type=Float,Description=\"Mean log2 "
+    "fold change over the event's windows\">",
+]
+
+
+def _gt(cn: int) -> str:
+    if cn == 0:
+        return "1/1"
+    return "0/1"  # het del (CN1) and any gain both carry one alt allele
+
+
+def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
+                  source: str = "goleft-tpu cnv"):
+    """Write CNV ``calls`` as a multi-sample VCF.
+
+    ``calls``: iterable of (chrom, start, end, sample, cn, log2fc) —
+    exactly what :func:`commands.emdepth_cmd.call_cnvs` returns, with
+    0-based half-open [start, end) coordinates. ``samples`` fixes the
+    column order (every cohort sample appears, carrier or not).
+    ``contig_lengths``: optional {chrom: length} for ##contig headers;
+    chroms seen only in calls still get an ID-only ##contig line.
+    Returns the number of VCF records written.
+    """
+    samples = list(samples)
+    col = {s: i for i, s in enumerate(samples)}
+    # group per-sample calls into events keyed by locus + direction
+    events: dict[tuple, list] = {}
+    chrom_order: list[str] = []
+    for chrom, start, end, sample, cn, fc in calls:
+        if chrom not in chrom_order:
+            chrom_order.append(chrom)
+        # the 30kb merge can blend a sample's DEL and DUP runs into one
+        # call whose MEDIAN CN rounds to 2 (models/emdepth.py Cache) —
+        # classify those by the fold-change sign instead of mislabeling
+        # a depth loss as <DUP>
+        if cn < 2 or (cn == 2 and fc < 0):
+            svtype = "DEL"
+        else:
+            svtype = "DUP"
+        events.setdefault((chrom, int(start), int(end), svtype),
+                          []).append((sample, int(cn), float(fc)))
+
+    own = isinstance(path_or_fh, str)
+    fh = xopen(path_or_fh, "w") if own else path_or_fh
+    try:
+        fh.write("##fileformat=VCFv4.2\n")
+        fh.write(f"##source={source}\n")
+        contigs = dict(contig_lengths or {})
+        for c in chrom_order:
+            contigs.setdefault(c, None)
+        for c, ln in contigs.items():
+            if ln:
+                fh.write(f"##contig=<ID={c},length={int(ln)}>\n")
+            else:
+                fh.write(f"##contig=<ID={c}>\n")
+        for line in _HEADER_LINES:
+            fh.write(line + "\n")
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\t"
+                 "FORMAT\t" + "\t".join(samples) + "\n")
+        n = 0
+        order = {c: i for i, c in enumerate(chrom_order)}
+        for key in sorted(events, key=lambda k: (order[k[0]], k[1],
+                                                 k[2], k[3])):
+            chrom, start, end, svtype = key
+            carriers = events[key]
+            fields = ["0/0:2:."] * len(samples)
+            for sample, cn, fc in carriers:
+                fields[col[sample]] = f"{_gt(cn)}:{cn}:{fc:.3f}"
+            svlen = end - start
+            if svtype == "DEL":
+                svlen = -svlen
+            fh.write(
+                f"{chrom}\t{start + 1}\t"
+                f"{svtype}_{chrom}_{start + 1}_{end}\tN\t<{svtype}>\t"
+                f".\tPASS\tSVTYPE={svtype};END={end};SVLEN={svlen};"
+                f"NCARRIER={len(carriers)}\tGT:CN:L2FC\t"
+                + "\t".join(fields) + "\n"
+            )
+            n += 1
+        return n
+    finally:
+        if own:
+            fh.close()

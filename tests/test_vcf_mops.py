@@ -1,0 +1,199 @@
+"""VCF emission and cn.mops posterior outputs on the CNV stack.
+
+The reference stops at tab text for its CNV prototypes; the productized
+commands also emit VCF 4.2 (<DEL>/<DUP> symbolic alleles) and the
+cn.mops posterior CN / information-gain tracks (mops.go:126-161)."""
+
+import io
+
+import numpy as np
+
+from goleft_tpu.commands.emdepth_cmd import call_cnvs
+from goleft_tpu.utils.vcf import write_cnv_vcf
+
+
+def _parse_vcf(text: str):
+    headers = [l for l in text.splitlines() if l.startswith("##")]
+    cols = [l for l in text.splitlines() if l.startswith("#CHROM")]
+    recs = [l.split("\t") for l in text.splitlines()
+            if l and not l.startswith("#")]
+    return headers, cols[0].split("\t"), recs
+
+
+def test_vcf_writer_grouping_and_genotypes(tmp_path):
+    samples = ["a", "b", "c"]
+    calls = [
+        # same DEL locus carried by two samples -> one record
+        ("chr1", 1000, 3000, "a", 1, -0.9),
+        ("chr1", 1000, 3000, "c", 0, -3.2),
+        # a DUP elsewhere
+        ("chr1", 9000, 12000, "b", 3, 0.55),
+        # second chromosome, later in input order
+        ("chr2", 500, 700, "b", 1, -1.0),
+    ]
+    path = str(tmp_path / "x.vcf")
+    n = write_cnv_vcf(path, calls, samples,
+                      contig_lengths={"chr1": 50_000, "chr2": 20_000})
+    assert n == 3
+    headers, cols, recs = _parse_vcf(open(path).read())
+    assert "##fileformat=VCFv4.2" in headers
+    assert "##contig=<ID=chr1,length=50000>" in headers
+    assert cols == ["#CHROM", "POS", "ID", "REF", "ALT", "QUAL",
+                    "FILTER", "INFO", "FORMAT", "a", "b", "c"]
+    assert len(recs) == 3
+    r0, r1, r2 = recs
+    # merged DEL: 1-based POS, negative SVLEN, two carriers
+    assert (r0[0], r0[1], r0[4]) == ("chr1", "1001", "<DEL>")
+    assert r0[2] == "DEL_chr1_1001_3000"
+    assert r0[7] == "SVTYPE=DEL;END=3000;SVLEN=-2000;NCARRIER=2"
+    assert r0[8] == "GT:CN:L2FC"
+    assert r0[9] == "0/1:1:-0.900"    # het del
+    assert r0[10] == "0/0:2:."        # non-carrier
+    assert r0[11] == "1/1:0:-3.200"   # hom del
+    # DUP record
+    assert (r1[4], r1[10]) == ("<DUP>", "0/1:3:0.550")
+    assert "SVLEN=3000" in r1[7]
+    # chrom order preserved from input
+    assert r2[0] == "chr2"
+
+
+def test_vcf_median_cn2_classified_by_fold_change(tmp_path):
+    """A merged run whose median CN rounds to 2 (mixed DEL+DUP windows
+    within the 30kb gap) is classified by its fold-change sign, never
+    emitted as a <DUP> that is really a depth loss."""
+    calls = [
+        ("chr1", 100, 300, "a", 2, -1.1),  # net loss
+        ("chr1", 900, 950, "a", 2, 0.8),   # net gain
+    ]
+    path = str(tmp_path / "m.vcf")
+    write_cnv_vcf(path, calls, ["a"])
+    _, _, recs = _parse_vcf(open(path).read())
+    assert [r[4] for r in recs] == ["<DEL>", "<DUP>"]
+    assert recs[0][9] == "0/1:2:-1.100"
+    assert "SVLEN=-200" in recs[0][7]
+
+
+def test_vcf_gz_roundtrip(tmp_path):
+    from goleft_tpu.utils.xopen import xopen
+
+    path = str(tmp_path / "x.vcf.gz")
+    write_cnv_vcf(path, [("chr1", 0, 100, "s", 1, -1.0)], ["s"])
+    with open(path, "rb") as fh:
+        assert fh.read(2) == b"\x1f\x8b"
+    with xopen(path) as fh:
+        text = fh.read()
+    assert "DEL_chr1_1_100" in text
+    # ID-only contig line when no length is known
+    assert "##contig=<ID=chr1>" in text
+
+
+def _planted_matrix(rng, n_win=60, n_samp=6, depth=30,
+                    del_sample=2, del_lo=20, del_hi=30,
+                    del_frac=0.35):
+    """Depth matrix with one sample dropped to ``del_frac``x in a run of
+    windows. The drop is deeper than a clean het del because the EM's
+    CN2 preference (reference emdepth.go:298-301 Poisson tie-break with
+    the widened CN1 center) absorbs shallow 0.5x events."""
+    d = rng.poisson(depth, size=(n_win, n_samp)).astype(np.float64)
+    d[del_lo:del_hi, del_sample] = rng.poisson(
+        depth * del_frac, size=del_hi - del_lo)
+    return d
+
+
+def test_call_cnvs_emits_vcf(tmp_path):
+    rng = np.random.default_rng(0)
+    n_win = 60
+    depths = _planted_matrix(rng, n_win=n_win)
+    chroms = np.array(["chr1"] * n_win)
+    starts = np.arange(n_win, dtype=np.int64) * 1000
+    ends = starts + 1000
+    samples = [f"s{i}" for i in range(6)]
+    vcf = str(tmp_path / "cnv.vcf")
+    results = call_cnvs(chroms, starts, ends, depths, samples,
+                        out=io.StringIO(), vcf_out=vcf,
+                        contig_lengths={"chr1": n_win * 1000})
+    dels = [r for r in results if r[3] == "s2" and r[4] < 2]
+    assert dels
+    headers, cols, recs = _parse_vcf(open(vcf).read())
+    assert cols[9:] == samples
+    hit = [r for r in recs if r[4] == "<DEL>" and int(r[1]) <= 30_000
+           and r[9 + 2].startswith(("0/1:1", "1/1:0"))]
+    assert hit, recs
+    rec = hit[0]
+    flat = [rec[9 + i] for i in range(6) if i != 2]
+    assert all(f == "0/0:2:." for f in flat)
+    # every tab row in results appears in exactly one VCF record's
+    # carrier set: record count == distinct (locus, svtype) groups
+    keys = {(r[0], r[1], r[2], "DEL" if r[4] < 2 else "DUP")
+            for r in results}
+    assert len(recs) == len(keys)
+
+
+def test_mops_and_gain_outputs(tmp_path):
+    rng = np.random.default_rng(1)
+    n_win = 40
+    depths = _planted_matrix(rng, n_win=n_win, del_lo=10, del_hi=20,
+                             depth=40)
+    chroms = np.array(["chr1"] * n_win)
+    starts = np.arange(n_win, dtype=np.int64) * 500
+    ends = starts + 500
+    samples = [f"s{i}" for i in range(6)]
+    from goleft_tpu.utils.xopen import xopen
+
+    mops_p = str(tmp_path / "mops.tsv")
+    gain_p = str(tmp_path / "gain.tsv.gz")  # outputs route through xopen
+    call_cnvs(chroms, starts, ends, depths, samples, out=io.StringIO(),
+              mops_out=mops_p, gain_out=gain_p)
+
+    with open(gain_p, "rb") as fh:
+        assert fh.read(2) == b"\x1f\x8b"
+    rows = open(mops_p).read().splitlines()
+    assert rows[0] == "#chrom\tstart\tend\t" + "\t".join(samples)
+    assert len(rows) == n_win + 1
+    cn = np.array([[int(x) for x in r.split("\t")[3:]]
+                   for r in rows[1:]])
+    # flat windows posterior CN2 almost everywhere (Poisson noise can
+    # nudge an isolated window); the deleted run drops below 2 for s2
+    # in most windows and stays ~2 for the others
+    flat = np.concatenate([cn[:10].ravel(), cn[20:].ravel()])
+    assert (flat == 2).mean() > 0.95
+    assert (cn[10:20, 2] < 2).sum() >= 8
+    assert (cn[10:20, [0, 1, 3, 4, 5]] == 2).mean() > 0.95
+
+    with xopen(gain_p) as fh:
+        rows = fh.read().splitlines()
+    assert rows[0] == "#chrom\tstart\tend\tgain"
+    gain = np.array([float(r.split("\t")[3]) for r in rows[1:]])
+    assert len(gain) == n_win
+    # information gain concentrates on the divergent windows: their
+    # median well above every flat window's (isolated noisy flat
+    # windows can carry a small nonzero gain)
+    flat_gain = np.concatenate([gain[:10], gain[20:]])
+    assert np.median(gain[10:20]) > 1.5 * flat_gain.max()
+    assert (gain[10:20] > 0).all() or (gain[10:20] > 0).sum() >= 8
+
+
+def test_mops_outputs_chunked(monkeypatch):
+    """The mops outputs stream through the device in EM_CHUNK batches —
+    a matrix larger than one chunk produces identical rows to the
+    single-shot path."""
+    import goleft_tpu.commands.emdepth_cmd as ec
+
+    rng = np.random.default_rng(2)
+    n_win = 50
+    depths = rng.poisson(20, size=(n_win, 4)).astype(np.float64)
+    chroms = np.array(["chr1"] * n_win)
+    starts = np.arange(n_win, dtype=np.int64) * 100
+    ends = starts + 100
+    samples = list("abcd")
+
+    import tempfile
+    outs = []
+    for chunk in (ec.EM_CHUNK, 16):
+        monkeypatch.setattr(ec, "EM_CHUNK", chunk)
+        with tempfile.NamedTemporaryFile("r", suffix=".tsv") as tf:
+            call_cnvs(chroms, starts, ends, depths, samples,
+                      out=io.StringIO(), normalize=False,
+                      mops_out=tf.name)
+            outs.append(open(tf.name).read())
+    assert outs[0] == outs[1]
