@@ -92,6 +92,11 @@ def main(argv: list[str] | None = None) -> int:
     from .utils.device_guard import devices_with_watchdog, maybe_force_cpu
 
     maybe_force_cpu()
+    # multi-host world (no-op without GOLEFT_TPU_COORDINATOR): must come
+    # before the watchdog's jax.devices() initializes the XLA backend
+    from .parallel.mesh import init_distributed
+
+    init_distributed()
     if PROGS[prog][2]:
         devices_with_watchdog()
     sys.argv = [f"goleft-tpu {prog}"] + argv[1:]

@@ -51,12 +51,16 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
             out=None, matrix_out=None, engine: str = "auto",
             vcf_out=None, mops_out=None, gain_out=None):
     out = out or sys.stdout
+    import jax
+
     contig_lengths = None
-    if vcf_out:
+    if vcf_out and jax.process_count() == 1:
         # read the .fai up front: a missing/unreadable index must fail
         # instantly, not after the whole cohort decode has run
         # (cohortdepth auto-generates it from the reference, so do the
-        # same here before reading)
+        # same here before reading). Multi-host defers to the barrier-
+        # guarded generation inside distributed_cohort_matrix — every
+        # process writing the same shared-FS path here would race.
         import os
 
         from ..io.fai import read_fai, write_fai
@@ -67,14 +71,38 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
                 write_fai(reference)
             contig_lengths = {r.name: r.length
                               for r in read_fai(fai_path)}
-    names, n_win, blocks = cohort_matrix_blocks(
-        bams, reference=reference, fai=fai, window=window, mapq=mapq,
-        chrom=chrom, processes=processes, engine=engine,
-    )
-    if n_win == 0:
-        return []
-    chroms, starts, ends, depths = collect_matrix(blocks, n_win,
-                                                  len(names))
+
+    if jax.process_count() > 1:
+        # multi-host: decode shards across processes, assemble over DCN
+        # (parallel/distributed_cohort); process 0 runs the EM + merge
+        # and writes every output
+        from ..parallel.distributed_cohort import (
+            distributed_cohort_matrix,
+        )
+
+        names, chroms, starts, ends, depths = distributed_cohort_matrix(
+            bams, reference=reference, fai=fai, window=window,
+            mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+        )
+        if len(starts) == 0 or jax.process_index() != 0:
+            return []
+        if vcf_out:
+            # the .fai exists now (generated under the barrier above)
+            from ..io.fai import read_fai
+
+            fai_path = fai or (reference + ".fai" if reference else None)
+            if fai_path:
+                contig_lengths = {r.name: r.length
+                                  for r in read_fai(fai_path)}
+    else:
+        names, n_win, blocks = cohort_matrix_blocks(
+            bams, reference=reference, fai=fai, window=window,
+            mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+        )
+        if n_win == 0:
+            return []
+        chroms, starts, ends, depths = collect_matrix(blocks, n_win,
+                                                      len(names))
     return call_cnvs(chroms, starts, ends, depths, names, out=out,
                      matrix_out=matrix_out, vcf_out=vcf_out,
                      mops_out=mops_out, gain_out=gain_out,
@@ -107,6 +135,9 @@ def main(argv=None):
                    help="cohort matrix engine (see cohortdepth --engine)")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    from ..parallel.mesh import init_distributed
+
+    init_distributed()  # idempotent; the CLI dispatcher already ran it
     run_cnv(a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
             mapq=a.mapq, chrom=a.chrom, processes=a.processes,
             matrix_out=a.matrix_out, engine=a.engine, vcf_out=a.vcf,

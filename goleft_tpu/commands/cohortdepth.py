@@ -279,10 +279,38 @@ def run_cohortdepth(
     engine: str = "auto",
 ):
     out = out or sys.stdout
-    names, _, blocks = cohort_matrix_blocks(
-        bams, reference=reference, fai=fai, window=window, mapq=mapq,
-        chrom=chrom, processes=processes, engine=engine,
-    )
+    if jax.process_count() > 1:
+        # multi-host world (mesh.init_distributed): samples shard
+        # across processes, decode wall time divides by the process
+        # count, the matrix assembles over DCN; process 0 writes
+        from ..parallel.distributed_cohort import (
+            distributed_cohort_matrix,
+        )
+
+        names, chroms_a, starts_a, ends_a, mat = \
+            distributed_cohort_matrix(
+                bams, reference=reference, fai=fai, window=window,
+                mapq=mapq, chrom=chrom, processes=processes,
+                engine=engine,
+            )
+        if jax.process_index() != 0:
+            return
+
+        def chrom_blocks():
+            lo = 0
+            for hi in range(1, len(chroms_a) + 1):
+                if hi == len(chroms_a) or chroms_a[hi] != chroms_a[lo]:
+                    yield (chroms_a[lo], starts_a[lo:hi],
+                           ends_a[lo:hi],
+                           mat[lo:hi].T.astype(np.int64))
+                    lo = hi
+
+        blocks = chrom_blocks()
+    else:
+        names, _, blocks = cohort_matrix_blocks(
+            bams, reference=reference, fai=fai, window=window,
+            mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+        )
     from ..io import native
 
     out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
@@ -319,6 +347,9 @@ def main(argv=None):
                         "segments to the chip")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    from ..parallel.mesh import init_distributed
+
+    init_distributed()  # idempotent; the CLI dispatcher already ran it
     run_cohortdepth(
         a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
         mapq=a.mapq, chrom=a.chrom, processes=a.processes,

@@ -74,18 +74,26 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(grid, axis_names)
 
 
+_distributed_up = False
+
+
 def init_distributed() -> None:
-    """Multi-host bring-up over DCN (no-op single-host).
+    """Multi-host bring-up over DCN (no-op single-host, idempotent).
 
     Honors the standard JAX coordinator env vars; the reference has no
     distributed backend at all (SURVEY.md §2.5) — this is the rebuild's
-    equivalent of an NCCL/MPI world init.
+    equivalent of an NCCL/MPI world init. Must run before anything
+    initializes the XLA backend — the CLI dispatcher calls it ahead of
+    its device bring-up watchdog.
     """
+    global _distributed_up
+
     addr = os.environ.get("GOLEFT_TPU_COORDINATOR")
-    if not addr:
+    if not addr or _distributed_up:
         return
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=int(os.environ.get("GOLEFT_TPU_NUM_PROCESSES", "1")),
         process_id=int(os.environ.get("GOLEFT_TPU_PROCESS_ID", "0")),
     )
+    _distributed_up = True

@@ -40,6 +40,23 @@ _HEADER_LINES = [
 ]
 
 
+class _BgzfText:
+    """Minimal text façade over the streaming BGZF writer."""
+
+    def __init__(self, path: str):
+        from ..io.bgzf import BgzfWriter
+
+        self._raw = open(path, "wb")
+        self._w = BgzfWriter(self._raw)
+
+    def write(self, s: str) -> None:
+        self._w.write(s.encode("utf-8"))
+
+    def close(self) -> None:
+        self._w.close()
+        self._raw.close()
+
+
 def _gt(cn: int) -> str:
     if cn == 0:
         return "1/1"
@@ -78,7 +95,15 @@ def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
                           []).append((sample, int(cn), float(fc)))
 
     own = isinstance(path_or_fh, str)
-    fh = xopen(path_or_fh, "w") if own else path_or_fh
+    if own and path_or_fh.endswith(".gz"):
+        # BGZF, not plain gzip: the named consumers (bcftools index,
+        # tabix, IGV) require bgzip-compressed .vcf.gz; BGZF is still a
+        # valid gzip stream for everything else
+        fh = _BgzfText(path_or_fh)
+    elif own:
+        fh = xopen(path_or_fh, "w")
+    else:
+        fh = path_or_fh
     try:
         fh.write("##fileformat=VCFv4.2\n")
         fh.write(f"##source={source}\n")

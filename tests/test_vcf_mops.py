@@ -79,7 +79,13 @@ def test_vcf_gz_roundtrip(tmp_path):
     path = str(tmp_path / "x.vcf.gz")
     write_cnv_vcf(path, [("chr1", 0, 100, "s", 1, -1.0)], ["s"])
     with open(path, "rb") as fh:
-        assert fh.read(2) == b"\x1f\x8b"
+        raw = fh.read()
+    # BGZF, not plain gzip: BC extra subfield + the 28-byte EOF marker,
+    # so bcftools index / tabix accept the output
+    from goleft_tpu.io.bgzf import BGZF_EOF
+
+    assert raw[:4] == b"\x1f\x8b\x08\x04" and raw[12:14] == b"BC"
+    assert raw.endswith(BGZF_EOF)
     with xopen(path) as fh:
         text = fh.read()
     assert "DEL_chr1_1_100" in text
