@@ -5,7 +5,7 @@ reductions scale across decode threads on multi-core hosts (the
 reference's equivalent is its process pool, depth/depth.go:392-394).
 ``measure_scaling`` runs that claim: N concurrent ``window_reduce``
 calls on distinct mmap-backed files vs the same calls serial.
-bench.py --suite records the numbers in BENCH_details.json;
+bench.py records the numbers in BENCH_details.json;
 tests/test_thread_scaling.py asserts them.
 """
 

@@ -1,7 +1,7 @@
 """Executable proof of the decode-thread scaling claim.
 
 The measurement lives in goleft_tpu/utils/decode_scaling.py (shared
-with bench.py --suite, which records it in BENCH_details.json); this
+with bench.py, which records it in BENCH_details.json); this
 test asserts it:
 
 - multi-core host: wall must approach serial/min(N, cores)
