@@ -329,6 +329,10 @@ def main(argv=None):
     p.add_argument("-c", "--chrom", default="")
     p.add_argument("--mincov", type=int, default=4,
                    help="minimum depth considered callable")
+    p.add_argument("-o", "--ordered", action="store_true",
+                   help="accepted for reference-CLI parity; output here "
+                        "is ALWAYS in input order (the shard scheduler "
+                        "consumes results ordered even with -p)")
     p.add_argument("-s", "--stats", action="store_true",
                    help="report GC CpG masked stats per window")
     p.add_argument("-r", "--reference", default=None,

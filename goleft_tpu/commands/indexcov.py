@@ -552,7 +552,7 @@ def main(argv=None):
                    help="directory for output files")
     p.add_argument("-e", "--includegl", action="store_true",
                    help="plot GL chromosomes")
-    p.add_argument("--excludepatt", default=DEFAULT_EXCLUDE,
+    p.add_argument("-p", "--excludepatt", default=DEFAULT_EXCLUDE,
                    help="regex of chromosomes to exclude")
     p.add_argument("-X", "--sex", default="X,Y",
                    help="comma-delimited sex chromosomes ('' for none)")
