@@ -538,10 +538,13 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
         run_cohortdepth(bams, fai=fai, window=500, out=_Null())
         cold = _t.perf_counter() - t0
         # steady state (caches warm — what a whole-genome run
-        # amortizes to)
-        t0 = _t.perf_counter()
-        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
-        wall = _t.perf_counter() - t0
+        # amortizes to): best of two, the least-noise estimator on a
+        # shared host (same policy as the numpy baseline's best-of-3)
+        wall = float("inf")
+        for _ in range(2):
+            t0 = _t.perf_counter()
+            run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+            wall = min(wall, _t.perf_counter() - t0)
         # non-default variant: BGZF payload CRC verification skipped
         # (GOLEFT_TPU_SKIP_CRC=1, trusted local files). Recorded for
         # the stage analysis only; the headline stays the strict
@@ -571,6 +574,35 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
             native.format_matrix_rows(c, st, en, vals)
     t_format = _t.perf_counter() - t0
 
+    # decode-floor evidence: stream the same file through the product
+    # ring driver with a no-op walk — the inflate(+CRC) share of the
+    # decode stage is libdeflate running at hardware rates, i.e. the
+    # per-core floor; the remainder is the record walk
+    floor = None
+    if native.get_lib() is not None:
+        comp = np.fromfile(base, dtype=np.uint8)
+
+        def best_of(f, n=3):
+            return min(_timed(f) for _ in range(n))
+
+        total = native.bgzf_stream_inflate_only(comp)
+        t_crc = best_of(lambda: native.bgzf_stream_inflate_only(comp))
+        t_nocrc = best_of(lambda: native.bgzf_stream_inflate_only(
+            comp, check_crc=False))
+        per_sample = t_reduce / n_samples
+        floor = {
+            "uncompressed_mb": round(total / 1e6, 1),
+            "ring_inflate_crc_ms": round(t_crc * 1e3, 2),
+            "ring_inflate_ms": round(t_nocrc * 1e3, 2),
+            "full_decode_reduce_ms": round(per_sample * 1e3, 2),
+            "record_walk_ms": round(max(per_sample - t_crc, 0.0) * 1e3,
+                                    2),
+            "inflate_crc_share": round(min(t_crc / per_sample, 1.0), 3),
+            "note": "per sample; identical ring driver minus the walk "
+                    "— the inflate+CRC share is libdeflate at hardware "
+                    "rates (the per-core decode floor)",
+        }
+
     # numpy per-sample equivalent of the windowing math, decode-free
     seg_s = starts.astype(np.int32)
     seg_e = (seg_s + read_len).astype(np.int32)
@@ -591,6 +623,7 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
             "decode_window_reduce": round(t_reduce, 3),
             "format_matrix": round(t_format, 3),
         },
+        "decode_floor": floor,
         "numpy_kernel_only_seconds": round(np_one * n_samples, 2),
         "numpy_kernel_gbases_per_sec": round(
             gbases / (np_one * n_samples), 4

@@ -902,6 +902,36 @@ long bam_window_acc_stream(const uint8_t* comp, long comp_len,
     return st.nk;
 }
 
+// Inflate-only variant of the streaming walk (the walk consumes every
+// byte and reduces nothing): isolates the BGZF inflate(+CRC) floor of
+// the decode stage so bench.py can record what fraction of
+// decode_window_reduce is libdeflate running at hardware rates vs the
+// record walk. Returns total uncompressed bytes or a negative bgzf
+// error.
+static long inflate_only_walk(void* st, const uint8_t*, long have,
+                              long* rpos_io) {
+    *(int64_t*)st += have - *rpos_io;
+    *rpos_io = have;  // consume everything; keep streaming
+    return 0;
+}
+
+long bgzf_stream_inflate_only(const uint8_t* comp, long comp_len,
+                              long c_begin, long in_block,
+                              int check_crc, int64_t* total_out) {
+    // reuses the product driver minus the record walk. One deliberate
+    // divergence: the consume-all walk keeps the ring at offset 0, so
+    // the compaction/growth branches a real walk can trigger never run
+    // — the recorded floor is a (slightly best-case-locality) LOWER
+    // bound on the production inflate cost, which is the right
+    // direction for a floor measurement
+    int64_t total = 0;
+    long status = bgzf_stream_walk(comp, comp_len, c_begin, in_block,
+                                   check_crc, inflate_only_walk, &total);
+    if (status < 0) return status;
+    *total_out = total;
+    return 0;
+}
+
 // Scan a .bai: per reference, the bin-section byte range, linear-index
 // range, and stats-bin (0x924A) counts — without materializing per-bin
 // chunk lists (Python parses one reference's bins lazily if a region

@@ -93,6 +93,7 @@ def _register_restypes(lib) -> None:
         lib.bam_window_reduce.restype = ctypes.c_long
         lib.bam_window_reduce_stream.restype = ctypes.c_long
         lib.bam_window_acc_stream.restype = ctypes.c_long
+        lib.bgzf_stream_inflate_only.restype = ctypes.c_long
         lib.bgzf_deflate_block.restype = ctypes.c_long
         lib.rans4x8_decode.restype = ctypes.c_long
         lib.ransnx16_decode0.restype = ctypes.c_long
@@ -439,6 +440,26 @@ def bgzf_deflate_block(chunk: bytes, level: int) -> bytes | None:
     if n < 0:
         return None  # fall back to the zlib path
     return out[:n].tobytes()
+
+
+def bgzf_stream_inflate_only(comp, check_crc: bool = True):
+    """Total uncompressed bytes after streaming the whole BGZF file
+    through the product ring driver with a no-op walk — isolates the
+    inflate(+CRC) floor of the fused decode stage for the bench's
+    decode-floor evidence. None when native is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = _as_u8(comp)
+    total = ctypes.c_int64(0)
+    r = lib.bgzf_stream_inflate_only(
+        _ptr(buf), ctypes.c_long(len(buf)), ctypes.c_long(0),
+        ctypes.c_long(0), ctypes.c_int(1 if check_crc else 0),
+        ctypes.byref(total),
+    )
+    if r < 0:
+        raise ValueError(f"bgzf stream inflate: {_stream_err(r)}")
+    return int(total.value)
 
 
 def bai_scan(data):
