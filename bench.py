@@ -666,8 +666,8 @@ def host_suite(quick: bool) -> dict:
         run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
                      exclude_patt="", sex="")  # warmup/compile
         t0 = time.perf_counter()
-        run_indexcov(bais, directory=f"{d}/out", fai=f"{d}/ref.fa.fai",
-                     exclude_patt="", sex="")
+        r = run_indexcov(bais, directory=f"{d}/out",
+                         fai=f"{d}/ref.fa.fai", exclude_patt="", sex="")
         dt = time.perf_counter() - t0
         shutil.rmtree(d, ignore_errors=True)
         import jax as _jax
@@ -677,6 +677,7 @@ def host_suite(quick: bool) -> dict:
             "samples": n_ix, "chromosomes": 25,
             "genome_gb": round(sum(chrom_lens) / 1e9, 2),
             "seconds_warm": round(dt, 2),
+            "stage_seconds": r.get("stages"),
             "platform": plat + (" (host-only mode)" if plat == "cpu"
                                 else ""),
             "note": "full CLI path: .bai parse -> QC -> bed.gz/ped/roc/"

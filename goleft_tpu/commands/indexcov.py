@@ -190,12 +190,18 @@ def run_indexcov(
     bams = expand_globs(bams)
     refs = references(bams, fai, chrom)
     log.info("running on %d indexes", len(bams))
+    from ..utils.profiling import StageTimer
+
+    # wall-clock per pipeline stage, returned under "stages" (and
+    # recorded by bench.py's indexcov e2e entry)
+    timer = StageTimer()
     # 8-way parallel index load, mirroring indexcov.go:417-434
     import concurrent.futures as cf
 
-    with cf.ThreadPoolExecutor(max_workers=8) as ex:
-        idxs = list(ex.map(SampleIndex, bams))
-        names = list(ex.map(get_short_name, bams))
+    with timer.stage("index_load"):
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            idxs = list(ex.map(SampleIndex, bams))
+            names = list(ex.map(get_short_name, bams))
     n_samples = len(idxs)
 
     name = os.path.basename(os.path.abspath(directory))
@@ -228,20 +234,22 @@ def run_indexcov(
         tunnel latency per chromosome). Empty chromosomes contribute
         nothing.
         """
-        rows = [idx.normalized_depth(ref_id) for idx in idxs]
-        mat, valid, lengths = _pad_rows(rows)
-        longest = int(lengths.max())
-        is_sex = _same_chrom(sex_chroms, ref_name)
-        if extra_normalize and not is_sex and n_samples >= 5:
-            mat = np.asarray(ops.normalize_across_samples(mat, lengths))
-            mat = np.where(valid, mat, 0.0)
-        packed_dev = None
-        if longest > 0:
-            packed_dev = ops.chrom_qc(mat, valid, np.int32(longest))
-            try:
-                packed_dev.copy_to_host_async()
-            except AttributeError:  # non-jax array (cpu fallback paths)
-                pass
+        with timer.stage("qc_launch"):
+            rows = [idx.normalized_depth(ref_id) for idx in idxs]
+            mat, valid, lengths = _pad_rows(rows)
+            longest = int(lengths.max())
+            is_sex = _same_chrom(sex_chroms, ref_name)
+            if extra_normalize and not is_sex and n_samples >= 5:
+                mat = np.asarray(
+                    ops.normalize_across_samples(mat, lengths))
+                mat = np.where(valid, mat, 0.0)
+            packed_dev = None
+            if longest > 0:
+                packed_dev = ops.chrom_qc(mat, valid, np.int32(longest))
+                try:
+                    packed_dev.copy_to_host_async()
+                except AttributeError:  # non-jax array (cpu fallback)
+                    pass
         return (ref_name, ref_len, mat, valid, lengths, longest, is_sex,
                 packed_dev)
 
@@ -251,9 +259,10 @@ def run_indexcov(
          packed_dev) = state
         rocs = chrom_counters = chrom_cn = None
         if packed_dev is not None:
-            rocs, chrom_counters, chrom_cn = ops.unpack_chrom_qc(
-                np.asarray(packed_dev), n_samples
-            )
+            with timer.stage("qc_fetch"):
+                rocs, chrom_counters, chrom_cn = ops.unpack_chrom_qc(
+                    np.asarray(packed_dev), n_samples
+                )
 
         # bed.gz rows: longest sample defines row count; shorter samples
         # print 0 (indexcov.go:678-680, depthsFor :1038-1048).
@@ -263,25 +272,26 @@ def run_indexcov(
         from ..io import native
 
         use_native_fmt = native.get_lib() is not None
-        for lo in range(0, longest, 2048):
-            hi = min(lo + 2048, longest)
-            idx = np.arange(lo, hi, dtype=np.int64)
-            if use_native_fmt:
-                bed.write(native.format_float_matrix_rows(
-                    ref_name, idx * TILE, (idx + 1) * TILE,
-                    mat[:, lo:hi], valid[:, lo:hi],
-                ))
-                continue
-            block = np.char.mod("%.3g", mat[:, lo:hi].T)
-            block[~valid[:, lo:hi].T] = "0"
-            starts_col = np.char.mod("%d", idx * TILE)
-            ends_col = np.char.mod("%d", (idx + 1) * TILE)
-            rows_txt = [
-                ref_name + "\t" + starts_col[i] + "\t" + ends_col[i]
-                + "\t" + "\t".join(block[i]) + "\n"
-                for i in range(hi - lo)
-            ]
-            bed.write("".join(rows_txt).encode())
+        with timer.stage("bed_gz"):
+            for lo in range(0, longest, 2048):
+                hi = min(lo + 2048, longest)
+                idx = np.arange(lo, hi, dtype=np.int64)
+                if use_native_fmt:
+                    bed.write(native.format_float_matrix_rows(
+                        ref_name, idx * TILE, (idx + 1) * TILE,
+                        mat[:, lo:hi], valid[:, lo:hi],
+                    ))
+                    continue
+                block = np.char.mod("%.3g", mat[:, lo:hi].T)
+                block[~valid[:, lo:hi].T] = "0"
+                starts_col = np.char.mod("%d", idx * TILE)
+                ends_col = np.char.mod("%d", (idx + 1) * TILE)
+                rows_txt = [
+                    ref_name + "\t" + starts_col[i] + "\t" + ends_col[i]
+                    + "\t" + "\t".join(block[i]) + "\n"
+                    for i in range(hi - lo)
+                ]
+                bed.write("".join(rows_txt).encode())
 
         if is_sex:
             if longest > 0:
@@ -299,28 +309,31 @@ def run_indexcov(
 
         if longest > 0:
             # one vectorized format pass for the whole ROC block
-            cov_col = np.char.mod(
-                "%.2f", np.arange(ops.SLOTS) / (ops.SLOTS * ops.SLOTS_MID)
-            )
-            cells = np.char.mod("%.2f", rocs.T)  # (SLOTS, S)
-            roc_fh.write("".join(
-                ref_name + "\t" + cov_col[i] + "\t"
-                + "\t".join(cells[i]) + "\n"
-                for i in range(ops.SLOTS)
-            ))
+            with timer.stage("roc"):
+                cov_col = np.char.mod(
+                    "%.2f",
+                    np.arange(ops.SLOTS) / (ops.SLOTS * ops.SLOTS_MID),
+                )
+                cells = np.char.mod("%.2f", rocs.T)  # (SLOTS, S)
+                roc_fh.write("".join(
+                    ref_name + "\t" + cov_col[i] + "\t"
+                    + "\t".join(cells[i]) + "\n"
+                    for i in range(ops.SLOTS)
+                ))
             if (include_gl or not ref_name.startswith("GL")) and longest > 2:
                 if not is_sex and longest > 100:
                     slopes += ops.update_slopes(rocs, ref_len / 1e6)
                     n_slopes += 1
                 chrom_names.append(ref_name)
                 if write_html:
-                    _plot_depth_chrom(
-                        base, ref_name, mat, lengths, names,
-                        interactive=n_samples <= MAX_SAMPLES,
-                        write_png=write_png,
-                    )
-                    _plot_roc_chrom(base, ref_name, rocs, names,
-                                    write_png=write_png)
+                    with timer.stage("plots"):
+                        _plot_depth_chrom(
+                            base, ref_name, mat, lengths, names,
+                            interactive=n_samples <= MAX_SAMPLES,
+                            write_png=write_png,
+                        )
+                        _plot_roc_chrom(base, ref_name, rocs, names,
+                                        write_png=write_png)
 
     pending = None
     for ref_id, ref_name, ref_len in refs:
@@ -336,30 +349,33 @@ def run_indexcov(
     bed.close()
     bed_fh.close()
     roc_fh.close()
-    if n_slopes > 0:
-        slopes = slopes / np.float32(n_slopes)
-    _check_sexes(sexes, sex_chroms)
+    with timer.stage("pca_ped_html"):
+        if n_slopes > 0:
+            slopes = slopes / np.float32(n_slopes)
+        _check_sexes(sexes, sex_chroms)
 
-    # PCA over autosome bins (indexcov.go:773-807)
-    pcs = None
-    var_frac = None
-    if pca_blocks:
-        pca_mat = np.concatenate(pca_blocks, axis=1).astype(np.float32)
-        if pca_mat.shape[1] >= 3 and n_samples >= 3:
-            proj, frac = ops.pca_project(pca_mat, k=5)
-            pcs, var_frac = np.asarray(proj), np.asarray(frac)
+        # PCA over autosome bins (indexcov.go:773-807)
+        pcs = None
+        var_frac = None
+        if pca_blocks:
+            pca_mat = np.concatenate(pca_blocks, axis=1).astype(
+                np.float32)
+            if pca_mat.shape[1] >= 3 and n_samples >= 3:
+                proj, frac = ops.pca_project(pca_mat, k=5)
+                pcs, var_frac = np.asarray(proj), np.asarray(frac)
 
-    ped_path = _write_ped(
-        base, directory, sexes, counters, names, slopes, pcs,
-        [i.mapped for i in idxs], [i.unmapped for i in idxs],
-    )
-    if write_html:
-        _write_index_html(
-            directory, base, name, sexes, counters, names, pcs, var_frac,
+        ped_path = _write_ped(
+            base, directory, sexes, counters, names, slopes, pcs,
             [i.mapped for i in idxs], [i.unmapped for i in idxs],
-            chrom_names, write_png=write_png,
         )
-        log.info("indexcov finished: see %s/index.html", directory)
+        if write_html:
+            _write_index_html(
+                directory, base, name, sexes, counters, names, pcs,
+                var_frac,
+                [i.mapped for i in idxs], [i.unmapped for i in idxs],
+                chrom_names, write_png=write_png,
+            )
+            log.info("indexcov finished: see %s/index.html", directory)
     return {
         "sexes": sexes,
         "counters": counters,
@@ -369,6 +385,7 @@ def run_indexcov(
         "bed": base + ".bed.gz",
         "roc": base + ".roc",
         "chrom_names": chrom_names,
+        "stages": {k: round(v, 3) for k, v in timer.totals.items()},
     }
 
 
