@@ -49,7 +49,8 @@ def collect_matrix(blocks, n_win: int, n_samples: int):
 def run_cnv(bams, reference=None, fai=None, window: int = 1000,
             mapq: int = 1, chrom: str = "", processes: int = 8,
             out=None, matrix_out=None, engine: str = "auto",
-            vcf_out=None, mops_out=None, gain_out=None):
+            vcf_out=None, mops_out=None, gain_out=None,
+            bed: str | None = None):
     out = out or sys.stdout
     import jax
 
@@ -83,6 +84,7 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
         names, chroms, starts, ends, depths = distributed_cohort_matrix(
             bams, reference=reference, fai=fai, window=window,
             mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+            bed=bed,
         )
         if len(starts) == 0 or jax.process_index() != 0:
             return []
@@ -98,6 +100,7 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
         names, n_win, blocks = cohort_matrix_blocks(
             bams, reference=reference, fai=fai, window=window,
             mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+            bed=bed,
         )
         if n_win == 0:
             return []
@@ -118,6 +121,8 @@ def main(argv=None):
     p.add_argument("-w", "--windowsize", type=int, default=1000)
     p.add_argument("-Q", "--mapq", type=int, default=1)
     p.add_argument("-c", "--chrom", default="")
+    p.add_argument("-b", "--bed", default=None,
+                   help="restrict to regions in this bed")
     p.add_argument("-r", "--reference", default=None)
     p.add_argument("--fai", default=None)
     p.add_argument("-p", "--processes", type=int, default=8)
@@ -141,7 +146,7 @@ def main(argv=None):
     run_cnv(a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
             mapq=a.mapq, chrom=a.chrom, processes=a.processes,
             matrix_out=a.matrix_out, engine=a.engine, vcf_out=a.vcf,
-            mops_out=a.mops_out, gain_out=a.gain_out)
+            mops_out=a.mops_out, gain_out=a.gain_out, bed=a.bed)
 
 
 if __name__ == "__main__":

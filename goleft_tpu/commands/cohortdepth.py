@@ -28,8 +28,36 @@ from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
 from ..utils.decode_scaling import effective_cores
 from ..ops.depth_pipeline import shard_depth_pipeline
+from . import depth as _depth
 from .depth import DEPTH_CAP_EXTRA, gen_regions
 from .indexcov import get_short_name
+
+
+def cohort_regions(fai_records, chrom: str, window: int,
+                   bed: str | None):
+    """Shard list for the cohort engines.
+
+    The fai path is gen_regions' STEP-sized shards. Bed intervals are
+    additionally (a) filtered by ``chrom`` when both are given (plain
+    gen_regions ignores -c for beds) and (b) split at absolute
+    multiples of the STEP-aligned shard size, so a whole-chromosome bed
+    line costs the same bounded per-shard memory as the fai path —
+    interior split points land on window boundaries, so the emitted
+    windows are identical to an unsplit run."""
+    regions = gen_regions(fai_records, chrom, window, bed)
+    if not bed:
+        return regions
+    if chrom:
+        regions = [r for r in regions if r[0] == chrom]
+    step = max(1, _depth.STEP // window) * window
+    out = []
+    for c, s, e in regions:
+        lo = s
+        while lo < e:
+            hi = min(e, (lo // step + 1) * step)
+            out.append((c, lo, hi))
+            lo = hi
+    return out
 
 
 def _batched_pipeline(seg_s, seg_e, keep, w0, rs, re, cap, length, window):
@@ -51,9 +79,12 @@ def cohort_matrix_blocks(
     chrom: str = "",
     processes: int = 8,
     engine: str = "auto",
+    bed: str | None = None,
 ):
     """(sample_names, total_windows, block generator) for the cohort
-    depth matrix.
+    depth matrix. ``bed`` restricts to the file's regions (the cohort
+    analog of ``depth -b``); each bed interval becomes a shard whose
+    windows tile it on absolute window-aligned coordinates.
 
     Each block is (chrom, starts, ends, vals) with vals an int64
     (samples, n_windows) array of round-half-up window means — the same
@@ -81,6 +112,25 @@ def cohort_matrix_blocks(
     import os
     import threading
 
+    # resolve regions FIRST: a bad fai/bed/chrom must fail before the
+    # (potentially huge) cohort of BAM handles is opened
+    fai_path = fai or (reference + ".fai" if reference else None)
+    if fai_path is None:
+        raise SystemExit("cohortdepth: need -r reference or --fai")
+    if not os.path.exists(fai_path) and reference:
+        write_fai(reference)
+    fai_records = read_fai(fai_path)
+    regions = cohort_regions(fai_records, chrom, window, bed)
+    if not regions:
+        raise SystemExit(
+            "cohortdepth: no regions ("
+            + (f"bed {bed!r} has no usable intervals"
+               + (f" on chromosome {chrom!r}" if chrom else "")
+               if bed else
+               f"chromosome {chrom!r} not in {fai_path}?")
+            + ")"
+        )
+
     handles = []
     bais = []
     names = []
@@ -100,19 +150,6 @@ def cohort_matrix_blocks(
             handles.append(h)
             bais.append(bai)
             names.append(nm)
-
-    fai_path = fai or (reference + ".fai" if reference else None)
-    if fai_path is None:
-        raise SystemExit("cohortdepth: need -r reference or --fai")
-    if not os.path.exists(fai_path) and reference:
-        write_fai(reference)
-    fai_records = read_fai(fai_path)
-    regions = gen_regions(fai_records, chrom, window, None)
-    if not regions:
-        raise SystemExit(
-            f"cohortdepth: no regions (chromosome {chrom!r} not in "
-            f"{fai_path}?)"
-        )
     max_span = max(e - (s // window) * window for _, s, e in regions)
     length = (max_span + window - 1) // window * window
     cap = np.int32(DEPTH_CAP_EXTRA)
@@ -277,6 +314,7 @@ def run_cohortdepth(
     processes: int = 8,
     out=None,
     engine: str = "auto",
+    bed: str | None = None,
 ):
     out = out or sys.stdout
     if jax.process_count() > 1:
@@ -291,7 +329,7 @@ def run_cohortdepth(
             distributed_cohort_matrix(
                 bams, reference=reference, fai=fai, window=window,
                 mapq=mapq, chrom=chrom, processes=processes,
-                engine=engine,
+                engine=engine, bed=bed,
             )
         if jax.process_index() != 0:
             return
@@ -310,6 +348,7 @@ def run_cohortdepth(
         names, _, blocks = cohort_matrix_blocks(
             bams, reference=reference, fai=fai, window=window,
             mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+            bed=bed,
         )
     from ..io import native
 
@@ -337,6 +376,9 @@ def main(argv=None):
     p.add_argument("-w", "--windowsize", type=int, default=250)
     p.add_argument("-Q", "--mapq", type=int, default=1)
     p.add_argument("-c", "--chrom", default="")
+    p.add_argument("-b", "--bed", default=None,
+                   help="restrict to regions in this bed (cohort "
+                        "analog of depth -b)")
     p.add_argument("-r", "--reference", default=None)
     p.add_argument("--fai", default=None)
     p.add_argument("-p", "--processes", type=int, default=8)
@@ -353,7 +395,7 @@ def main(argv=None):
     run_cohortdepth(
         a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
         mapq=a.mapq, chrom=a.chrom, processes=a.processes,
-        engine=a.engine,
+        engine=a.engine, bed=a.bed,
     )
 
 

@@ -43,17 +43,18 @@ def _stdout_to_stderr():
         os.close(saved)
 
 
-def cohort_coords(fai_path: str, chrom: str, window: int):
+def cohort_coords(fai_path: str, chrom: str, window: int,
+                  bed: str | None = None):
     """(chroms, starts, ends) for every window of the cohort matrix,
     derived from the .fai alone — exactly the coordinates
     cohort_matrix_blocks emits (same gen_regions shards, same
     window_bounds), so a process holding zero local samples can still
     label the gathered matrix."""
-    from ..commands.depth import gen_regions
+    from ..commands.cohortdepth import cohort_regions
     from ..io.fai import read_fai
     from ..ops.coverage import window_bounds
 
-    regions = gen_regions(read_fai(fai_path), chrom, window, None)
+    regions = cohort_regions(read_fai(fai_path), chrom, window, bed)
     ch, st, en = [], [], []
     for c, s, e in regions:
         starts, ends, _, _ = window_bounds(s, e, window)
@@ -68,7 +69,7 @@ def cohort_coords(fai_path: str, chrom: str, window: int):
 
 
 def _local_matrix(local_bams, n_win, reference, fai, window, mapq,
-                  chrom, processes, engine):
+                  chrom, processes, engine, bed):
     """Drain cohort_matrix_blocks for this process's sample shard into
     an int32 (n_win, n_local) matrix of round-half-up window means."""
     from ..commands.cohortdepth import cohort_matrix_blocks
@@ -78,6 +79,7 @@ def _local_matrix(local_bams, n_win, reference, fai, window, mapq,
     names, total, blocks = cohort_matrix_blocks(
         local_bams, reference=reference, fai=fai, window=window,
         mapq=mapq, chrom=chrom, processes=processes, engine=engine,
+        bed=bed,
     )
     assert total == n_win, (total, n_win)
     mat = np.empty((n_win, len(names)), dtype=np.int32)
@@ -111,6 +113,7 @@ def distributed_cohort_matrix(
     chrom: str = "",
     processes: int = 8,
     engine: str = "auto",
+    bed: str | None = None,
 ):
     """(names, chroms, starts, ends, matrix) with matrix int32
     (n_windows, n_samples) of round-half-up window means, identical to
@@ -141,18 +144,19 @@ def distributed_cohort_matrix(
             with _stdout_to_stderr():
                 multihost_utils.sync_global_devices(
                     "goleft_tpu_fai_ready")
-    chroms, starts, ends = cohort_coords(fai_path, chrom, window)
+    chroms, starts, ends = cohort_coords(fai_path, chrom, window,
+                                         bed=bed)
     n_win = len(starts)
     if P == 1:
         names, mat = _local_matrix(bams, n_win, reference, fai_path,
                                    window, mapq, chrom, processes,
-                                   engine)
+                                   engine, bed)
         return names, chroms, starts, ends, mat
 
     local = bams[pid::P]
     names_l, mat_l = _local_matrix(local, n_win, reference, fai_path,
                                    window, mapq, chrom, processes,
-                                   engine)
+                                   engine, bed)
     # fixed-shape padding: allgather needs identical shapes everywhere
     pad = (len(bams) + P - 1) // P
     mat_pad = np.zeros((n_win, pad), dtype=np.int32)

@@ -97,3 +97,123 @@ def test_blocks_hybrid_threaded_path_matches_serial(tmp_path,
         outs[cores] = buf.getvalue()
     assert outs[1] == outs[4]
     assert len(outs[1].splitlines()) == ref_len // 500 + 1
+
+
+def test_cohortdepth_bed_restriction(tmp_path):
+    """-b bed: output contains exactly the bed intervals' windows, with
+    values identical to the full run's rows at the same coordinates
+    (windows align to absolute window-aligned origins either way)."""
+    rng = np.random.default_rng(3)
+    ref_len = 30_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(3):
+        reads = random_reads(rng, 600, 0, ref_len)
+        p = str(tmp_path / f"b{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,))
+        bams.append(p)
+    bed = str(tmp_path / "r.bed")
+    with open(bed, "w") as fh:
+        # unaligned interval starts exercise the window-origin logic
+        fh.write("chr1\t1100\t4200\nchr1\t20000\t23000\n")
+
+    full = io.StringIO()
+    run_cohortdepth(bams, reference=fa, window=500, out=full)
+    by_coord = {tuple(l.split("\t")[:3]): l
+                for l in full.getvalue().splitlines()[1:]}
+
+    out = io.StringIO()
+    run_cohortdepth(bams, reference=fa, window=500, out=out, bed=bed)
+    lines = out.getvalue().splitlines()
+    rows = [l.split("\t") for l in lines[1:]]
+    # rows tile exactly the bed intervals: window boundaries on absolute
+    # window-aligned coordinates, first/last windows clipped to the
+    # interval (depth -b semantics)
+    want_rows = ([("chr1", max(s, 1100), min(s + 500, 4200)) for s in
+                  range(1000, 4200, 500)]
+                 + [("chr1", s, min(s + 500, 23000)) for s in
+                    range(20000, 23000, 500)])
+    got = [(r[0], int(r[1]), int(r[2])) for r in rows]
+    assert got == want_rows
+    # interior whole windows carry the same values as the full run
+    checked = 0
+    for l in lines[1:]:
+        t = tuple(l.split("\t")[:3])
+        if t in by_coord and int(t[2]) - int(t[1]) == 500:
+            assert l == by_coord[t]
+            checked += 1
+    assert checked >= 8
+
+
+def test_cnv_bed_restriction(tmp_path):
+    """cnv -b: the EM runs on the restricted matrix only."""
+    from goleft_tpu.commands.cnv import run_cnv
+
+    rng = np.random.default_rng(4)
+    ref_len = 40_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+    bams = []
+    for i in range(4):
+        reads = random_reads(rng, 800, 0, ref_len)
+        p = str(tmp_path / f"c{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,))
+        bams.append(p)
+    bed = str(tmp_path / "r.bed")
+    with open(bed, "w") as fh:
+        fh.write("chr1\t0\t10000\n")
+    m = str(tmp_path / "cn.tsv")
+    run_cnv(bams, reference=fa, window=1000, out=io.StringIO(),
+            matrix_out=m, bed=bed)
+    rows = open(m).read().splitlines()
+    assert len(rows) == 1 + 10  # header + 10 windows of the bed region
+    assert rows[1].startswith("chr1\t0\t1000\t")
+    assert rows[-1].startswith("chr1\t9000\t10000\t")
+
+
+def test_cohort_regions_splits_large_bed_intervals(monkeypatch,
+                                                   tmp_path):
+    """A whole-chromosome bed line splits at absolute STEP multiples
+    (bounded per-shard memory), with interior boundaries on window
+    boundaries; -c filters multi-chromosome beds."""
+    import goleft_tpu.commands.depth as depth_mod
+    from goleft_tpu.commands.cohortdepth import cohort_regions
+    from goleft_tpu.io.fai import FaiRecord
+
+    monkeypatch.setattr(depth_mod, "STEP", 4000)
+    recs = [FaiRecord("chr1", 100_000, 0, 60, 61),
+            FaiRecord("chr2", 50_000, 0, 60, 61)]
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".bed") as bf:
+        bf.write("chr1\t1100\t9500\nchr2\t0\t2000\n")
+        bf.flush()
+        regions = cohort_regions(recs, "", 500, bf.name)
+        assert regions == [
+            ("chr1", 1100, 4000), ("chr1", 4000, 8000),
+            ("chr1", 8000, 9500), ("chr2", 0, 2000),
+        ]
+        # every interior split point is window-aligned
+        assert all(s % 500 == 0 for _, s, _ in regions[1:3])
+        # -c composes with -b
+        assert cohort_regions(recs, "chr2", 500, bf.name) == [
+            ("chr2", 0, 2000)
+        ]
+    # empty bed -> clear error from the caller
+    import io as _io
+    import pytest
+
+    fai = str(tmp_path / "r.fa.fai")
+    with open(fai, "w") as fh:
+        fh.write("chr1\t100000\t6\t60\t61\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".bed") as bf:
+        bf.write("# nothing\n")
+        bf.flush()
+        # fails on the empty bed BEFORE any BAM is opened (the path
+        # does not exist, so reaching the open would error differently)
+        with pytest.raises(SystemExit, match="no usable intervals"):
+            run_cohortdepth(["unused.bam"], fai=fai,
+                            window=500, out=_io.StringIO(), bed=bf.name)
