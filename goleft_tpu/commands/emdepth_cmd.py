@@ -85,6 +85,23 @@ def _batched_em(depths: np.ndarray, med=None, medmed=None,
         return lam, (np.asarray(em.cn_batch(lam, c)) if want_cn
                      else None)
 
+    # multi-chip: the window axis is embarrassingly parallel, so chunks
+    # shard across this host's devices and XLA partitions the vmapped
+    # EM as pure SPMD (no collectives). Chunks are always padded to
+    # EM_CHUNK here, so the leading axis divides evenly. LOCAL devices
+    # only, and only in a single-process world: in a multi-host cnv run
+    # process 0 alone reaches the EM (the others returned after the
+    # gather), so a global mesh would address remote devices whose
+    # processes are gone and hang the SPMD program.
+    sharding = None
+    devs = jax.local_devices()
+    if (jax.process_count() == 1 and len(devs) > 1
+            and EM_CHUNK % len(devs) == 0):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(Mesh(np.array(devs), ("w",)),
+                                 PartitionSpec("w", None))
+
     def staged(lo):
         chunk = _norm_chunk(depths[lo : lo + EM_CHUNK], med, medmed,
                             dtype)
@@ -94,6 +111,8 @@ def _batched_em(depths: np.ndarray, med=None, medmed=None,
             chunk = np.concatenate([chunk, pad])
         # async H2D: the transfer of chunk k+1 rides the link while the
         # device chews chunk k (device_put returns immediately)
+        if sharding is not None:
+            return jax.device_put(chunk, sharding), n
         return jax.device_put(chunk), n
 
     lams = cns = None

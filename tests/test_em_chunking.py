@@ -14,3 +14,31 @@ def test_chunked_em_identical(monkeypatch):
     lam_f, cn_f = ec._batched_em(d)
     np.testing.assert_allclose(lam_c, lam_f, rtol=1e-12)
     np.testing.assert_array_equal(cn_c, cn_f)
+
+
+def test_chunked_em_sharded_across_devices(monkeypatch):
+    """On a multi-device host the chunked EM shards the window axis
+    across all devices (pure SPMD) — results bit-identical to the
+    single-batch path. Runs on the suite's virtual 8-device CPU mesh."""
+    import jax
+
+    assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+    rng = np.random.default_rng(5)
+    d = rng.gamma(25, 1.2, size=(70, 6))
+    monkeypatch.setattr(ec, "EM_CHUNK", 16)  # 16 % 8 == 0 -> sharded
+    put_shardings = []
+    orig_put = jax.device_put
+
+    def spy(x, s=None):
+        put_shardings.append(s)
+        return orig_put(x) if s is None else orig_put(x, s)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+    lam_c, cn_c = ec._batched_em(d)
+    # the chunks really went up sharded over all 8 devices
+    assert any(s is not None and s.mesh.devices.size == 8
+               for s in put_shardings)
+    monkeypatch.setattr(ec, "EM_CHUNK", 10**9)
+    lam_f, cn_f = ec._batched_em(d)
+    np.testing.assert_allclose(lam_c, lam_f, rtol=1e-12)
+    np.testing.assert_array_equal(cn_c, cn_f)
