@@ -198,9 +198,17 @@ def run_indexcov(
     # 8-way parallel index load, mirroring indexcov.go:417-434
     import concurrent.futures as cf
 
+    def _load(p):
+        # corrupt/truncated index -> clean CLI error naming the file,
+        # not a traceback (the codecs' contract is typed ValueError)
+        try:
+            return SampleIndex(p)
+        except ValueError as e:
+            raise SystemExit(f"indexcov: {p}: {e}")
+
     with timer.stage("index_load"):
         with cf.ThreadPoolExecutor(max_workers=8) as ex:
-            idxs = list(ex.map(SampleIndex, bams))
+            idxs = list(ex.map(_load, bams))
             names = list(ex.map(get_short_name, bams))
     n_samples = len(idxs)
 

@@ -144,30 +144,44 @@ def read_bai(path_or_bytes) -> BaiIndex:
             (n_no_coor,) = struct.unpack_from("<Q", data, last_end)
         return BaiIndex(refs, n_no_coor)
 
-    # pure-Python fallback: eager parse
-    off = 4
-    (n_ref,) = struct.unpack_from("<i", data, off)
-    off += 4
-    refs = []
-    for _ in range(n_ref):
-        (n_bin,) = struct.unpack_from("<i", data, off)
+    # pure-Python fallback: eager parse. Corruption surfaces as the
+    # module's typed ValueError (same contract as the native scanner's
+    # negative codes) — struct/numpy errors from truncated or
+    # garbage-count bytes must not leak (tests/test_index_fuzz.py).
+    try:
+        off = 4
+        (n_ref,) = struct.unpack_from("<i", data, off)
         off += 4
-        bins_start = off
-        for _ in range(n_bin):
-            _bno, n_chunk = struct.unpack_from("<Ii", data, off)
-            off += 8 + 16 * n_chunk
-        bins, mapped, unmapped = _parse_bins(data, bins_start, off)
-        (n_intv,) = struct.unpack_from("<i", data, off)
-        off += 4
-        intervals = np.frombuffer(
-            data, dtype="<u8", count=n_intv, offset=off
-        ).copy()
-        off += 8 * n_intv
-        refs.append(RefIndex(bins, intervals, mapped, unmapped))
-    n_no_coor = 0
-    if off + 8 <= len(data):
-        (n_no_coor,) = struct.unpack_from("<Q", data, off)
-    return BaiIndex(refs, n_no_coor)
+        if n_ref < 0 or n_ref > 1_000_000:
+            raise ValueError(f"bai: implausible n_ref {n_ref}")
+        refs = []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", data, off)
+            off += 4
+            if n_bin < 0:
+                raise ValueError("bai: negative bin count")
+            bins_start = off
+            for _ in range(n_bin):
+                _bno, n_chunk = struct.unpack_from("<Ii", data, off)
+                if n_chunk < 0 or off + 8 + 16 * n_chunk > len(data):
+                    raise ValueError("bai: truncated bin chunks")
+                off += 8 + 16 * n_chunk
+            bins, mapped, unmapped = _parse_bins(data, bins_start, off)
+            (n_intv,) = struct.unpack_from("<i", data, off)
+            off += 4
+            if n_intv < 0 or off + 8 * n_intv > len(data):
+                raise ValueError("bai: truncated linear index")
+            intervals = np.frombuffer(
+                data, dtype="<u8", count=n_intv, offset=off
+            ).copy()
+            off += 8 * n_intv
+            refs.append(RefIndex(bins, intervals, mapped, unmapped))
+        n_no_coor = 0
+        if off + 8 <= len(data):
+            (n_no_coor,) = struct.unpack_from("<Q", data, off)
+        return BaiIndex(refs, n_no_coor)
+    except struct.error as e:
+        raise ValueError(f"bai: truncated index ({e})")
 
 
 def write_bai(idx: BaiIndex, path: str) -> None:

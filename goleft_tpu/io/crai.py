@@ -22,6 +22,7 @@ Behavioral contract reproduced from the reference
 from __future__ import annotations
 
 import gzip
+import zlib
 from dataclasses import dataclass
 
 import numpy as np
@@ -99,9 +100,19 @@ def read_crai(path_or_bytes) -> CraiIndex:
         with open(path_or_bytes, "rb") as fh:
             data = fh.read()
     if data[:2] == b"\x1f\x8b":
-        data = gzip.decompress(data)
+        # typed error surface: corrupt/truncated compressed bytes must
+        # come out as the module's ValueError, not raw zlib/EOF errors
+        # (pinned by tests/test_index_fuzz.py)
+        try:
+            data = gzip.decompress(data)
+        except (OSError, EOFError, zlib.error) as e:
+            raise ValueError(f"crai: corrupt gzip stream ({e})")
+    try:
+        text = data.decode()
+    except UnicodeDecodeError:
+        raise ValueError("crai: not a text index (bad utf-8)")
     slices: list[list[CraiSlice]] = []
-    for lineno, line in enumerate(data.decode().splitlines(), 1):
+    for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:
             continue
@@ -110,16 +121,30 @@ def read_crai(path_or_bytes) -> CraiIndex:
             raise ValueError(
                 f"crai: expected 6 fields, got {len(parts)} at line {lineno}"
             )
-        si = int(parts[0])
+        try:
+            vals = [int(p) for p in parts]
+        except ValueError:
+            raise ValueError(f"crai: non-integer field at line {lineno}")
+        si, aln_start, aln_span, cstart, sstart, slen = vals
         if si == -1:
             continue  # unmapped
-        aln_span = int(parts[2])
+        # bounds sanity: a corrupt/malicious line must not allocate an
+        # unbounded per-seqID list (DoS) or overflow later float math
+        if si < 0 or si > 1_000_000:
+            raise ValueError(f"crai: implausible seqID {si} at line "
+                             f"{lineno}")
+        if max(abs(cstart), abs(sstart), abs(slen)) > 2**62:
+            raise ValueError(f"crai: out-of-range field at line {lineno}")
+        if max(abs(aln_start), aln_span) > 2**40:
+            # genomic coordinates: anything past ~1e12 is corruption and
+            # would make _make_sizes extend an unbounded tile list
+            raise ValueError(f"crai: implausible genomic span at line "
+                             f"{lineno}")
         if aln_span < 0:
             break  # matches reference early-break on negative span
         while len(slices) <= si:
             slices.append([])
         slices[si].append(
-            CraiSlice(int(parts[1]), aln_span, int(parts[3]),
-                      int(parts[4]), int(parts[5]))
+            CraiSlice(aln_start, aln_span, cstart, sstart, slen)
         )
     return CraiIndex(slices)

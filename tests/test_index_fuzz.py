@@ -1,0 +1,148 @@
+"""Corrupt-index fuzz: mutated .bai/.crai bytes must produce a typed
+error or a clean parse — never a crash, hang, or unhandled low-level
+exception. Exercises the C bai_scan bounds checks and the Python
+fallbacks on the same bytes."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io.bai import build_bai, read_bai, write_bai
+from goleft_tpu.io import native
+from helpers import write_bam_and_bai, random_reads
+
+# the readers' contract: every corruption surfaces as ValueError
+OK_ERRORS = (ValueError,)
+
+
+@pytest.fixture(scope="module")
+def bai_bytes(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ixfuzz")
+    rng = np.random.default_rng(0)
+    p = str(d / "t.bam")
+    write_bam_and_bai(p, random_reads(rng, 2000, 0, 500_000),
+                      ref_names=("chr1", "chr2"),
+                      ref_lens=(500_000, 400_000))
+    return open(p + ".bai", "rb").read()
+
+
+def _mutations(data: bytes, rng, n: int):
+    for _ in range(n):
+        b = bytearray(data)
+        kind = rng.integers(0, 3)
+        if kind == 0:  # bit flip
+            i = int(rng.integers(0, len(b)))
+            b[i] ^= 1 << int(rng.integers(0, 8))
+        elif kind == 1:  # truncate
+            b = b[: int(rng.integers(0, len(b)))]
+        else:  # int splice: overwrite 4 bytes with an extreme value
+            i = int(rng.integers(0, max(len(b) - 4, 1)))
+            b[i : i + 4] = int(rng.choice(
+                [0x7FFFFFFF, 0xFFFFFFFF, 0x80000000])).to_bytes(
+                    4, "little")
+        yield bytes(b)
+
+
+def test_bai_fuzz_python_and_native(bai_bytes):
+    rng = np.random.default_rng(1)
+    survived = crashed_cleanly = 0
+    for mut in _mutations(bai_bytes, rng, 300):
+        try:
+            idx = read_bai(mut)
+            # a successful parse must still yield a usable structure
+            idx.sizes()
+            survived += 1
+        except OK_ERRORS:
+            crashed_cleanly += 1
+    assert survived + crashed_cleanly == 300
+    assert crashed_cleanly > 0, "no mutation was ever detected"
+
+
+def test_bai_scan_native_fuzz(bai_bytes):
+    """The C scanner itself: must return n_ref or a negative error for
+    any mutation (ctypes wrapper raises ValueError on negatives)."""
+    if native.get_lib() is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(2)
+    for mut in _mutations(bai_bytes, rng, 300):
+        try:
+            native.bai_scan(np.frombuffer(mut, dtype=np.uint8))
+        except OK_ERRORS:
+            pass
+
+
+def test_crai_fuzz(tmp_path):
+    from goleft_tpu.io.crai import read_crai
+
+    lines = "".join(
+        f"{tid}\t{s}\t{s + 999}\t{1000 + s}\t0\t500\n"
+        for tid in (0, 1) for s in range(0, 50_000, 1000)
+    )
+    data = gzip.compress(lines.encode())
+    rng = np.random.default_rng(3)
+    survived = rejected = 0
+    for mut in _mutations(data, rng, 200):
+        try:
+            read_crai(mut).sizes()
+            survived += 1
+        except OK_ERRORS:
+            rejected += 1
+    assert survived + rejected == 200
+    assert rejected > 0
+
+
+def test_indexcov_cli_corrupt_crai_clean_error(tmp_path, capsys):
+    """A corrupt .crai through the indexcov CLI exits with a clean
+    'indexcov: <file>: crai: ...' message, not a traceback."""
+    from goleft_tpu.commands.indexcov import run_indexcov
+
+    bad = str(tmp_path / "bad.crai")
+    with open(bad, "wb") as fh:
+        fh.write(gzip.compress(b"0\t0\t999\t100\t0\t50\n")[:20])
+    fai = str(tmp_path / "r.fa.fai")
+    with open(fai, "w") as fh:
+        fh.write("chr1\t100000\t6\t60\t61\n")
+    with pytest.raises(SystemExit) as ei:
+        run_indexcov([bad], directory=str(tmp_path / "o"), fai=fai,
+                     sex="")
+    msg = str(ei.value)
+    assert msg.startswith("indexcov: ") and "bad.crai" in msg
+    assert "crai:" in msg
+
+
+def test_bai_python_fallback_fuzz(bai_bytes, monkeypatch):
+    """The pure-Python parser (hosts without the native lib) honors the
+    same ValueError-only contract on the same mutations."""
+    import goleft_tpu.io.native as native_mod
+
+    # read_bai resolves native.bai_scan at call time, so this routes
+    # every parse through the pure-Python branch
+    monkeypatch.setattr(native_mod, "bai_scan", lambda *_: None)
+    rng = np.random.default_rng(4)
+    survived = rejected = 0
+    for mut in _mutations(bai_bytes, rng, 300):
+        try:
+            read_bai(mut).sizes()
+            survived += 1
+        except OK_ERRORS:
+            rejected += 1
+    assert survived + rejected == 300
+    assert rejected > 0
+
+
+def test_crai_hostile_lines_bounded():
+    """Hand-crafted hostile lines (huge seqID / span) must raise the
+    typed error promptly instead of allocating unbounded lists — the
+    random fuzz can't reach these because gzip CRC rejects most
+    mutations."""
+    import pytest
+
+    from goleft_tpu.io.crai import read_crai
+
+    for line in (b"99999999999\t0\t1\t0\t0\t1\n",          # huge seqID
+                 b"0\t0\t" + str(2**50).encode() + b"\t0\t0\t1\n",
+                 b"0\t" + str(10**400).encode() + b"\t1\t0\t0\t1\n",
+                 b"0\tx\t1\t0\t0\t1\n"):                    # non-int
+        with pytest.raises(ValueError):
+            read_crai(gzip.compress(line)).sizes()
