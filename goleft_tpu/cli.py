@@ -100,7 +100,14 @@ def main(argv: list[str] | None = None) -> int:
     if PROGS[prog][2]:
         devices_with_watchdog()
     sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
-    ret = PROGS[prog][1](argv[1:])
+    try:
+        ret = PROGS[prog][1](argv[1:])
+    except ValueError as e:
+        # every parser raises typed ValueError on corrupt input (bai/
+        # crai/fai/bed/bam/cram contract); the CLI surfaces it as one
+        # clean line, never a traceback
+        print(f"goleft-tpu {prog}: {e}", file=sys.stderr)
+        return 1
     return int(ret or 0)
 
 

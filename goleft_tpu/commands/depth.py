@@ -56,12 +56,22 @@ def gen_regions(
     if bed:
         out = []
         with xopen(bed) as fh:
-            for line in fh:
+            for lineno, line in enumerate(fh, 1):
                 line = line.strip()
                 if not line or line.startswith(("#", "track")):
                     continue
                 t = line.split("\t")
-                out.append((t[0], max(int(t[1]), 0), int(t[2])))
+                if len(t) < 3:
+                    raise ValueError(
+                        f"{bed}:{lineno}: bed line needs chrom/start/"
+                        f"end, got {len(t)} fields"
+                    )
+                try:
+                    out.append((t[0], max(int(t[1]), 0), int(t[2])))
+                except ValueError:
+                    raise ValueError(
+                        f"{bed}:{lineno}: non-integer bed coordinate"
+                    )
         return out
     step = max(1, STEP // window) * window
     out = []

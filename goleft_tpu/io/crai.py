@@ -130,7 +130,10 @@ def read_crai(path_or_bytes) -> CraiIndex:
             continue  # unmapped
         # bounds sanity: a corrupt/malicious line must not allocate an
         # unbounded per-seqID list (DoS) or overflow later float math
-        if si < 0 or si > 1_000_000:
+        if si < 0 or si > 2**24:
+            # 16.7M references bounds the per-seqID list at ~1GB worst
+            # case while clearing every real assembly (largest public
+            # ones are ~5M scaffolds); beyond that is corruption/DoS
             raise ValueError(f"crai: implausible seqID {si} at line "
                              f"{lineno}")
         if max(abs(cstart), abs(sstart), abs(slen)) > 2**62:

@@ -24,13 +24,19 @@ class FaiRecord:
 def read_fai(path: str) -> list[FaiRecord]:
     out = []
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.rstrip("\n")
             if not line:
                 continue
             f = line.split("\t")
-            out.append(FaiRecord(f[0], int(f[1]), int(f[2]), int(f[3]),
-                                 int(f[4])))
+            try:
+                out.append(FaiRecord(f[0], int(f[1]), int(f[2]),
+                                     int(f[3]), int(f[4])))
+            except (ValueError, IndexError):
+                raise ValueError(
+                    f"{path}:{lineno}: not a .fai line (need name + 4 "
+                    f"integer fields)"
+                )
     return out
 
 

@@ -472,8 +472,12 @@ def bai_scan(data):
     buf = _as_u8(data)
     if len(buf) < 8:
         raise ValueError("bai: truncated or corrupt index (-2)")
-    # exact allocation: the header carries n_ref up front
+    # exact allocation: the header carries n_ref up front. Bound by
+    # what the bytes could possibly hold (every reference costs >= 8
+    # bytes), so a corrupt header cannot demand a multi-GB allocation —
+    # genuinely oversized counts then fail in C with -3 (over max_ref)
     max_ref = max(int(np.frombuffer(buf[4:8], "<i4")[0]), 0)
+    max_ref = min(max_ref, len(buf) // 8 + 1)
     arrs = {k: np.empty(max_ref, np.int64)
             for k in ("bins_start", "bins_end", "n_intv", "intv_off",
                       "mapped", "unmapped")}

@@ -146,3 +146,41 @@ def test_crai_hostile_lines_bounded():
                  b"0\tx\t1\t0\t0\t1\n"):                    # non-int
         with pytest.raises(ValueError):
             read_crai(gzip.compress(line)).sizes()
+
+
+def test_text_parsers_typed_errors(tmp_path):
+    """Corrupt .fai and .bed inputs surface as ValueError with
+    file:line context — never IndexError/raw int() messages."""
+    import pytest
+
+    from goleft_tpu.commands.depth import gen_regions
+    from goleft_tpu.io.fai import read_fai
+
+    fai = str(tmp_path / "bad.fai")
+    open(fai, "w").write("chr1\tnotanint\t6\t60\t61\n")
+    with pytest.raises(ValueError, match=r"bad\.fai:1: not a \.fai"):
+        read_fai(fai)
+    open(fai, "w").write("chr1\t100\n")
+    with pytest.raises(ValueError, match=r"bad\.fai:1"):
+        read_fai(fai)
+
+    bed = str(tmp_path / "bad.bed")
+    open(bed, "w").write("chr1\t100\n")
+    with pytest.raises(ValueError, match=r"bad\.bed:1: bed line"):
+        gen_regions([], "", 500, bed)
+    open(bed, "w").write("# ok\nchr1\tx\ty\n")
+    with pytest.raises(ValueError, match=r"bad\.bed:2: non-integer"):
+        gen_regions([], "", 500, bed)
+
+
+def test_cli_valueerror_clean_surface(tmp_path, capsys):
+    """The dispatcher converts any parser ValueError into one clean
+    stderr line + exit 1 — corrupt fai through the full CLI."""
+    from goleft_tpu.cli import main as cli_main
+
+    fai = str(tmp_path / "bad.fai")
+    open(fai, "w").write("chr1\tnope\t6\t60\t61\n")
+    rc = cli_main(["depthwed", "-s", "500", fai])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "goleft-tpu depthwed:" in err and "Traceback" not in err
