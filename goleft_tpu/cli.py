@@ -103,9 +103,15 @@ def main(argv: list[str] | None = None) -> int:
     try:
         ret = PROGS[prog][1](argv[1:])
     except ValueError as e:
-        # every parser raises typed ValueError on corrupt input (bai/
-        # crai/fai/bed/bam/cram contract); the CLI surfaces it as one
-        # clean line, never a traceback
+        # the io parsers raise typed ValueError on corrupt input (bai/
+        # crai/fai/bed contract; bam/cram convert to SystemExit in
+        # open_bam_file) — surface it as one clean line. The cost: a
+        # ValueError from a genuine bug is masked as bad input, so
+        # GOLEFT_TPU_DEBUG=1 re-raises with the full traceback.
+        import os
+
+        if os.environ.get("GOLEFT_TPU_DEBUG"):
+            raise
         print(f"goleft-tpu {prog}: {e}", file=sys.stderr)
         return 1
     return int(ret or 0)

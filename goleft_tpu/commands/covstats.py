@@ -225,58 +225,83 @@ HEADER = ("coverage\tinsert_mean\tinsert_sd\tinsert_5th\tinsert_95th\t"
           "pct_duplicate\tpct_proper_pair\tread_length\tbam\tsample")
 
 
+def _stats_one(path: str, n: int, skip: int,
+               region_bases_total: int | None):
+    """Full stats for one file — independent of every other file, so
+    the driver can fan these out across decode threads."""
+    # lazy native handle: the compressed file is mmapped and only the
+    # decode window is ever inflated, so peak RSS is O(window + n)
+    # regardless of file size — matching the reference's streaming
+    # record loop (covstats.go:122-220) instead of round 1's eager
+    # whole-file inflate
+    handle = open_bam_file(path, lazy=True)
+    names = ",".join(handle.header.sample_names()) or \
+        "<no-read-groups>"
+    acc = BamStatsAccumulator(n, skip)
+    for cols in handle.stream_columns():
+        acc.update(cols)
+        if acc.done:
+            break
+    st = acc.finalize()
+
+    genome_bases = sum(handle.header.ref_lens)
+    mapped = 0
+    # mapped totals come from the .bai; the reference does the same
+    # and only for ".bam" paths (covstats.go:238-249), so CRAM input
+    # reports coverage 0.00 there too — deliberate parity
+    if not getattr(handle, "is_cram", False):
+        try:
+            import os
+
+            bai_path = path + ".bai" if os.path.exists(path + ".bai") \
+                else path[:-4] + ".bai"
+            mapped = read_bai(bai_path).mapped_total
+        except (OSError, ValueError):
+            pass
+    if region_bases_total is not None:
+        genome_bases = region_bases_total
+    coverage = ((1 - st["prop_bad"]) * mapped * st["read_len_mean"]
+                / max(genome_bases, 1))
+    st.update(coverage=coverage, bam=path, sample=names)
+    return st
+
+
 def run_covstats(bams: list[str], n: int = 1_000_000,
                  regions: str | None = None, skip: int = SKIP_READS,
-                 out=None) -> list[dict]:
+                 out=None, processes: int = 4) -> list[dict]:
     import sys
 
     out = out or sys.stdout
     out.write(HEADER + "\n")
     results = []
-    for path in bams:
-        # lazy native handle: the compressed file is mmapped and only the
-        # decode window is ever inflated, so peak RSS is O(window + n)
-        # regardless of file size — matching the reference's streaming
-        # record loop (covstats.go:122-220) instead of round 1's eager
-        # whole-file inflate
-        handle = open_bam_file(path, lazy=True)
-        names = ",".join(handle.header.sample_names()) or \
-            "<no-read-groups>"
-        acc = BamStatsAccumulator(n, skip)
-        for cols in handle.stream_columns():
-            acc.update(cols)
-            if acc.done:
-                break
-        st = acc.finalize()
+    # the target-region total is the same for every file: parse once
+    rb_total = region_bases(regions) if regions else None
+    # files are independent: fan the sampling across decode threads
+    # (native decode releases the GIL); ex.map preserves input order so
+    # rows print exactly as the sequential loop would. Beyond-reference:
+    # the Go tool samples files one after another (covstats.go:251-262)
+    import concurrent.futures as cf
 
-        genome_bases = sum(handle.header.ref_lens)
-        mapped = 0
-        # mapped totals come from the .bai; the reference does the same
-        # and only for ".bam" paths (covstats.go:238-249), so CRAM input
-        # reports coverage 0.00 there too — deliberate parity
-        if not getattr(handle, "is_cram", False):
-            try:
-                import os
-
-                bai_path = path + ".bai" if os.path.exists(path + ".bai") \
-                    else path[:-4] + ".bai"
-                mapped = read_bai(bai_path).mapped_total
-            except (OSError, ValueError):
-                pass
-        if regions:
-            genome_bases = region_bases(regions)
-        coverage = ((1 - st["prop_bad"]) * mapped * st["read_len_mean"]
-                    / max(genome_bases, 1))
-        st.update(coverage=coverage, bam=path, sample=names)
-        results.append(st)
-        out.write(
-            f"{coverage:.2f}\t{st['insert_mean']:.2f}\t{st['insert_sd']:.2f}"
-            f"\t{st['insert_5']}\t{st['insert_95']}"
-            f"\t{st['template_mean']:.2f}\t{st['template_sd']:.2f}"
-            f"\t{100 * st['prop_unmapped']:.2f}\t{100 * st['prop_bad']:.1f}"
-            f"\t{100 * st['prop_dup']:.1f}\t{100 * st['prop_proper']:.1f}"
-            f"\t{st['max_read_len']}\t{path}\t{names}\n"
-        )
+    with cf.ThreadPoolExecutor(
+        max_workers=max(1, min(processes, len(bams)))
+    ) as ex:
+        stats_iter = ex.map(
+            lambda p: _stats_one(p, n, skip, rb_total), bams)
+        for st in stats_iter:
+            results.append(st)
+            path, names = st["bam"], st["sample"]
+            coverage = st["coverage"]
+            out.write(
+                f"{coverage:.2f}\t{st['insert_mean']:.2f}"
+                f"\t{st['insert_sd']:.2f}"
+                f"\t{st['insert_5']}\t{st['insert_95']}"
+                f"\t{st['template_mean']:.2f}\t{st['template_sd']:.2f}"
+                f"\t{100 * st['prop_unmapped']:.2f}"
+                f"\t{100 * st['prop_bad']:.1f}"
+                f"\t{100 * st['prop_dup']:.1f}"
+                f"\t{100 * st['prop_proper']:.1f}"
+                f"\t{st['max_read_len']}\t{path}\t{names}\n"
+            )
     return results
 
 
@@ -293,9 +318,13 @@ def main(argv=None):
                    help="reference fasta (accepted for reference-CLI "
                         "parity; CRAM decode here never reconstructs "
                         "bases, so it is not required)")
+    p.add_argument("-p", "--processes", type=int, default=4,
+                   help="files sampled in parallel (decode threads; "
+                        "output order is unchanged)")
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
-    run_covstats(a.bams, n=a.n, regions=a.regions)
+    run_covstats(a.bams, n=a.n, regions=a.regions,
+                 processes=a.processes)
 
 
 if __name__ == "__main__":

@@ -489,6 +489,12 @@ def bai_scan(data):
     )
     if n == -1:
         raise ValueError("not a BAI file (bad magic)")
+    if n == -3:
+        # same diagnostic as the pure-Python fallback's byte-derived
+        # n_ref bound: the header claims more references than the
+        # bytes could hold
+        raise ValueError("bai: implausible n_ref (over what the bytes "
+                         "can hold)")
     if n < 0:
         raise ValueError(f"bai: truncated or corrupt index ({n})")
     return {k: v[:n] for k, v in arrs.items()}

@@ -141,3 +141,32 @@ def test_malformed_block_size_is_distinct_error(tmp_path):
     body2 = native.bgzf_inflate(d2, native.bgzf_scan(d2)[2])
     with pytest.raises(ValueError, match="malformed BAM record geometry"):
         native.bam_decode(body2, 0, -1, 0, -1)
+
+
+def test_covstats_parallel_matches_serial(tmp_path):
+    """processes=4 fans files across decode threads; output must be
+    byte-identical to the sequential loop (ex.map preserves order)."""
+    import io
+
+    import numpy as np
+
+    from goleft_tpu.commands.covstats import run_covstats
+    from helpers import write_bam_and_bai
+    rng = np.random.default_rng(9)
+    bams = []
+    for i in range(5):
+        reads = []
+        pos = 0
+        for j in range(400):
+            pos += int(rng.integers(1, 50))
+            flag = 0x63 if j % 2 == 0 else 0x93  # proper paired
+            reads.append((0, pos, "100M", 60, flag))
+        p = str(tmp_path / f"v{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(100_000,))
+        bams.append(p)
+    a, b = io.StringIO(), io.StringIO()
+    run_covstats(bams, n=200, skip=0, out=a, processes=1)
+    run_covstats(bams, n=200, skip=0, out=b, processes=4)
+    assert a.getvalue() == b.getvalue()
+    assert len(a.getvalue().splitlines()) == 6

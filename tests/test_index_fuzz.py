@@ -180,7 +180,16 @@ def test_cli_valueerror_clean_surface(tmp_path, capsys):
 
     fai = str(tmp_path / "bad.fai")
     open(fai, "w").write("chr1\tnope\t6\t60\t61\n")
-    rc = cli_main(["depthwed", "-s", "500", fai])
+    # cohortdepth validates the fai BEFORE opening any BAM, so the
+    # nonexistent bam never matters and the error IS read_fai's
+    import os
+
+    os.environ["GOLEFT_TPU_CPU"] = "1"
+    try:
+        rc = cli_main(["cohortdepth", "--fai", fai, "missing.bam"])
+    finally:
+        del os.environ["GOLEFT_TPU_CPU"]
     err = capsys.readouterr().err
     assert rc == 1
-    assert "goleft-tpu depthwed:" in err and "Traceback" not in err
+    assert "goleft-tpu cohortdepth:" in err
+    assert "not a .fai line" in err and "Traceback" not in err
