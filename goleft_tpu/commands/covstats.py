@@ -282,12 +282,13 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
     # the Go tool samples files one after another (covstats.go:251-262)
     import concurrent.futures as cf
 
-    with cf.ThreadPoolExecutor(
-        max_workers=max(1, min(processes, len(bams)))
-    ) as ex:
-        stats_iter = ex.map(
-            lambda p: _stats_one(p, n, skip, rb_total), bams)
-        for st in stats_iter:
+    ex = cf.ThreadPoolExecutor(
+        max_workers=max(1, min(processes, len(bams))))
+    try:
+        futures = [ex.submit(_stats_one, p, n, skip, rb_total)
+                   for p in bams]
+        for f in futures:  # input order; failures abort promptly
+            st = f.result()
             results.append(st)
             path, names = st["bam"], st["sample"]
             coverage = st["coverage"]
@@ -302,6 +303,12 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
                 f"\t{100 * st['prop_proper']:.1f}"
                 f"\t{st['max_read_len']}\t{path}\t{names}\n"
             )
+    except BaseException:
+        # one corrupt file must not keep sampling the rest of a large
+        # queued cohort before the error reaches the user
+        ex.shutdown(wait=False, cancel_futures=True)
+        raise
+    ex.shutdown(wait=True)
     return results
 
 

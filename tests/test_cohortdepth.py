@@ -217,3 +217,49 @@ def test_cohort_regions_splits_large_bed_intervals(monkeypatch,
         with pytest.raises(SystemExit, match="no usable intervals"):
             run_cohortdepth(["unused.bam"], fai=fai,
                             window=500, out=_io.StringIO(), bed=bf.name)
+
+
+def test_cohortdepth_mixed_bam_cram_cohort(tmp_path):
+    """A cohort mixing BAM and CRAM inputs produces the same matrix as
+    the all-BAM cohort (the CRAM twin carries identical reads); mixed
+    cohorts route through the device engine (CRAM handles have no
+    native fused reduce) and values stay byte-identical."""
+    from goleft_tpu.io.cram import M_GZIP, CramWriter
+    from goleft_tpu.io.bam import parse_cigar
+
+    rng = np.random.default_rng(21)
+    ref_len = 25_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+
+    cohort_reads = []
+    bams = []
+    for i in range(3):
+        starts = np.sort(rng.integers(0, ref_len - 100, size=700))
+        reads = [(0, int(s), "100M", 60, 0) for s in starts]
+        cohort_reads.append(reads)
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:mx{i}\n")
+        p = str(tmp_path / f"mx{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,), header_text=hdr)
+        bams.append(p)
+
+    # CRAM twin of sample 1
+    cram_p = str(tmp_path / "mx1.cram")
+    hdr = ("@HD\tVN:1.6\tSO:coordinate\n@RG\tID:r\tSM:mx1\n")
+    with open(cram_p, "wb") as fh:
+        with CramWriter(fh, hdr, ["chr1"], [ref_len],
+                        records_per_container=300,
+                        block_method=M_GZIP) as w:
+            for i, (tid, pos, cig, mq, fl) in enumerate(cohort_reads[1]):
+                w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
+                               flag=fl, name=f"r{i:05d}")
+        w.write_crai(cram_p + ".crai")
+
+    all_bam = io.StringIO()
+    run_cohortdepth(bams, reference=fa, window=500, out=all_bam)
+    mixed = io.StringIO()
+    run_cohortdepth([bams[0], cram_p, bams[2]], reference=fa,
+                    window=500, out=mixed)
+    assert mixed.getvalue() == all_bam.getvalue()

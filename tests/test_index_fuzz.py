@@ -173,22 +173,17 @@ def test_text_parsers_typed_errors(tmp_path):
         gen_regions([], "", 500, bed)
 
 
-def test_cli_valueerror_clean_surface(tmp_path, capsys):
+def test_cli_valueerror_clean_surface(tmp_path, capsys, monkeypatch):
     """The dispatcher converts any parser ValueError into one clean
     stderr line + exit 1 — corrupt fai through the full CLI."""
     from goleft_tpu.cli import main as cli_main
 
+    monkeypatch.setenv("GOLEFT_TPU_CPU", "1")
     fai = str(tmp_path / "bad.fai")
     open(fai, "w").write("chr1\tnope\t6\t60\t61\n")
+    rc = cli_main(["cohortdepth", "--fai", fai, "missing.bam"])
     # cohortdepth validates the fai BEFORE opening any BAM, so the
     # nonexistent bam never matters and the error IS read_fai's
-    import os
-
-    os.environ["GOLEFT_TPU_CPU"] = "1"
-    try:
-        rc = cli_main(["cohortdepth", "--fai", fai, "missing.bam"])
-    finally:
-        del os.environ["GOLEFT_TPU_CPU"]
     err = capsys.readouterr().err
     assert rc == 1
     assert "goleft-tpu cohortdepth:" in err
