@@ -160,13 +160,16 @@ def cohort_matrix_blocks(
     ]
     S = len(handles)
 
+    def _fused(h):
+        # BamFile with the native lib, or a CRAM handle (its
+        # window_reduce is Python-orchestrated over the C codec ports)
+        return getattr(h, "native", False) or getattr(h, "is_cram",
+                                                      False)
+
     if engine == "auto":
-        engine = "hybrid" if all(
-            getattr(h, "native", False) for h in handles
-        ) else "device"
-    if engine == "hybrid" and not all(
-        getattr(h, "native", False) for h in handles
-    ):
+        engine = "hybrid" if all(_fused(h) for h in handles) \
+            else "device"
+    if engine == "hybrid" and not all(_fused(h) for h in handles):
         raise SystemExit("cohortdepth: engine=hybrid needs the native io")
 
     # multi-chip: shard the sample axis across all devices (data
@@ -215,6 +218,9 @@ def cohort_matrix_blocks(
         n_win_r = length_r // window
         if tid < 0:
             return np.zeros(n_win_r, np.int64)
+        if bai is None:  # CRAM handle: .crai-driven access inside
+            return h.window_reduce(tid, s, e, w0, length_r, window,
+                                   int(cap), mapq, 0x704)
         voff = query_voffset(bai, tid, s)
         if voff is None:
             return np.zeros(n_win_r, np.int64)

@@ -1457,6 +1457,46 @@ class CramFile:
             recs = self._all_records
         return _records_to_columns(recs, tid, start, e)
 
+    def window_reduce(self, tid, start, end, w0, length, window,
+                      depth_cap, min_mapq, flag_mask, voffset=None,
+                      delta_scratch=None, **_ignored):
+        """Fused decode + per-window depth sums for one region — the
+        numpy equivalent of csrc/fastio.cpp::bam_window_reduce's dense
+        path (M/=/X segments of records passing mapq/flag filters,
+        clipped to [start, end) and [w0, w0+length), per-base depth
+        capped at depth_cap, summed per window). Lets the cohort
+        hybrid engine treat a CRAM handle like a native BAM handle:
+        Python-orchestrated (the record decode already rides the C
+        codec ports) but identical output.
+        """
+        del voffset  # CRAM random access rides the .crai
+        if length % window:
+            raise ValueError("length must be a multiple of window")
+        cols = self.read_columns(tid=tid, start=start, end=end)
+        wsums = np.zeros(length // window, dtype=np.int64)
+        if cols.n_reads == 0:
+            return wsums
+        keep = ((cols.mapq.astype(np.int32) >= min_mapq)
+                & ((cols.flag.astype(np.int32) & flag_mask) == 0))
+        segk = keep[cols.seg_read]
+        s = cols.seg_start[segk].astype(np.int64)
+        e = cols.seg_end[segk].astype(np.int64)
+        np.clip(s, start, end, out=s)
+        np.clip(e, start, end, out=e)
+        s -= w0
+        e -= w0
+        np.clip(s, 0, length, out=s)
+        np.clip(e, 0, length, out=e)
+        m = e > s
+        if not m.any():
+            return wsums
+        delta = np.zeros(length + 1, dtype=np.int64)
+        np.add.at(delta, s[m], 1)
+        np.add.at(delta, e[m], -1)
+        depth = np.cumsum(delta[:length])
+        np.minimum(depth, depth_cap, out=depth)
+        return depth.reshape(-1, window).sum(axis=1)
+
     def stream_columns(self, window_bytes: int = 0, chunk_records: int = 0):
         """Per-container column chunks (bounded by container size)."""
         for hdr, body in self._iter_containers():

@@ -263,3 +263,47 @@ def test_cohortdepth_mixed_bam_cram_cohort(tmp_path):
     run_cohortdepth([bams[0], cram_p, bams[2]], reference=fa,
                     window=500, out=mixed)
     assert mixed.getvalue() == all_bam.getvalue()
+
+
+def test_cram_hybrid_engine_matches_device(tmp_path):
+    """CramFile.window_reduce lets the hybrid engine accept CRAM
+    handles: a CRAM-containing cohort stays on the fused per-sample
+    path (auto no longer falls back to the device engine) and every
+    engine produces the identical matrix."""
+    from goleft_tpu.io.bam import parse_cigar
+    from goleft_tpu.io.cram import M_GZIP, CramWriter
+
+    rng = np.random.default_rng(33)
+    ref_len = 30_000
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    write_fai(fa)
+
+    paths = []
+    for i in range(2):
+        starts = np.sort(rng.integers(0, ref_len - 100, size=900))
+        # mixed flags/mapq exercise the filter parity
+        reads = [(0, int(s), "100M",
+                  int(rng.integers(0, 70)),
+                  0x400 if rng.random() < 0.1 else 0)
+                 for s in starts]
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@RG\tID:r\tSM:cr{i}\n")
+        p = str(tmp_path / f"cr{i}.cram")
+        with open(p, "wb") as fh:
+            with CramWriter(fh, hdr, ["chr1"], [ref_len],
+                            records_per_container=250,
+                            block_method=M_GZIP) as w:
+                for j, (tid, pos, cig, mq, fl) in enumerate(reads):
+                    w.write_record(tid, pos, parse_cigar(cig), mapq=mq,
+                                   flag=fl, name=f"r{j:05d}")
+            w.write_crai(p + ".crai")
+        paths.append(p)
+
+    outs = {}
+    for engine in ("auto", "hybrid", "device"):
+        buf = io.StringIO()
+        run_cohortdepth(paths, reference=fa, window=500, out=buf,
+                        engine=engine)
+        outs[engine] = buf.getvalue()
+    assert outs["auto"] == outs["hybrid"] == outs["device"]
+    assert len(outs["auto"].splitlines()) == ref_len // 500 + 1
