@@ -31,10 +31,15 @@ kernels + rooflines, the cohort e2e headline, BASELINE configs 4-5
 a 2504-sample matrix) and the host-side entries (indexcov CLI e2e,
 decode thread scaling, CRAM 3.1 codec decode). ``--kernels-only``
 skips everything but the device kernels + cohort headline for fast
-iteration; without a usable accelerator the run falls back to
-``--suite-host`` (host-only entries, honestly labeled).
+iteration. Without a usable accelerator the run records the host
+portfolio FIRST (in a child process), then re-probes with backoff
+spread across the run; every probe attempt is recorded in the
+``device_probe`` block so "tunnel down" is distinguishable from
+"device path regressed". On a successful probe the device kernels are
+captured immediately (salvage ordering) before the longer suite.
 
 Usage: python bench.py [--quick] [--kernels-only] [--suite-host]
+       [--no-probe]
 """
 
 from __future__ import annotations
@@ -248,122 +253,145 @@ def _merge_details(details: dict) -> dict:
     prev.update(details)
     with open("BENCH_details.json", "w") as fh:
         json.dump(prev, fh, indent=1)
-    for k, v in prev.items():
-        print(f"{k}: {v}", file=sys.stderr)
+    for k in details:  # echo only what this call merged (incremental
+        print(f"{k}: {prev[k]}", file=sys.stderr)  # emit calls many)
     return prev
 
 
-def bench_suite(quick: bool) -> dict:
-    """Cohort-scale secondary benchmarks (BASELINE.md configs 3-5)."""
+def bench_suite(quick: bool, emit=None) -> dict:
+    """Cohort-scale secondary benchmarks (BASELINE.md configs 3-5).
+
+    Each entry is computed in its own guarded section and handed to
+    ``emit`` (the incremental BENCH_details merger) AS SOON as it
+    exists — a tunnel wedge mid-suite loses only the entry in flight,
+    not the portfolio (round-3 VERDICT item 1)."""
     import jax
 
     from goleft_tpu.ops import indexcov_ops as ic
     from goleft_tpu.models.emdepth import em_depth_batch, cn_batch
 
     out = {}
+
+    def _rec(key, fn):
+        try:
+            v = fn()
+        except Exception as e:  # noqa: BLE001 — keep other entries
+            v = {"error": repr(e)}
+        out[key] = v
+        if emit:
+            emit({key: v})
+        return v
+
     rng = np.random.default_rng(0)
 
     reps = 3  # fresh inputs per timing (repeat-call timings are
     # unreliable over the dev tunnel); a scalar fetch forces completion
 
-    # indexcov: 500 samples x ~190k tiles (whole genome at 16KB)
-    n_samples = 100 if quick else 500
-    n_tiles = 30_000 if quick else 190_000
-    mats = [
-        jax.device_put(
-            rng.gamma(20, 0.05, size=(n_samples, n_tiles)).astype(
-                np.float32
+    def _indexcov_cohort():
+        # indexcov: 500 samples x ~190k tiles (whole genome at 16KB)
+        n_samples = 100 if quick else 500
+        n_tiles = 30_000 if quick else 190_000
+        mats = [
+            jax.device_put(
+                rng.gamma(20, 0.05, size=(n_samples, n_tiles)).astype(
+                    np.float32
+                )
             )
+            for _ in range(reps + 1)
+        ]
+        v = jax.device_put(np.ones((n_samples, n_tiles), dtype=bool))
+
+        def qc(d):
+            rocs = ic.counts_roc(ic.counts_at_depth(d, v))
+            cnt = ic.bin_counters(d, v, np.int32(n_tiles))
+            cn = ic.get_cn(d, v)
+            return (float(rocs.sum()) + float(cnt["in"].sum())
+                    + float(cn.sum()))
+
+        qc(mats[0])  # compile
+        t0 = time.perf_counter()
+        for r in range(reps):
+            qc(mats[r + 1])
+        dt = (time.perf_counter() - t0) / reps
+        return {
+            "samples": n_samples, "tiles": n_tiles,
+            "seconds": round(dt, 4),
+            "samples_per_sec": round(n_samples / dt, 1),
+            "note": "hist+ROC+counters+CN on device (excl. index "
+                    "parse)",
+            "roofline": roofline(
+                # fused QC reads the (S,T) f32 matrix + bool mask twice
+                # (hist/ROC binning pass, counters/CN pass); outputs
+                # are O(S) and negligible
+                bytes_moved=n_samples * n_tiles * (4 + 1) * 2,
+                seconds=dt,
+                model="2 passes over (samples x tiles) f32 matrix + "
+                      "bool mask; O(samples) outputs ignored",
+            ),
+        }
+
+    _rec("indexcov_cohort", _indexcov_cohort)
+
+    def _indexcov_e2e():
+        # indexcov END-TO-END at the reference's headline scale
+        # (README: "30 samples x 60X WGS in ~30s"): fabricated
+        # whole-genome .bai files through the full CLI path incl.
+        # bed.gz/ped/roc/html/png
+        import shutil
+        import tempfile
+
+        from goleft_tpu.commands.indexcov import (
+            SampleIndex, run_indexcov,
         )
-        for _ in range(reps + 1)
-    ]
-    v = jax.device_put(np.ones((n_samples, n_tiles), dtype=bool))
 
-    def qc(d):
-        rocs = ic.counts_roc(ic.counts_at_depth(d, v))
-        cnt = ic.bin_counters(d, v, np.int32(n_tiles))
-        cn = ic.get_cn(d, v)
-        return float(rocs.sum()) + float(cnt["in"].sum()) + float(cn.sum())
+        d = tempfile.mkdtemp(prefix="goleft_ixc_")
+        n_ix = 10 if quick else 30
+        chrom_lens = [int(2.5e8 * (1 - i * 0.03)) for i in range(25)]
+        bais = _fabricate_bai_cohort(d, n_ix, chrom_lens, rng)
+        run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
+                     exclude_patt="", sex="")  # compile warmup
+        t0 = time.perf_counter()
+        run_indexcov(bais, directory=f"{d}/out", fai=f"{d}/ref.fa.fai",
+                     exclude_patt="", sex="")
+        dt = time.perf_counter() - t0
+        # stage breakdown by differencing feature-toggled runs:
+        # parse-only, core (parse+QC+bed+roc+ped), +html, +png
+        t0 = time.perf_counter()
+        for b in bais:
+            SampleIndex(b)
+        t_parse = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run_indexcov(bais, directory=f"{d}/o2", fai=f"{d}/ref.fa.fai",
+                     exclude_patt="", sex="", write_html=False,
+                     write_png=False)
+        t_core = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run_indexcov(bais, directory=f"{d}/o3", fai=f"{d}/ref.fa.fai",
+                     exclude_patt="", sex="", write_png=False)
+        t_html = time.perf_counter() - t0
+        shutil.rmtree(d, ignore_errors=True)
+        return {
+            "samples": n_ix, "chromosomes": 25,
+            "genome_gb": round(sum(chrom_lens) / 1e9, 2),
+            "seconds_warm": round(dt, 2),
+            "stage_seconds": {
+                "bai_parse": round(t_parse, 2),
+                "qc_bed_roc_ped": round(t_core - t_parse, 2),
+                "html": round(t_html - t_core, 2),
+                "png": round(dt - t_html, 2),
+            },
+            "note": "full CLI path: .bai parse -> device QC -> "
+                    "bed.gz/ped/roc/html/png; reference README cites "
+                    "~30s for 30 samples",
+        }
 
-    qc(mats[0])  # compile
-    t0 = time.perf_counter()
-    for r in range(reps):
-        qc(mats[r + 1])
-    dt = (time.perf_counter() - t0) / reps
-    out["indexcov_cohort"] = {
-        "samples": n_samples, "tiles": n_tiles,
-        "seconds": round(dt, 4),
-        "samples_per_sec": round(n_samples / dt, 1),
-        "note": "hist+ROC+counters+CN on device (excl. index parse)",
-        "roofline": roofline(
-            # fused QC reads the (S,T) f32 matrix + bool mask twice
-            # (hist/ROC binning pass, counters/CN pass); outputs are
-            # O(S) and negligible
-            bytes_moved=n_samples * n_tiles * (4 + 1) * 2,
-            seconds=dt,
-            model="2 passes over (samples x tiles) f32 matrix + bool "
-                  "mask; O(samples) outputs ignored",
-        ),
-    }
-
-    # indexcov END-TO-END at the reference's headline scale (README:
-    # "30 samples x 60X WGS in ~30s"): fabricated whole-genome .bai
-    # files through the full CLI path incl. bed.gz/ped/roc/html/png
-    import glob
-    import shutil
-    import struct
-    import tempfile
-
-    from goleft_tpu.commands.indexcov import run_indexcov
-
-    d = tempfile.mkdtemp(prefix="goleft_ixc_")
-    n_ix = 10 if quick else 30
-    chrom_lens = [int(2.5e8 * (1 - i * 0.03)) for i in range(25)]
-    bais = _fabricate_bai_cohort(d, n_ix, chrom_lens, rng)
-    run_indexcov(bais, directory=f"{d}/w", fai=f"{d}/ref.fa.fai",
-                 exclude_patt="", sex="")  # compile warmup
-    t0 = time.perf_counter()
-    run_indexcov(bais, directory=f"{d}/out", fai=f"{d}/ref.fa.fai",
-                 exclude_patt="", sex="")
-    dt = time.perf_counter() - t0
-    # stage breakdown by differencing feature-toggled runs: parse-only,
-    # core (parse+QC+bed+roc+ped), +html, +png = the full path
-    from goleft_tpu.commands.indexcov import SampleIndex
-
-    t0 = time.perf_counter()
-    for b in bais:
-        SampleIndex(b)
-    t_parse = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run_indexcov(bais, directory=f"{d}/o2", fai=f"{d}/ref.fa.fai",
-                 exclude_patt="", sex="", write_html=False,
-                 write_png=False)
-    t_core = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run_indexcov(bais, directory=f"{d}/o3", fai=f"{d}/ref.fa.fai",
-                 exclude_patt="", sex="", write_png=False)
-    t_html = time.perf_counter() - t0
-    shutil.rmtree(d, ignore_errors=True)
-    out["indexcov_e2e_wholegenome"] = {
-        "samples": n_ix, "chromosomes": 25,
-        "genome_gb": round(sum(chrom_lens) / 1e9, 2),
-        "seconds_warm": round(dt, 2),
-        "stage_seconds": {
-            "bai_parse": round(t_parse, 2),
-            "qc_bed_roc_ped": round(t_core - t_parse, 2),
-            "html": round(t_html - t_core, 2),
-            "png": round(dt - t_html, 2),
-        },
-        "note": "full CLI path: .bai parse -> device QC -> "
-                "bed.gz/ped/roc/html/png; reference README cites ~30s "
-                "for 30 samples",
-    }
+    _rec("indexcov_e2e_wholegenome", _indexcov_e2e)
 
     # pallas vs XLA depth kernel at product shape (the pay-or-park
     # decision record: the XLA scatter+cumsum path sits on the memory
     # roofline; the pallas compare-reduction does O(endpoints/tile)
     # vector work per position and is kept as an experimental backend)
-    try:
+    def _pallas_vs_xla():
         from goleft_tpu.ops.pallas_coverage import (
             bucket_endpoints, pallas_depth,
         )
@@ -400,7 +428,7 @@ def bench_suite(quick: bool) -> dict:
             o = xla_run(w)
         jax.block_until_ready(o)
         t_xla = (time.perf_counter() - t0) / len(staged_x)
-        out["pallas_vs_xla_depth"] = {
+        return {
             "shard_bp": L, "coverage": 30,
             "pallas_ms": round(t_pallas * 1e3, 3),
             "xla_ms": round(t_xla * 1e3, 3),
@@ -410,64 +438,67 @@ def bench_suite(quick: bool) -> dict:
                         "tile) compares per position — experimental "
                         "backend only (ops/pallas_coverage.py)",
         }
-    except Exception as e:  # pragma: no cover - non-TPU backends
-        out["pallas_vs_xla_depth"] = {"error": str(e)}
 
-    # emdepth: 2504-sample 1000G-scale matrix, batched EM at the
-    # PRODUCT chunk size (emdepth_cmd.EM_CHUNK windows per dispatch —
-    # round 2 measured at B=1000 where per-dispatch link latency
-    # dominated and made the kernel look 10x slower than it is)
-    from goleft_tpu.commands.emdepth_cmd import EM_CHUNK
+    _rec("pallas_vs_xla_depth", _pallas_vs_xla)
 
-    n_s = 500 if quick else 2504
-    n_w = 2048 if quick else EM_CHUNK
-    em_reps = 2
-    ems = [
-        jax.device_put(
-            rng.gamma(30, 1.0, size=(n_w, n_s)).astype(np.float32)
-        )
-        for _ in range(em_reps + 1)
-    ]
+    def _emdepth_em():
+        # emdepth: 2504-sample 1000G-scale matrix, batched EM at the
+        # PRODUCT chunk size (emdepth_cmd.EM_CHUNK windows per dispatch
+        # — round 2 measured at B=1000 where per-dispatch link latency
+        # dominated and made the kernel look 10x slower than it is)
+        from goleft_tpu.commands.emdepth_cmd import EM_CHUNK
+        from goleft_tpu.models.emdepth import MAX_ITER, N_LAMBDA
 
-    def em(m):
-        cns = cn_batch(em_depth_batch(m), m)
-        return int(cns.sum())
+        n_s = 500 if quick else 2504
+        n_w = 2048 if quick else EM_CHUNK
+        em_reps = 2
+        ems = [
+            jax.device_put(
+                rng.gamma(30, 1.0, size=(n_w, n_s)).astype(np.float32)
+            )
+            for _ in range(em_reps + 1)
+        ]
 
-    em(ems[0])  # compile
-    t0 = time.perf_counter()
-    for r in range(em_reps):
-        em(ems[r + 1])
-    dt = (time.perf_counter() - t0) / em_reps
+        def em(m):
+            cns = cn_batch(em_depth_batch(m), m)
+            return int(cns.sum())
+
+        em(ems[0])  # compile
+        t0 = time.perf_counter()
+        for r in range(em_reps):
+            em(ems[r + 1])
+        dt = (time.perf_counter() - t0) / em_reps
+
+        per_iter_flops = n_s * N_LAMBDA * 6  # assign+1hot+2 reductions
+        wgs_windows = 3_000_000  # BASELINE config 5: WGS, 1kb windows
+        return {
+            "windows": n_w, "samples": n_s, "seconds": round(dt, 4),
+            "window_calls_per_sec": round(n_w / dt, 1),
+            "wgs_extrapolated_minutes": round(
+                wgs_windows / (n_w / dt) / 60, 2
+            ),
+            "note": "device-resident EM+CN at the product dispatch "
+                    "size; the cnv/emdepth CLI overlaps H2D of chunk "
+                    "k+1 with compute of chunk k "
+                    "(emdepth_cmd._batched_em)",
+            "roofline": roofline(
+                # masked-convergence fori_loop always runs MAX_ITER
+                # iterations; each reads the (B,S) depth row once
+                # (minimal model; 9-wide state fits registers/VMEM)
+                bytes_moved=float(n_w) * n_s * 4 * MAX_ITER,
+                seconds=dt,
+                flops=float(n_w) * per_iter_flops * MAX_ITER,
+                model=f"MAX_ITER={MAX_ITER} x one f32 read of (B,S) "
+                      f"per iter; ~{N_LAMBDA * 6} flops/sample/iter",
+            ),
+        }
+
+    _rec("emdepth_em", _emdepth_em)
     # decode-thread scaling: the executable artifact for the README's
     # multi-core claim (see tests/test_thread_scaling.py — same
     # measurement, judge-visible here)
-    out["decode_thread_scaling"] = _thread_scaling_entry()
-    out["cram31_codec_decode"] = _cram31_codec_entry(quick)
-
-    from goleft_tpu.models.emdepth import MAX_ITER, N_LAMBDA
-
-    per_iter_flops = n_s * N_LAMBDA * 6  # assign+one-hot+2 reductions
-    wgs_windows = 3_000_000  # BASELINE config 5: WGS at 1kb windows
-    out["emdepth_em"] = {
-        "windows": n_w, "samples": n_s, "seconds": round(dt, 4),
-        "window_calls_per_sec": round(n_w / dt, 1),
-        "wgs_extrapolated_minutes": round(
-            wgs_windows / (n_w / dt) / 60, 2
-        ),
-        "note": "device-resident EM+CN at the product dispatch size; "
-                "the cnv/emdepth CLI overlaps H2D of chunk k+1 with "
-                "compute of chunk k (emdepth_cmd._batched_em)",
-        "roofline": roofline(
-            # masked-convergence fori_loop always runs MAX_ITER
-            # iterations; each reads the (B,S) depth row once (minimal
-            # model; the 9-wide state fits registers/VMEM)
-            bytes_moved=float(n_w) * n_s * 4 * MAX_ITER,
-            seconds=dt,
-            flops=float(n_w) * per_iter_flops * MAX_ITER,
-            model=f"MAX_ITER={MAX_ITER} x one f32 read of (B,S) per "
-                  f"iter; ~{N_LAMBDA * 6} flops/sample/iter",
-        ),
-    }
+    _rec("decode_thread_scaling", _thread_scaling_entry)
+    _rec("cram31_codec_decode", lambda: _cram31_codec_entry(quick))
     return out
 
 
@@ -640,17 +671,24 @@ def _timed(fn, *a, **kw) -> float:
     return time.perf_counter() - t0
 
 
-def host_suite(quick: bool) -> dict:
+def host_suite(quick: bool, emit=None) -> dict:
     """Host-side benchmarks: the indexcov CLI e2e (QC kernels ride
     whatever backend is live — the entry's ``platform`` label records
     which), decode thread scaling and the CRAM 3.1 codec table (pure
     host). Runs in BOTH bench modes so the recorded artifact always
     carries the full portfolio; in --suite-host mode the caller pins
-    the platform to CPU first and the labels say so."""
+    the platform to CPU first and the labels say so. ``emit`` merges
+    each entry into BENCH_details.json as soon as it exists."""
     import shutil
     import tempfile
 
     out = {}
+
+    def _put(key, val):
+        out[key] = val
+        if emit:
+            emit({key: val})
+
     rng = np.random.default_rng(0)
     # each entry is independently guarded: this now runs on the default
     # device path too, and a failure in one host entry must not discard
@@ -673,7 +711,7 @@ def host_suite(quick: bool) -> dict:
         import jax as _jax
 
         plat = _jax.default_backend()
-        out["indexcov_e2e_wholegenome"] = {
+        _put("indexcov_e2e_wholegenome", {
             "samples": n_ix, "chromosomes": 25,
             "genome_gb": round(sum(chrom_lens) / 1e9, 2),
             "seconds_warm": round(dt, 2),
@@ -683,22 +721,22 @@ def host_suite(quick: bool) -> dict:
             "note": "full CLI path: .bai parse -> QC -> bed.gz/ped/roc/"
                     "html/png; reference README cites ~30s for 30 "
                     "samples",
-        }
+        })
     except Exception as e:  # noqa: BLE001
-        out["indexcov_e2e_wholegenome"] = {"error": repr(e)}
+        _put("indexcov_e2e_wholegenome", {"error": repr(e)})
     try:
-        out["decode_thread_scaling"] = _thread_scaling_entry()
+        _put("decode_thread_scaling", _thread_scaling_entry())
     except Exception as e:  # noqa: BLE001
-        out["decode_thread_scaling"] = {"error": repr(e)}
+        _put("decode_thread_scaling", {"error": repr(e)})
     try:
-        out["cram31_codec_decode"] = _cram31_codec_entry(quick)
+        _put("cram31_codec_decode", _cram31_codec_entry(quick))
     except Exception as e:  # noqa: BLE001
-        out["cram31_codec_decode"] = {"error": repr(e)}
+        _put("cram31_codec_decode", {"error": repr(e)})
     return out
 
 
-def _device_backend_usable(timeout_s: float = 120.0) -> bool:
-    """Probe accelerator bring-up in a SUBPROCESS so a wedged tunnel
+def _probe_once(timeout_s: float = 120.0) -> dict:
+    """One accelerator bring-up probe in a SUBPROCESS so a wedged tunnel
     (which hangs jax.devices() indefinitely) cannot turn the benchmark
     run into silence. The probe asserts a NON-CPU platform — a silent
     CPU fallback backend must not green-light the device suite.
@@ -706,69 +744,125 @@ def _device_backend_usable(timeout_s: float = 120.0) -> bool:
     The child is never killed: SIGKILLing a client mid-bring-up is
     itself a documented way to wedge the remote session. On timeout the
     orphan is left to finish (it exits cleanly on its own if bring-up
-    was merely slow) and this run conservatively takes the host path.
+    was merely slow) and this attempt conservatively reports not-ok.
     A successful probe is followed by a short settle so the bench's own
-    client doesn't race the probe client's teardown."""
+    client doesn't race the probe client's teardown.
+
+    Returns an attempt record for the ``device_probe`` artifact block
+    (round-3 VERDICT: a reader of BENCH_rN.json must be able to tell
+    "tunnel down" from "device path regressed"):
+    {ts, timeout_s, seconds, rc, ok, platform/device_kind or error}.
+    """
+    import datetime
     import subprocess
     import time as _time
 
+    rec = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "timeout_s": timeout_s,
+    }
+    import tempfile
+
+    t0 = _time.monotonic()
+    # child output goes to TEMP FILES, not pipes: a verbose bring-up
+    # failure must not block the (never-killed) child on a full pipe
+    fo = tempfile.TemporaryFile(mode="w+")
+    fe = tempfile.TemporaryFile(mode="w+")
     try:
         child = subprocess.Popen(
             [sys.executable, "-c",
              "import jax; d = jax.devices(); "
-             "assert d and d[0].platform != 'cpu', d"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+             "assert d and d[0].platform != 'cpu', d; "
+             "print(d[0].platform + '|' + d[0].device_kind)"],
+            stdout=fo, stderr=fe,
         )
-    except OSError:
-        return False
+    except OSError as e:
+        rec.update(ok=False, rc=None, error=f"spawn failed: {e!r}")
+        return rec
     deadline = _time.monotonic() + timeout_s
     while _time.monotonic() < deadline:
         rc = child.poll()
         if rc is not None:
+            fo.seek(0)
+            fe.seek(0)
+            out, err = fo.read(), fe.read()
+            rec["seconds"] = round(_time.monotonic() - t0, 1)
+            rec["rc"] = rc
             if rc == 0:
+                plat, _, kind = out.strip().partition("|")
+                rec.update(ok=True, platform=plat, device_kind=kind)
                 _time.sleep(5)  # let the probe client's session settle
-                return True
-            return False
+            else:
+                tail = (err.strip().splitlines() or ["<no stderr>"])[-1]
+                rec.update(ok=False, error=tail[:300])
+            return rec
         _time.sleep(1)
-    # still hanging: leave it be (no kill) and take the host path
-    return False
+    # still hanging: leave it be (no kill) and report not-ok
+    rec.update(ok=False, rc=None,
+               seconds=round(_time.monotonic() - t0, 1),
+               error="probe hung past timeout (child left to finish — "
+                     "killing mid-bring-up can wedge the session)")
+    return rec
 
 
-def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
-    quick = "--quick" in argv
-    if "--suite-host" not in argv and "--no-probe" not in argv:
-        if not _device_backend_usable():
-            print(
-                "bench: accelerator backend unusable (probe timed out "
-                "or failed) — falling back to --suite-host so the run "
-                "still records honest host-side numbers",
-                file=sys.stderr,
-            )
-            argv = list(argv) + ["--suite-host"]
-    if "--suite-host" in argv:
-        # accelerator-free fallback: refresh the host-side entries and
-        # the cohort headline (pure host) without touching the device.
-        # Pin the platform FIRST so no later jax touch can initialize
-        # an accelerator backend and silently falsify the labels.
-        import jax as _jax
+def _suite_host_subprocess(quick: bool, kernels_only: bool):
+    """Run ``bench.py --suite-host`` in a child process (which pins the
+    platform to CPU *there*) so this process's jax stays untouched for
+    a later device phase. The child merges its entries into
+    BENCH_details.json on disk; its single stdout JSON line (the host
+    cohort headline) is returned parsed, or None on failure."""
+    import subprocess
 
-        _jax.config.update("jax_platforms", "cpu")
-        cohort = bench_cohort(
-            *((20, 2_000_000, 3) if quick else (50, 10_000_000, 4)))
-        cohort["platform"] = "host (decode+reduce is pure host work)"
-        details = {"cohort_e2e": cohort}
-        if "--kernels-only" not in argv:  # honor fast iteration here too
-            details.update(host_suite(quick))
-        _merge_details(details)
-        print(json.dumps({
-            "metric": "cohort_depth_e2e_gbases_per_sec",
-            "value": cohort["gbases_per_sec"], "unit": "Gbases/s",
-            "vs_baseline": round(
-                cohort["gbases_per_sec"]
-                / cohort["numpy_kernel_gbases_per_sec"], 2),
-        }))
-        return
+    cmd = [sys.executable, __file__, "--suite-host"]
+    if quick:
+        cmd.append("--quick")
+    if kernels_only:
+        cmd.append("--kernels-only")
+    try:
+        r = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
+                           text=True, timeout=5400)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"bench: host-suite subprocess failed: {e!r}",
+              file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def _suite_host_main(argv, quick):
+    """``--suite-host``: accelerator-free mode — refresh the host-side
+    entries and the cohort headline (pure host work) without touching
+    the device. Pins the platform FIRST so no later jax touch can
+    initialize an accelerator backend and silently falsify labels."""
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    cohort = bench_cohort(
+        *((20, 2_000_000, 3) if quick else (50, 10_000_000, 4)))
+    cohort["platform"] = "host (decode+reduce is pure host work)"
+    _merge_details({"cohort_e2e": cohort})
+    if "--kernels-only" not in argv:  # honor fast iteration here too
+        host_suite(quick, emit=_merge_details)
+    print(json.dumps({
+        "metric": "cohort_depth_e2e_gbases_per_sec",
+        "value": cohort["gbases_per_sec"], "unit": "Gbases/s",
+        "vs_baseline": round(
+            cohort["gbases_per_sec"]
+            / cohort["numpy_kernel_gbases_per_sec"], 2),
+    }))
+
+
+def bench_kernels(quick: bool) -> dict:
+    """Device depth-kernel micro-bench: device-resident rate, segment
+    e2e incl. transfer (unpacked + packed wire), the HBM roofline block
+    and the single-core numpy baseline. Factored out of main() so a
+    successful probe can capture these IMMEDIATELY (salvage-first) —
+    if the tunnel wedges later, the round still has device numbers."""
     import jax
 
     from goleft_tpu.ops.depth_pipeline import shard_depth_pipeline
@@ -870,31 +964,119 @@ def main(argv=None):
     )
     np_gbps = length / np_dt / 1e9
 
-    # the headline number IS the end-to-end product path (round-1
-    # VERDICT: the kernel rate is not the product) — BASELINE config-3
-    # scale by default, a small cohort with --quick
-    if quick:
-        cohort = bench_cohort(20, 2_000_000, 3)
-    else:
-        cohort = bench_cohort(50, 10_000_000, 4)
-
-    details = {"cohort_e2e": cohort}
-    # a plain `python bench.py` on a usable accelerator records the FULL
-    # portfolio (the driver invokes exactly that at round end): cohort
-    # configs 4-5 on device plus the host-side entries. --kernels-only
-    # skips them for fast device-kernel iteration.
-    if "--kernels-only" not in argv:
-        try:
-            details.update(bench_suite(quick))
-        except Exception as e:  # noqa: BLE001 — keep device results
-            details["suite_error"] = repr(e)
-        details.update(host_suite(quick))  # internally per-entry guarded
-    if details:
-        # merge with any existing entries so --cohort alone doesn't wipe
-        # --suite results (and vice versa)
-        _merge_details(details)
-
     dev = jax.devices()[0]
+    return {
+        "window": window,
+        "device": str(dev), "platform": dev.platform,
+        "kernel_device_resident_gbases_per_sec": round(gbps, 4),
+        "kernel_e2e_incl_transfer_gbases_per_sec": round(e2e_gbps, 4),
+        "kernel_e2e_packed_wire_gbases_per_sec": round(packed_gbps, 4),
+        "kernel_shard_bp": length, "kernel_coverage": coverage,
+        "kernel_read_len": read_len, "kernel_iters": iters,
+        "roofline": kernel_roofline,
+        "numpy_single_core_gbases_per_sec": round(np_gbps, 4),
+    }
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    quick = "--quick" in argv
+    kernels_only = "--kernels-only" in argv
+    if "--suite-host" in argv:
+        _suite_host_main(argv, quick)
+        return
+
+    # Probe/salvage policy (round-3 VERDICT: a single failed probe must
+    # not erase the round's device story). Probe in a subprocess; on
+    # failure, record the HOST portfolio first (in a child so this
+    # process's jax stays untouched), then re-probe with backoff spread
+    # across the run. Every attempt lands in the device_probe artifact.
+    import os
+
+    probe_timeout = float(
+        os.environ.get("GOLEFT_BENCH_PROBE_TIMEOUT", "120"))
+    backoffs = tuple(
+        float(x) for x in os.environ.get(
+            "GOLEFT_BENCH_PROBE_BACKOFF", "0,240,480").split(",")
+        if x.strip())  # "" disables re-probing entirely
+    host_done = False
+    host_headline = None
+    att = {"ok": True}
+    if "--no-probe" not in argv:
+        probe = {
+            "policy": f"probe subprocess ({probe_timeout:g}s); on "
+                      "failure run host suite in a child then re-probe "
+                      "with backoff "
+                      f"({'/'.join(f'{b:g}' for b in backoffs)}s); "
+                      "device phase captures kernels first (salvage "
+                      "ordering)",
+            "attempts": [],
+        }
+        att = _probe_once(probe_timeout)
+        probe["attempts"].append(att)
+        if not att["ok"]:
+            print(
+                f"bench: probe 1 failed ({att.get('error')}) — "
+                "recording host portfolio first, then re-probing",
+                file=sys.stderr,
+            )
+            host_headline = _suite_host_subprocess(quick, kernels_only)
+            host_done = True
+            for delay in backoffs:
+                time.sleep(delay)
+                att = _probe_once(probe_timeout)
+                probe["attempts"].append(att)
+                if att["ok"]:
+                    break
+                print(f"bench: re-probe failed ({att.get('error')})",
+                      file=sys.stderr)
+        _merge_details({"device_probe": probe})
+        if not att["ok"]:
+            print(
+                "bench: accelerator unusable after "
+                f"{len(probe['attempts'])} probes — host-only artifact "
+                "recorded (see device_probe block)", file=sys.stderr,
+            )
+            if host_headline is not None:
+                print(json.dumps(host_headline))
+            else:
+                print(json.dumps({
+                    "metric": "cohort_depth_e2e_gbases_per_sec",
+                    "value": 0.0, "unit": "Gbases/s", "vs_baseline": 0.0,
+                    "error": "device unusable and host fallback failed",
+                }))
+            return
+
+    # device phase — kernels FIRST so a later wedge can't erase them
+    kern = bench_kernels(quick)
+    _merge_details({"device_kernels": kern})
+    cohort = None
+    if host_done and host_headline is not None:
+        # reuse the cohort the host-suite child JUST recorded (pure
+        # host work — device-independent), but only if the file entry
+        # matches the child's own headline: BENCH_details.json is
+        # git-tracked, so a bare key-presence check could resurrect a
+        # stale prior-round number as this run's headline
+        try:
+            with open("BENCH_details.json") as fh:
+                cand = json.load(fh)["cohort_e2e"]
+            if abs(cand["gbases_per_sec"]
+                   - host_headline["value"]) < 1e-9:
+                cohort = cand
+        except (OSError, ValueError, KeyError, TypeError):
+            cohort = None
+    if cohort is None:
+        cohort = bench_cohort(
+            *((20, 2_000_000, 3) if quick else (50, 10_000_000, 4)))
+        _merge_details({"cohort_e2e": cohort})
+    if not kernels_only:
+        try:
+            bench_suite(quick, emit=_merge_details)
+        except Exception as e:  # noqa: BLE001 — keep device results
+            _merge_details({"suite_error": repr(e)})
+        if not host_done:
+            host_suite(quick, emit=_merge_details)
+
     print(json.dumps({
         "metric": "cohort_depth_e2e_gbases_per_sec",
         "value": cohort["gbases_per_sec"],
@@ -914,15 +1096,7 @@ def main(argv=None):
             "cohort": {k: cohort[k] for k in
                        ("samples", "ref_bp", "coverage",
                         "wall_seconds_warm", "stage_seconds")},
-            "window": window,
-            "device": str(dev), "platform": dev.platform,
-            "kernel_device_resident_gbases_per_sec": round(gbps, 4),
-            "kernel_e2e_incl_transfer_gbases_per_sec": round(e2e_gbps, 4),
-            "kernel_e2e_packed_wire_gbases_per_sec": round(
-                packed_gbps, 4
-            ),
-            "kernel_shard_bp": length, "kernel_coverage": coverage,
-            "kernel_read_len": read_len, "kernel_iters": iters,
+            **kern,
         },
     }))
 
