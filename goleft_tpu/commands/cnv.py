@@ -109,7 +109,8 @@ def run_cnv(bams, reference=None, fai=None, window: int = 1000,
     return call_cnvs(chroms, starts, ends, depths, names, out=out,
                      matrix_out=matrix_out, vcf_out=vcf_out,
                      mops_out=mops_out, gain_out=gain_out,
-                     contig_lengths=contig_lengths)
+                     contig_lengths=contig_lengths,
+                     ref_fasta=reference, ref_fai=fai)
 
 
 def main(argv=None):

@@ -225,10 +225,18 @@ HEADER = ("coverage\tinsert_mean\tinsert_sd\tinsert_5th\tinsert_95th\t"
           "pct_duplicate\tpct_proper_pair\tread_length\tbam\tsample")
 
 
+class _SamplingAborted(RuntimeError):
+    """A healthy sampling stopped because ANOTHER file failed — never
+    the root cause, so the driver must not surface it as the error."""
+
+
 def _stats_one(path: str, n: int, skip: int,
-               region_bases_total: int | None):
+               region_bases_total: int | None, cancel=None):
     """Full stats for one file — independent of every other file, so
-    the driver can fan these out across decode threads."""
+    the driver can fan these out across decode threads. ``cancel`` (a
+    threading.Event) aborts the streaming loop between decode windows
+    so an in-flight sampling of a huge file can't delay the error exit
+    after another file has already failed."""
     # lazy native handle: the compressed file is mmapped and only the
     # decode window is ever inflated, so peak RSS is O(window + n)
     # regardless of file size — matching the reference's streaming
@@ -239,6 +247,9 @@ def _stats_one(path: str, n: int, skip: int,
         "<no-read-groups>"
     acc = BamStatsAccumulator(n, skip)
     for cols in handle.stream_columns():
+        if cancel is not None and cancel.is_set():
+            raise _SamplingAborted(f"covstats: {path}: aborted "
+                                   "(another file failed)")
         acc.update(cols)
         if acc.done:
             break
@@ -282,11 +293,25 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
     # the Go tool samples files one after another (covstats.go:251-262)
     import concurrent.futures as cf
 
+    import threading
+
+    cancel = threading.Event()
     ex = cf.ThreadPoolExecutor(
         max_workers=max(1, min(processes, len(bams))))
     try:
-        futures = [ex.submit(_stats_one, p, n, skip, rb_total)
+        futures = [ex.submit(_stats_one, p, n, skip, rb_total, cancel)
                    for p in bams]
+        # trip the cancel flag the moment ANY sampling fails — the
+        # in-order consumer below may still be blocked on an earlier
+        # (slow, healthy) file when a later file errors, and that
+        # healthy sampling must stop at its next decode window instead
+        # of running to completion first
+        def _on_done(f):
+            if not f.cancelled() and f.exception() is not None:
+                cancel.set()
+
+        for f in futures:
+            f.add_done_callback(_on_done)
         for f in futures:  # input order; failures abort promptly
             st = f.result()
             results.append(st)
@@ -305,8 +330,19 @@ def run_covstats(bams: list[str], n: int = 1_000_000,
             )
     except BaseException:
         # one corrupt file must not keep sampling the rest of a large
-        # queued cohort before the error reaches the user
+        # queued cohort before the error reaches the user; the cancel
+        # flag also stops samplings already in flight at their next
+        # decode-window boundary
+        cancel.set()
         ex.shutdown(wait=False, cancel_futures=True)
+        # if the in-order consumer tripped on a healthy file's
+        # _SamplingAborted, surface the ROOT failure instead
+        for g in futures:
+            if g.done() and not g.cancelled():
+                exc = g.exception()
+                if exc is not None and not isinstance(
+                        exc, _SamplingAborted):
+                    raise exc from None
         raise
     ex.shutdown(wait=True)
     return results

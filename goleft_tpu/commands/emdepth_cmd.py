@@ -207,7 +207,9 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
               normalize: bool = True, matrix_out: str | None = None,
               vcf_out: str | None = None, mops_out: str | None = None,
               gain_out: str | None = None,
-              contig_lengths: dict | None = None):
+              contig_lengths: dict | None = None,
+              ref_fasta: str | None = None,
+              ref_fai: str | None = None):
     """EM copy-number calls from in-memory matrix arrays (the device
     pipeline's native feed — ``cnv`` passes cohortdepth's blocks here
     directly, no text round-trip)."""
@@ -279,7 +281,8 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
         from ..utils.vcf import write_cnv_vcf
 
         write_cnv_vcf(vcf_out, results, samples,
-                      contig_lengths=contig_lengths)
+                      contig_lengths=contig_lengths,
+                      ref_fasta=ref_fasta, ref_fai=ref_fai)
     return results
 
 

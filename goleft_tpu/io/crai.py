@@ -45,7 +45,10 @@ class CraiIndex:
     slices: list[list[CraiSlice]]  # per seqID
 
     def sizes(self) -> list[np.ndarray]:
-        return [_make_sizes(s) for s in self.slices]
+        # share one empty result across absent seqIDs so a sparse
+        # high-seqID index costs pointers, not millions of arrays
+        empty = np.zeros(0, dtype=np.int64)
+        return [_make_sizes(s) if s else empty for s in self.slices]
 
 
 def _make_sizes(slices: list[CraiSlice]) -> np.ndarray:
@@ -111,8 +114,18 @@ def read_crai(path_or_bytes) -> CraiIndex:
         text = data.decode()
     except UnicodeDecodeError:
         raise ValueError("crai: not a text index (bad utf-8)")
-    slices: list[list[CraiSlice]] = []
-    for lineno, line in enumerate(text.splitlines(), 1):
+    # parse into a sparse {seqID: slices} map — a single hostile line
+    # claiming a huge (but in-bounds) seqID must not allocate millions
+    # of per-seqID lists mid-parse (ADVICE r3); densification at the
+    # end shares one sentinel list across absent ids, so the dense
+    # index costs one pointer per id, not one list object per id.
+    by_id: dict[int, list[CraiSlice]] = {}
+    lines = text.splitlines()
+    # 16.7M references clears every real assembly (largest public ones
+    # are ~5M scaffolds — including regionally-subsetted CRAMs whose
+    # few lines may all carry a high seqID); beyond is corruption/DoS
+    si_bound = 2 ** 24
+    for lineno, line in enumerate(lines, 1):
         line = line.strip()
         if not line:
             continue
@@ -130,10 +143,7 @@ def read_crai(path_or_bytes) -> CraiIndex:
             continue  # unmapped
         # bounds sanity: a corrupt/malicious line must not allocate an
         # unbounded per-seqID list (DoS) or overflow later float math
-        if si < 0 or si > 2**24:
-            # 16.7M references bounds the per-seqID list at ~1GB worst
-            # case while clearing every real assembly (largest public
-            # ones are ~5M scaffolds); beyond that is corruption/DoS
+        if si < 0 or si > si_bound:
             raise ValueError(f"crai: implausible seqID {si} at line "
                              f"{lineno}")
         if max(abs(cstart), abs(sstart), abs(slen)) > 2**62:
@@ -145,9 +155,11 @@ def read_crai(path_or_bytes) -> CraiIndex:
                              f"{lineno}")
         if aln_span < 0:
             break  # matches reference early-break on negative span
-        while len(slices) <= si:
-            slices.append([])
-        slices[si].append(
+        by_id.setdefault(si, []).append(
             CraiSlice(aln_start, aln_span, cstart, sstart, slen)
         )
-    return CraiIndex(slices)
+    empty: list[CraiSlice] = []  # shared read-only sentinel
+    dense = [empty] * (max(by_id) + 1 if by_id else 0)
+    for si, lst in by_id.items():
+        dense[si] = lst
+    return CraiIndex(dense)

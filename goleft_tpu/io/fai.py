@@ -80,13 +80,26 @@ def write_fai(fasta_path: str) -> list[FaiRecord]:
 class Faidx:
     """Random access to FASTA subsequences via the .fai index."""
 
-    def __init__(self, fasta_path: str):
+    def __init__(self, fasta_path: str, fai_path: str | None = None):
         self.path = fasta_path
-        try:
-            self.records = {r.name: r for r in read_fai(fasta_path + ".fai")}
-        except FileNotFoundError:
-            self.records = {r.name: r for r in write_fai(fasta_path)}
+        if fai_path:
+            self.records = {r.name: r for r in read_fai(fai_path)}
+        else:
+            try:
+                self.records = {
+                    r.name: r for r in read_fai(fasta_path + ".fai")}
+            except FileNotFoundError:
+                self.records = {r.name: r for r in write_fai(fasta_path)}
         self._fh = open(fasta_path, "rb")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def names(self) -> list[str]:
         return list(self.records)

@@ -96,6 +96,10 @@ def _pack_names(names, pad_to: int) -> np.ndarray:
     out = np.zeros((pad_to, _NAME_BYTES), dtype=np.uint8)
     for i, nm in enumerate(names):
         b = nm.encode("utf-8")[:_NAME_BYTES]
+        # a hard byte cut can split a multi-byte codepoint and make
+        # _unpack_name's decode raise mid-assembly; re-truncate on a
+        # codepoint boundary instead
+        b = b.decode("utf-8", errors="ignore").encode("utf-8")
         out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
     return out
 

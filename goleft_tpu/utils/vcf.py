@@ -64,7 +64,9 @@ def _gt(cn: int) -> str:
 
 
 def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
-                  source: str = "goleft-tpu cnv"):
+                  source: str = "goleft-tpu cnv",
+                  ref_fasta: str | None = None,
+                  ref_fai: str | None = None):
     """Write CNV ``calls`` as a multi-sample VCF.
 
     ``calls``: iterable of (chrom, start, end, sample, cn, log2fc) —
@@ -73,8 +75,25 @@ def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
     column order (every cohort sample appears, carrier or not).
     ``contig_lengths``: optional {chrom: length} for ##contig headers;
     chroms seen only in calls still get an ID-only ##contig line.
+    ``ref_fasta``: when given, symbolic-allele records are anchored per
+    the VCF 4.2 padding-base convention — POS is the base preceding the
+    event and REF is the actual reference base there; without it, POS
+    is the first altered base with REF=N (accepted by bcftools/truvari/
+    IGV but flagged by strict validators), and the header records which
+    convention is in effect either way. ``ref_fai`` points Faidx at a
+    user-supplied index; anchoring is best-effort — an unreadable
+    fasta/index downgrades to the no-fasta convention rather than
+    failing the write after the whole pipeline has run.
     Returns the number of VCF records written.
     """
+    fx = None
+    if ref_fasta:
+        from ..io.fai import Faidx
+
+        try:
+            fx = Faidx(ref_fasta, fai_path=ref_fai)
+        except Exception:  # noqa: BLE001 — anchoring is best-effort
+            fx = None
     samples = list(samples)
     col = {s: i for i, s in enumerate(samples)}
     # group per-sample calls into events keyed by locus + direction
@@ -107,6 +126,15 @@ def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
     try:
         fh.write("##fileformat=VCFv4.2\n")
         fh.write(f"##source={source}\n")
+        fh.write("##cnv_pos_convention=" + (
+            "padding-base (POS/REF anchor the reference base preceding "
+            "the event per VCF 4.2; events without a resolvable "
+            "A/C/G/T anchor — telomeric start, contig absent from the "
+            "fasta, or an N-gap anchor base — fall back to REF=N at "
+            "the first altered base)" if fx else
+            "first-altered-base with REF=N (no reference fasta "
+            "consulted; bcftools/truvari/IGV accept this, strict "
+            "validators may flag REF)") + "\n")
         contigs = dict(contig_lengths or {})
         for c in chrom_order:
             contigs.setdefault(c, None)
@@ -131,9 +159,23 @@ def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
             svlen = end - start
             if svtype == "DEL":
                 svlen = -svlen
+            # padding-base anchoring when the reference is available
+            # (ADVICE r3: strict validators flag REF=N at the first
+            # altered base); END stays the 1-based inclusive event end
+            # under both conventions
+            pos1, refb = start + 1, "N"
+            if fx is not None and start > 0 and chrom in fx.records:
+                try:
+                    b = fx.fetch(chrom, start - 1, start).decode(
+                        "ascii", "replace").upper()
+                except OSError:
+                    b = ""
+                if b in ("A", "C", "G", "T"):
+                    pos1, refb = start, b
             fh.write(
-                f"{chrom}\t{start + 1}\t"
-                f"{svtype}_{chrom}_{start + 1}_{end}\tN\t<{svtype}>\t"
+                f"{chrom}\t{pos1}\t"
+                f"{svtype}_{chrom}_{start + 1}_{end}\t{refb}\t"
+                f"<{svtype}>\t"
                 f".\tPASS\tSVTYPE={svtype};END={end};SVLEN={svlen};"
                 f"NCARRIER={len(carriers)}\tGT:CN:L2FC\t"
                 + "\t".join(fields) + "\n"
@@ -141,5 +183,7 @@ def write_cnv_vcf(path_or_fh, calls, samples, contig_lengths=None,
             n += 1
         return n
     finally:
+        if fx is not None:
+            fx.close()
         if own:
             fh.close()

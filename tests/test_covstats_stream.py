@@ -170,3 +170,38 @@ def test_covstats_parallel_matches_serial(tmp_path):
     run_covstats(bams, n=200, skip=0, out=b, processes=4)
     assert a.getvalue() == b.getvalue()
     assert len(a.getvalue().splitlines()) == 6
+
+
+def test_covstats_failure_surfaces_root_cause(tmp_path):
+    """When a later file fails while an earlier healthy sampling is
+    still in flight, the error the user sees must be the corrupt
+    file's, and healthy in-flight samplings abort via the shared
+    cancel flag instead of running to completion (ADVICE r3)."""
+    import io
+
+    import numpy as np
+    import pytest
+
+    from goleft_tpu.commands.covstats import (
+        _SamplingAborted, run_covstats,
+    )
+    from helpers import write_bam_and_bai
+
+    rng = np.random.default_rng(3)
+    reads = []
+    pos = 0
+    for j in range(20_000):  # big enough to still be sampling
+        pos += int(rng.integers(1, 4))
+        reads.append((0, pos, "100M", 60, 0x63 if j % 2 == 0 else 0x93))
+    slow = str(tmp_path / "slow.bam")
+    write_bam_and_bai(slow, reads, ref_names=("chr1",),
+                      ref_lens=(200_000,))
+    corrupt = str(tmp_path / "bad.bam")
+    with open(corrupt, "wb") as fh:
+        fh.write(b"\x1f\x8b\x08\x04BROKEN")
+    with pytest.raises(BaseException) as ei:  # corrupt opens SystemExit
+        run_covstats([slow, corrupt], n=1_000_000, skip=0,
+                     out=io.StringIO(), processes=2)
+    assert not isinstance(ei.value, _SamplingAborted)
+    assert "bad.bam" in str(ei.value) or "gzip" in str(
+        ei.value).lower() or "bgzf" in str(ei.value).lower()

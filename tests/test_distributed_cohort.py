@@ -160,3 +160,18 @@ def test_distributed_cohortdepth_matches_single_process(tmp_path):
     assert got_one == "".join(want_one.parts)
     got_cnv = open(tmp_path / "dist_cnv.tsv").read()
     assert got_cnv == "".join(want_cnv.parts)
+
+
+def test_pack_names_truncates_on_codepoint_boundary():
+    """A >256-byte utf-8 name whose byte cut lands inside a multi-byte
+    codepoint must still round-trip through pack/unpack without a
+    UnicodeDecodeError (ADVICE r3)."""
+    from goleft_tpu.parallel.distributed_cohort import (
+        _pack_names, _unpack_name,
+    )
+
+    name = "€" * 100  # 300 utf-8 bytes; 256 % 3 == 1 splits a codepoint
+    packed = _pack_names([name, "plain"], pad_to=2)
+    got = _unpack_name(packed[0])
+    assert got == "€" * 85  # 255 bytes: cut back to the boundary
+    assert _unpack_name(packed[1]) == "plain"

@@ -188,3 +188,19 @@ def test_cli_valueerror_clean_surface(tmp_path, capsys, monkeypatch):
     assert rc == 1
     assert "goleft-tpu cohortdepth:" in err
     assert "not a .fai line" in err and "Traceback" not in err
+
+
+def test_crai_sparse_high_seqid_is_cheap():
+    """A legitimate sparse index (few lines, high seqID — e.g. a
+    regionally-subsetted CRAM on a many-scaffold assembly) parses, and
+    absent seqIDs share one sentinel list / one empty sizes array
+    instead of allocating per-id objects (ADVICE r3)."""
+    from goleft_tpu.io.crai import read_crai
+
+    ix = read_crai(gzip.compress(b"5000000\t0\t16384\t0\t0\t100\n"))
+    assert len(ix.slices) == 5000001
+    assert ix.slices[0] is ix.slices[4999999]  # shared sentinel
+    assert len(ix.slices[5000000]) == 1
+    sz = ix.sizes()
+    assert sz[0] is sz[1]  # shared empty array
+    assert sz[5000000].tolist() == [610]  # 100000*100/16384 per base

@@ -203,3 +203,44 @@ def test_mops_outputs_chunked(monkeypatch):
                       mops_out=tf.name)
             outs.append(open(tf.name).read())
     assert outs[0] == outs[1]
+
+
+def test_vcf_padding_base_anchor_with_fasta(tmp_path):
+    """With a reference fasta, symbolic records anchor at the base
+    BEFORE the event with the real reference base (VCF 4.2 padding
+    convention, ADVICE r3); telomeric events (start 0) keep REF=N."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).parent))
+    from helpers import write_fasta
+
+    from goleft_tpu.io.fai import write_fai
+
+    seq = "ACGTACGTACGTACGTACGT"
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": seq})
+    write_fai(fa)
+    calls = [
+        ("chr1", 4, 8, "s", 1, -1.0),   # base before event: seq[3]='T'
+        ("chr1", 0, 4, "s", 0, -3.0),   # telomeric: no preceding base
+    ]
+    path = str(tmp_path / "a.vcf")
+    write_cnv_vcf(path, calls, ["s"], ref_fasta=fa)
+    headers, _, recs = _parse_vcf(open(path).read())
+    assert any(h.startswith("##cnv_pos_convention=padding-base")
+               for h in headers)
+    by_id = {r[2]: r for r in recs}
+    anchored = by_id["DEL_chr1_5_8"]
+    assert (anchored[1], anchored[3]) == ("4", "T")  # POS=start, REF
+    assert "END=8" in anchored[7] and "SVLEN=-4" in anchored[7]
+    telo = by_id["DEL_chr1_1_4"]
+    assert (telo[1], telo[3]) == ("1", "N")
+
+
+def test_vcf_no_fasta_documents_convention(tmp_path):
+    path = str(tmp_path / "b.vcf")
+    write_cnv_vcf(path, [("chr1", 10, 20, "s", 1, -1.0)], ["s"])
+    headers, _, recs = _parse_vcf(open(path).read())
+    assert any(h.startswith("##cnv_pos_convention=first-altered-base")
+               for h in headers)
+    assert (recs[0][1], recs[0][3]) == ("11", "N")
