@@ -334,25 +334,48 @@ def run_indexcov(
                     n_slopes += 1
                 chrom_names.append(ref_name)
                 if write_html:
+                    # render + write pages in worker threads: the page
+                    # bytes ride a (possibly slow) filesystem while the
+                    # next chromosome's QC/bed/roc work proceeds; the
+                    # futures are joined (and errors surfaced) before
+                    # index.html is written
                     with timer.stage("plots"):
-                        _plot_depth_chrom(
+                        plot_futs.append(plot_ex.submit(
+                            _plot_depth_chrom,
                             base, ref_name, mat, lengths, names,
                             interactive=n_samples <= MAX_SAMPLES,
                             write_png=write_png,
-                        )
-                        _plot_roc_chrom(base, ref_name, rocs, names,
-                                        write_png=write_png)
+                        ))
+                        plot_futs.append(plot_ex.submit(
+                            _plot_roc_chrom, base, ref_name, rocs,
+                            names, write_png))
+                        # bound the queue: each queued depth future
+                        # pins its chromosome's full (samples x bins)
+                        # matrix, so joining the oldest beyond a small
+                        # window caps resident memory at ~4 chroms
+                        # (the serial code held 1) while keeping the
+                        # render/compute overlap
+                        while len(plot_futs) > 8:
+                            plot_futs.pop(0).result()
 
-    pending = None
-    for ref_id, ref_name, ref_len in refs:
-        if exclude is not None and exclude.search(ref_name):
-            continue
-        cur = _launch(ref_id, ref_name, ref_len)
+    plot_ex = cf.ThreadPoolExecutor(max_workers=4)
+    plot_futs: list = []
+    try:
+        pending = None
+        for ref_id, ref_name, ref_len in refs:
+            if exclude is not None and exclude.search(ref_name):
+                continue
+            cur = _launch(ref_id, ref_name, ref_len)
+            if pending is not None:
+                _emit(pending)
+            pending = cur
         if pending is not None:
             _emit(pending)
-        pending = cur
-    if pending is not None:
-        _emit(pending)
+        with timer.stage("plots"):
+            for f in plot_futs:
+                f.result()  # surface the first page-render failure
+    finally:
+        plot_ex.shutdown(wait=True, cancel_futures=True)
 
     bed.close()
     bed_fh.close()

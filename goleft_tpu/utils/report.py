@@ -34,6 +34,38 @@ nav a {{ margin-right: 12px; }}
 """
 
 
+_HTML_MAX_POINTS = 2048  # per series; INDEXCOV_HTML_MAX_POINTS overrides
+
+
+def _html_max_points() -> int:
+    """Per-series point cap for interactive charts. The reference
+    subsamples its static plots 1/5-1/10 at whole-genome sizes for
+    exactly this reason (indexcov/plot.go:484-487); an 850px canvas
+    cannot show more than ~1700 distinct x anyway, and chart.js with
+    30x15k points is unusably slow in-browser. 0 disables."""
+    try:
+        return max(0, int(os.environ.get("INDEXCOV_HTML_MAX_POINTS",
+                                         str(_HTML_MAX_POINTS))))
+    except ValueError:
+        return _HTML_MAX_POINTS
+
+
+def _subsample_xy(x, y, cap: int):
+    """Stride-subsample to <= cap+1 points, always keeping the last
+    point so the x-extent (chromosome end) is preserved."""
+    import numpy as np
+
+    if not cap or len(x) <= cap:
+        return x, y
+    xa = np.asarray(x)
+    ya = np.asarray(y)
+    stride = -(-len(xa) // cap)
+    idx = np.arange(0, len(xa), stride)
+    if idx[-1] != len(xa) - 1:
+        idx = np.append(idx, len(xa) - 1)
+    return xa[idx], ya[idx]
+
+
 def _n_backgrounds() -> int:
     """INDEXCOV_N_BACKGROUNDS: the first n samples plot gray
     (reference plot.go:85-96)."""
@@ -88,11 +120,16 @@ def line_chart(
             "steppedLine": stepped,
             "pointHitRadius": 6,
         }
+        # whole-genome series are stride-subsampled to the canvas's
+        # useful resolution before serialization — at 30 samples x 25
+        # chroms this cuts the written html ~7x and was 60% of the
+        # indexcov e2e wall on slow filesystems
+        sx, sy = _subsample_xy(s["x"], s["y"], _html_max_points())
         # point serialization is the report writer's hot loop at
         # whole-genome sizes — C++ formats the pair array directly; the
         # Python fallback emits the SAME bytes (%.10g/%.5g, null for
         # non-finite — json.dumps would write invalid NaN literals)
-        b = native.format_xy_json(s["x"], s["y"])
+        b = native.format_xy_json(sx, sy)
         if b is not None:
             data_json = b.decode("ascii")
         else:
@@ -105,7 +142,7 @@ def line_chart(
 
             data_json = "[" + ",".join(
                 f'{{"x":{_pt(x, 10)},"y":{_pt(y, 5)}}}'
-                for x, y in zip(s["x"], s["y"])
+                for x, y in zip(sx, sy)
             ) + "]"
         mjson = json.dumps(meta)
         dataset_parts.append(mjson[:-1] + ',"data":' + data_json + "}")
@@ -362,8 +399,22 @@ def save_png(path: str, series: list[dict], xlabel: str, ylabel: str,
     img.save(path, compress_level=1)
 
 
+import threading as _threading
+
+_MPL_LOCK = _threading.Lock()
+
+
 def _save_matplotlib(path, series, xlabel, ylabel, y_max, kind,
                      subsample, extra) -> None:
+    # indexcov renders pages from worker threads; pyplot's global
+    # figure manager is not thread-safe, so the fallback serializes
+    with _MPL_LOCK:
+        _save_matplotlib_locked(path, series, xlabel, ylabel, y_max,
+                                kind, subsample, extra)
+
+
+def _save_matplotlib_locked(path, series, xlabel, ylabel, y_max, kind,
+                            subsample, extra) -> None:
     try:
         import matplotlib
         matplotlib.use("Agg")

@@ -132,3 +132,23 @@ def test_excluded_chrom(tmp_path):
         chroms = {line.split("\t")[0] for line in fh}
     assert "X" not in chroms
     assert "chr1" in chroms
+
+
+def test_html_series_subsampled_with_last_point(tmp_path, monkeypatch):
+    """Whole-genome html series are stride-subsampled to the canvas's
+    useful resolution (the reference subsamples its static plots the
+    same way, plot.go:484-487) keeping the final point, and
+    INDEXCOV_HTML_MAX_POINTS=0 restores full resolution."""
+    from goleft_tpu.utils import report
+
+    x = list(range(10_000))
+    y = [0.5] * 10_000
+    div, js = report.line_chart(
+        "c", [{"label": "s", "x": x, "y": y}], "x", "y")
+    pts = js.count('{"x":')
+    assert pts <= 2049  # cap + preserved last point
+    assert '"x":9999' in js  # chromosome end survives
+    monkeypatch.setenv("INDEXCOV_HTML_MAX_POINTS", "0")
+    _, js_full = report.line_chart(
+        "c", [{"label": "s", "x": x, "y": y}], "x", "y")
+    assert js_full.count('{"x":') == 10_000
