@@ -752,57 +752,30 @@ def _probe_once(timeout_s: float = 120.0) -> dict:
     (round-3 VERDICT: a reader of BENCH_rN.json must be able to tell
     "tunnel down" from "device path regressed"):
     {ts, timeout_s, seconds, rc, ok, platform/device_kind or error}.
+
+    Wraps the ONE shared subprocess-probe implementation
+    (goleft_tpu.utils.device_guard.probe_device — the product CLI's
+    bring-up fallback uses the same machinery), adding the timestamp
+    and platform/device-kind fields the artifact wants and the longer
+    post-success settle this dev tunnel needs.
     """
     import datetime
-    import subprocess
-    import time as _time
 
-    rec = {
-        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
-            timespec="seconds"),
-        "timeout_s": timeout_s,
-    }
-    import tempfile
+    from goleft_tpu.utils.device_guard import probe_device
 
-    t0 = _time.monotonic()
-    # child output goes to TEMP FILES, not pipes: a verbose bring-up
-    # failure must not block the (never-killed) child on a full pipe
-    fo = tempfile.TemporaryFile(mode="w+")
-    fe = tempfile.TemporaryFile(mode="w+")
-    try:
-        child = subprocess.Popen(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "assert d and d[0].platform != 'cpu', d; "
-             "print(d[0].platform + '|' + d[0].device_kind)"],
-            stdout=fo, stderr=fe,
-        )
-    except OSError as e:
-        rec.update(ok=False, rc=None, error=f"spawn failed: {e!r}")
-        return rec
-    deadline = _time.monotonic() + timeout_s
-    while _time.monotonic() < deadline:
-        rc = child.poll()
-        if rc is not None:
-            fo.seek(0)
-            fe.seek(0)
-            out, err = fo.read(), fe.read()
-            rec["seconds"] = round(_time.monotonic() - t0, 1)
-            rec["rc"] = rc
-            if rc == 0:
-                plat, _, kind = out.strip().partition("|")
-                rec.update(ok=True, platform=plat, device_kind=kind)
-                _time.sleep(5)  # let the probe client's session settle
-            else:
-                tail = (err.strip().splitlines() or ["<no stderr>"])[-1]
-                rec.update(ok=False, error=tail[:300])
-            return rec
-        _time.sleep(1)
-    # still hanging: leave it be (no kill) and report not-ok
-    rec.update(ok=False, rc=None,
-               seconds=round(_time.monotonic() - t0, 1),
-               error="probe hung past timeout (child left to finish — "
-                     "killing mid-bring-up can wedge the session)")
+    rec = probe_device(
+        timeout_s=timeout_s,
+        argv=[sys.executable, "-c",
+              "import jax; d = jax.devices(); "
+              "assert d and d[0].platform != 'cpu', d; "
+              "print(d[0].platform + '|' + d[0].device_kind)"],
+        settle_s=5.0,
+    )
+    rec["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    if rec.get("ok"):
+        plat, _, kind = rec.pop("stdout", "").partition("|")
+        rec.update(platform=plat, device_kind=kind)
     return rec
 
 

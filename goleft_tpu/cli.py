@@ -89,7 +89,9 @@ def main(argv: list[str] | None = None) -> int:
     # using commands then bring the backend up HERE, under the hang
     # watchdog, so a wedged tunnel warns with that knob instead of
     # hanging silently inside the first jit call.
-    from .utils.device_guard import devices_with_watchdog, maybe_force_cpu
+    from .utils.device_guard import (
+        devices_with_watchdog, ensure_usable_backend, maybe_force_cpu,
+    )
 
     maybe_force_cpu()
     # multi-host world (no-op without GOLEFT_TPU_COORDINATOR): must come
@@ -98,6 +100,10 @@ def main(argv: list[str] | None = None) -> int:
 
     init_distributed()
     if PROGS[prog][2]:
+        # subprocess-probe first: a wedged tunnel degrades to host mode
+        # with one warning line instead of hanging this process inside
+        # backend bring-up (GOLEFT_TPU_PROBE=0 skips)
+        ensure_usable_backend()
         devices_with_watchdog()
     sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
     try:

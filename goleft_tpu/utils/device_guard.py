@@ -57,6 +57,110 @@ def maybe_force_cpu() -> bool:
     return True
 
 
+_PROBE_SNIPPET = ("import jax; d = jax.devices(); "
+                  "assert d and d[0].platform != 'cpu', d")
+
+
+def probe_device(timeout_s: float | None = None, argv=None,
+                 settle_s: float | None = None) -> dict:
+    """Probe accelerator bring-up in a SUBPROCESS — the ONE shared
+    implementation (bench.py's ``_probe_once`` wraps this): a wedged
+    tunnel hangs ``jax.devices()`` indefinitely, and only an isolated
+    child can be abandoned safely. The child is never killed — SIGKILL
+    mid-bring-up is a documented way to wedge the remote session; on
+    timeout the orphan is left to finish on its own and the probe
+    reports not-ok.
+
+    ``argv`` overrides the probe command (tests simulate hangs with a
+    sleeping child; overriding also skips the post-success settle —
+    there is no real device session to let tear down). ``settle_s``
+    overrides the settle explicitly (bench uses a longer one for its
+    tunnel). Returns {ok, seconds, rc, stdout?, error?}."""
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    if timeout_s is None:
+        timeout_s = WATCHDOG_SECONDS
+    if settle_s is None:
+        settle_s = 0.0 if argv is not None else 2.0
+    rec: dict = {"timeout_s": timeout_s}
+    t0 = time.monotonic()
+    # child output goes to TEMP FILES, not pipes: a verbose bring-up
+    # failure must not block the (never-killed) child on a full pipe
+    fo = tempfile.TemporaryFile(mode="w+")
+    fe = tempfile.TemporaryFile(mode="w+")
+    try:
+        child = subprocess.Popen(
+            argv or [sys.executable, "-c", _PROBE_SNIPPET],
+            stdout=fo, stderr=fe,
+        )
+    except OSError as e:
+        rec.update(ok=False, rc=None, error=f"spawn failed: {e!r}")
+        return rec
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rc = child.poll()
+        if rc is not None:
+            rec.update(ok=rc == 0, rc=rc,
+                       seconds=round(time.monotonic() - t0, 1))
+            if rc != 0:
+                fe.seek(0)
+                tail = (fe.read().strip().splitlines()
+                        or ["<no stderr>"])[-1]
+                rec["error"] = tail[:300]
+            else:
+                fo.seek(0)
+                rec["stdout"] = fo.read().strip()[:300]
+                time.sleep(settle_s)  # let the probe session tear down
+            return rec
+        time.sleep(0.2)
+    rec.update(ok=False, rc=None,
+               seconds=round(time.monotonic() - t0, 1),
+               error="probe hung past timeout (child left to finish)")
+    return rec
+
+
+def ensure_usable_backend(probe_argv=None) -> str:
+    """CLI device bring-up: subprocess-probe the accelerator and
+    degrade to HOST mode with one loud line when it is unusable,
+    instead of hanging until the watchdog (round-3 VERDICT item 8 —
+    the same wedged tunnel that hit the bench hits users).
+
+    Returns "device" (probe ok), "host" (probe failed -> platform
+    pinned to CPU), or "unprobed" (probing disabled/irrelevant:
+    GOLEFT_TPU_CPU already pinned, GOLEFT_TPU_PROBE=0, a multi-host
+    world under GOLEFT_TPU_COORDINATOR, or the backend already up)."""
+    if os.environ.get("GOLEFT_TPU_CPU"):
+        return "unprobed"  # explicitly pinned at dispatch already
+    if os.environ.get("GOLEFT_TPU_PROBE", "1").lower() in (
+            "0", "no", "false"):
+        return "unprobed"
+    if os.environ.get("GOLEFT_TPU_COORDINATOR"):
+        return "unprobed"  # distributed worlds manage their own backend
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return "unprobed"  # host explicitly requested — nothing to probe
+    rec = probe_device(argv=probe_argv)
+    if rec["ok"]:
+        return "device"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as e:  # backend already initialized — leave it
+        log.warning(
+            "accelerator probe failed (%s) but the jax backend is "
+            "already initialized (%s) — cannot fall back",
+            rec.get("error"), e)
+        return "unprobed"
+    log.warning(
+        "accelerator unusable (%s) — running on the host CPU instead; "
+        "set GOLEFT_TPU_PROBE=0 to skip this probe or GOLEFT_TPU_CPU=1 "
+        "to always pin the host", rec.get("error"))
+    return "host"
+
+
 def devices_with_watchdog(seconds: float | None = None):
     """``jax.devices()`` with a hang warning: if backend bring-up takes
     longer than ``seconds``, log what is probably wrong and how to
