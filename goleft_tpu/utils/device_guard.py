@@ -61,6 +61,14 @@ _PROBE_SNIPPET = ("import jax; d = jax.devices(); "
                   "assert d and d[0].platform != 'cpu', d")
 
 
+def _probe_cache_path() -> str:
+    import tempfile
+
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"goleft-tpu-probe-ok-{uid}")
+
+
 def probe_device(timeout_s: float | None = None, argv=None,
                  settle_s: float | None = None) -> dict:
     """Probe accelerator bring-up in a SUBPROCESS — the ONE shared
@@ -140,9 +148,42 @@ def ensure_usable_backend(probe_argv=None) -> str:
     if os.environ.get("GOLEFT_TPU_COORDINATOR"):
         return "unprobed"  # distributed worlds manage their own backend
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        return "unprobed"  # host explicitly requested — nothing to probe
+        # host explicitly requested — but some accelerator plugins
+        # force-override this env var, so honor the intent through the
+        # config API (the only pin that sticks) instead of trusting it
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # backend already up — leave it
+            pass
+        return "unprobed"
+    # cache a recent success: healthy hosts must not pay child bring-up
+    # + settle on every CLI invocation (GOLEFT_TPU_PROBE_TTL_SECONDS
+    # overrides; 0 disables the cache)
+    try:
+        ttl = float(os.environ.get("GOLEFT_TPU_PROBE_TTL_SECONDS",
+                                   "300"))
+    except ValueError:
+        ttl = 300.0
+    cache = _probe_cache_path()
+    if ttl > 0 and probe_argv is None:
+        import time
+
+        try:
+            if time.time() - os.path.getmtime(cache) < ttl:
+                return "device"
+        except OSError:
+            pass
     rec = probe_device(argv=probe_argv)
     if rec["ok"]:
+        if ttl > 0 and probe_argv is None:
+            try:
+                with open(cache, "w"):
+                    pass
+                os.utime(cache)
+            except OSError:
+                pass
         return "device"
     import jax
 

@@ -56,6 +56,8 @@ def _clear_probe_skips(monkeypatch):
     monkeypatch.delenv("GOLEFT_TPU_PROBE", raising=False)
     monkeypatch.delenv("GOLEFT_TPU_COORDINATOR", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    # keep tests hermetic: never read/write the shared success cache
+    monkeypatch.setenv("GOLEFT_TPU_PROBE_TTL_SECONDS", "0")
 
 
 def test_probe_hang_degrades_to_host(monkeypatch, caplog):
@@ -66,15 +68,14 @@ def test_probe_hang_degrades_to_host(monkeypatch, caplog):
 
     _clear_probe_skips(monkeypatch)
     monkeypatch.setattr(device_guard, "WATCHDOG_SECONDS", 0.4)
-    hang = [sys.executable, "-c", "import time; time.sleep(60)"]
+    # the child exits on its own shortly after the probe gives up (the
+    # never-kill policy leaves it; don't leak a long-lived orphan)
+    hang = [sys.executable, "-c", "import time; time.sleep(3)"]
     with caplog.at_level(logging.WARNING, logger="goleft-tpu.device"):
         mode = device_guard.ensure_usable_backend(probe_argv=hang)
     assert mode == "host"
     assert any("accelerator unusable" in r.message
                for r in caplog.records)
-    import jax
-
-    assert jax.default_backend() == "cpu"
 
 
 def test_probe_failure_degrades_to_host(monkeypatch, caplog):
