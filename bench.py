@@ -242,6 +242,85 @@ def _cram31_codec_entry_inner(quick: bool) -> dict:
     }
 
 
+_LASTGOOD_PATH = "BENCH_lastgood.json"
+# device-side entries worth carrying across a probe-failed round, in
+# the order the device phase records them
+_LASTGOOD_KEYS = ("device_kernels", "indexcov_cohort",
+                  "pallas_vs_xla_depth", "emdepth_em",
+                  "cohort_e2e_device")
+
+
+def _save_lastgood(probe_att: dict,
+                   details_path: str = "BENCH_details.json",
+                   lastgood_path: str = _LASTGOOD_PATH) -> bool:
+    """Snapshot this run's device entries + provenance into the
+    git-tracked BENCH_lastgood.json, so a future round whose probe
+    fails degrades to "stale chip numbers, flagged stale" instead of
+    "no chip numbers" (round-4 VERDICT item 1a: rounds 3 and 4 both
+    lost the committed chip record to one bad tunnel day)."""
+    import datetime
+    import subprocess
+
+    try:
+        with open(details_path) as fh:
+            det = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    entries = {k: det[k] for k in _LASTGOOD_KEYS
+               if isinstance(det.get(k), dict)
+               and "error" not in det[k]}
+    kern = entries.get("device_kernels", {})
+    if kern.get("platform") in (None, "cpu"):
+        return False  # host run — nothing device-side to pin
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        sha = None
+    doc = {
+        "provenance": {
+            "ts": datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            "git_sha": sha,
+            "device": kern.get("device"),
+            "platform": kern.get("platform"),
+            "probe_seconds": probe_att.get("seconds"),
+        },
+        "entries": entries,
+    }
+    with open(lastgood_path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    return True
+
+
+def _load_lastgood(lastgood_path: str = _LASTGOOD_PATH):
+    try:
+        with open(lastgood_path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "entries" not in doc:
+        return None
+    return doc
+
+
+def _drop_details(keys, details_path: str = "BENCH_details.json"):
+    """Remove keys from BENCH_details.json (e.g. a stale carryover
+    block once the device has been measured live again)."""
+    try:
+        with open(details_path) as fh:
+            det = json.load(fh)
+    except (OSError, ValueError):
+        return
+    if any(k in det for k in keys):
+        for k in keys:
+            det.pop(k, None)
+        with open(details_path, "w") as fh:
+            json.dump(det, fh, indent=1)
+
+
 def _merge_details(details: dict) -> dict:
     """Merge new entries into BENCH_details.json (preserving entries
     other modes wrote) and echo to stderr."""
@@ -385,8 +464,6 @@ def bench_suite(quick: bool, emit=None) -> dict:
                     "~30s for 30 samples",
         }
 
-    _rec("indexcov_e2e_wholegenome", _indexcov_e2e)
-
     # pallas vs XLA depth kernel at product shape (the pay-or-park
     # decision record: the XLA scatter+cumsum path sits on the memory
     # roofline; the pallas compare-reduction does O(endpoints/tile)
@@ -494,6 +571,10 @@ def bench_suite(quick: bool, emit=None) -> dict:
         }
 
     _rec("emdepth_em", _emdepth_em)
+    # host-side entries come AFTER the device portfolio (round-4
+    # VERDICT item 1c: a mid-suite tunnel wedge must cost host
+    # entries, never chip numbers)
+    _rec("indexcov_e2e_wholegenome", _indexcov_e2e)
     # decode-thread scaling: the executable artifact for the README's
     # multi-core claim (see tests/test_thread_scaling.py — same
     # measurement, judge-visible here)
@@ -761,14 +842,17 @@ def _probe_once(timeout_s: float = 120.0) -> dict:
     """
     import datetime
 
-    from goleft_tpu.utils.device_guard import probe_device
+    from goleft_tpu.utils.device_guard import (
+        arm_traceback_snippet, probe_device,
+    )
 
     rec = probe_device(
         timeout_s=timeout_s,
-        argv=[sys.executable, "-c",
-              "import jax; d = jax.devices(); "
-              "assert d and d[0].platform != 'cpu', d; "
-              "print(d[0].platform + '|' + d[0].device_kind)"],
+        argv=[sys.executable, "-c", arm_traceback_snippet(
+            "import jax; d = jax.devices(); "
+            "assert d and d[0].platform != 'cpu', d; "
+            "print(d[0].platform + '|' + d[0].device_kind)",
+            timeout_s)],
         settle_s=5.0,
     )
     rec["ts"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
@@ -966,11 +1050,15 @@ def main(argv=None):
     # across the run. Every attempt lands in the device_probe artifact.
     import os
 
+    # round-4 VERDICT item 1b: the 4×120s-probe + 240/480s-backoff
+    # policy burned ~20 minutes of a wedged tunnel and salvaged
+    # nothing — first probe ≤30s, TWO attempts max (the re-probe rides
+    # behind the host suite, costing no extra wall time)
     probe_timeout = float(
-        os.environ.get("GOLEFT_BENCH_PROBE_TIMEOUT", "120"))
+        os.environ.get("GOLEFT_BENCH_PROBE_TIMEOUT", "30"))
     backoffs = tuple(
         float(x) for x in os.environ.get(
-            "GOLEFT_BENCH_PROBE_BACKOFF", "0,240,480").split(",")
+            "GOLEFT_BENCH_PROBE_BACKOFF", "0").split(",")
         if x.strip())  # "" disables re-probing entirely
     host_done = False
     host_headline = None
@@ -1010,6 +1098,27 @@ def main(argv=None):
                 f"{len(probe['attempts'])} probes — host-only artifact "
                 "recorded (see device_probe block)", file=sys.stderr,
             )
+            # degrade to STALE chip numbers, loudly flagged — never to
+            # "no chip numbers" (round-4 VERDICT item 1a)
+            lg = _load_lastgood()
+            if lg is not None:
+                _merge_details({"device_lastgood": {
+                    "stale": True,
+                    "note": "probe failed this run; entries below are "
+                            "the most recent recorded device numbers "
+                            "(see provenance) — NOT measured this run",
+                    **lg,
+                }})
+                if host_headline is not None:
+                    kern_lg = lg["entries"].get("device_kernels", {})
+                    host_headline["device_lastgood"] = {
+                        "stale": True,
+                        "ts": lg.get("provenance", {}).get("ts"),
+                        "kernel_device_resident_gbases_per_sec":
+                            kern_lg.get(
+                                "kernel_device_resident"
+                                "_gbases_per_sec"),
+                    }
             if host_headline is not None:
                 print(json.dumps(host_headline))
             else:
@@ -1020,9 +1129,21 @@ def main(argv=None):
                 }))
             return
 
-    # device phase — kernels FIRST so a later wedge can't erase them
+    # device phase — the FULL device portfolio runs before any host
+    # entry (round-4 VERDICT item 1c): kernels, then the device suite
+    # entries (indexcov_cohort / pallas-vs-XLA / emdepth_em lead
+    # bench_suite), each merged as soon as it exists
     kern = bench_kernels(quick)
     _merge_details({"device_kernels": kern})
+    if not kernels_only:
+        try:
+            bench_suite(quick, emit=_merge_details)
+        except Exception as e:  # noqa: BLE001 — keep device results
+            _merge_details({"suite_error": repr(e)})
+    # pin this run's device numbers for future probe-failed rounds,
+    # and clear any stale carryover a previous failed round merged
+    if _save_lastgood(att):
+        _drop_details(["device_lastgood"])
     cohort = None
     if host_done and host_headline is not None:
         # reuse the cohort the host-suite child JUST recorded (pure
@@ -1042,13 +1163,8 @@ def main(argv=None):
         cohort = bench_cohort(
             *((20, 2_000_000, 3) if quick else (50, 10_000_000, 4)))
         _merge_details({"cohort_e2e": cohort})
-    if not kernels_only:
-        try:
-            bench_suite(quick, emit=_merge_details)
-        except Exception as e:  # noqa: BLE001 — keep device results
-            _merge_details({"suite_error": repr(e)})
-        if not host_done:
-            host_suite(quick, emit=_merge_details)
+    if not kernels_only and not host_done:
+        host_suite(quick, emit=_merge_details)
 
     print(json.dumps({
         "metric": "cohort_depth_e2e_gbases_per_sec",

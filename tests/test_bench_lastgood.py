@@ -1,0 +1,79 @@
+"""Last-good device-bench persistence (round-4 VERDICT item 1a).
+
+Rounds 3 and 4 both committed artifacts with ZERO chip numbers because
+the device probe failed on bench day. bench.py now pins each
+successful device run into the git-tracked BENCH_lastgood.json; a
+probe-failed run merges those entries back into BENCH_details.json
+as a loudly-flagged stale carryover instead of losing the record.
+"""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "goleft_bench", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _details(tmp_path, doc):
+    p = str(tmp_path / "details.json")
+    with open(p, "w") as fh:
+        json.dump(doc, fh)
+    return p
+
+
+def test_save_load_roundtrip_excludes_errored_entries(tmp_path):
+    det = _details(tmp_path, {
+        "device_kernels": {
+            "platform": "tpu", "device": "TPU v5 lite0",
+            "kernel_device_resident_gbases_per_sec": 50.0,
+        },
+        "indexcov_cohort": {"samples": 500, "seconds": 0.1},
+        "emdepth_em": {"error": "RuntimeError('wedged')"},
+        "cohort_e2e": {"gbases_per_sec": 0.5},  # host entry: not pinned
+    })
+    lg_path = str(tmp_path / "lastgood.json")
+    assert bench._save_lastgood({"seconds": 3.2}, details_path=det,
+                                lastgood_path=lg_path)
+    doc = bench._load_lastgood(lg_path)
+    assert doc["provenance"]["ts"]  # stamped
+    assert doc["provenance"]["device"] == "TPU v5 lite0"
+    assert doc["provenance"]["probe_seconds"] == 3.2
+    assert set(doc["entries"]) == {"device_kernels", "indexcov_cohort"}
+
+
+def test_save_refuses_host_only_run(tmp_path):
+    det = _details(tmp_path, {"device_kernels": {"platform": "cpu"}})
+    lg_path = str(tmp_path / "lastgood.json")
+    assert not bench._save_lastgood({}, details_path=det,
+                                    lastgood_path=lg_path)
+    assert not os.path.exists(lg_path)
+    assert bench._load_lastgood(lg_path) is None
+
+
+def test_drop_details_removes_stale_carryover(tmp_path):
+    det = _details(tmp_path, {"device_lastgood": {"stale": True},
+                              "cohort_e2e": {"gbases_per_sec": 0.5}})
+    bench._drop_details(["device_lastgood"], details_path=det)
+    with open(det) as fh:
+        out = json.load(fh)
+    assert "device_lastgood" not in out
+    assert out["cohort_e2e"]["gbases_per_sec"] == 0.5
+
+
+def test_committed_lastgood_carries_chip_numbers():
+    """The repo must always ship a loadable BENCH_lastgood.json whose
+    kernel entry is a real device measurement — this is what a
+    probe-failed round falls back to."""
+    doc = bench._load_lastgood(os.path.join(REPO,
+                                            "BENCH_lastgood.json"))
+    assert doc is not None, "BENCH_lastgood.json missing or unreadable"
+    kern = doc["entries"]["device_kernels"]
+    assert kern["platform"] not in (None, "cpu")
+    assert kern["kernel_device_resident_gbases_per_sec"] > 1.0
+    prov = doc["provenance"]
+    assert prov.get("ts") or prov.get("seeded_from")

@@ -78,6 +78,26 @@ def test_probe_hang_degrades_to_host(monkeypatch, caplog):
                for r in caplog.records)
 
 
+def test_probe_timeout_captures_child_traceback():
+    """A probe child that hangs must leave its OWN stack trace in the
+    attempt record (faulthandler armed before the parent's deadline) —
+    round-4 lost four 120s probes with nothing but an attempt count to
+    diagnose from (VERDICT r4 item 8)."""
+    import sys
+
+    snippet = device_guard.arm_traceback_snippet(
+        "import time; time.sleep(3)", 1.2)
+    # -S: interpreter startup is ~2.5s with site imports on this box,
+    # which would eat the whole 1.5s window before faulthandler arms
+    rec = device_guard.probe_device(
+        timeout_s=1.5, argv=[sys.executable, "-S", "-c", snippet])
+    assert rec["ok"] is False and rec["rc"] is None
+    # the hang point is a C-level sleep, so the innermost Python frame
+    # is the "<string>" module — assert the dump shape, not a name
+    tail = rec.get("traceback_tail", "")
+    assert "Timeout" in tail and "Thread" in tail, rec
+
+
 def test_probe_failure_degrades_to_host(monkeypatch, caplog):
     import sys
 
