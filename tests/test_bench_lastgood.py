@@ -77,3 +77,27 @@ def test_committed_lastgood_carries_chip_numbers():
     assert kern["kernel_device_resident_gbases_per_sec"] > 1.0
     prov = doc["provenance"]
     assert prov.get("ts") or prov.get("seeded_from")
+
+
+def test_pinned_baseline_committed_and_preferred(tmp_path, monkeypatch):
+    """vs_baseline must divide by the PINNED constant
+    (BASELINE_PINNED.json) so cross-round ratios are comparable by
+    construction — the live measurement swung 2x between rounds 3 and
+    4 (VERDICT r4 item 5)."""
+    with open(os.path.join(REPO, "BASELINE_PINNED.json")) as fh:
+        pin = json.load(fh)
+    assert pin["numpy_kernel_gbases_per_sec"] > 0
+    prov = pin["provenance"]
+    assert prov["ts"] and len(prov["runs_seconds"]) >= 5
+    assert prov["workload"]["ref_bp"] == 10_000_000
+
+    monkeypatch.chdir(tmp_path)
+    cohort = {"numpy_kernel_gbases_per_sec": 0.999}
+    v, info = bench._baseline_block(cohort)  # no pin file here
+    assert v == 0.999 and info["pinned"] is False
+    with open(tmp_path / "BASELINE_PINNED.json", "w") as fh:
+        json.dump(pin, fh)
+    v, info = bench._baseline_block(cohort)
+    assert v == pin["numpy_kernel_gbases_per_sec"]
+    assert info["pinned"] is True
+    assert info["measured_this_run_gbases_per_sec"] == 0.999
