@@ -139,8 +139,12 @@ def main(argv=None):
     p.add_argument("--engine", choices=("auto", "hybrid", "device"),
                    default="auto",
                    help="cohort matrix engine (see cohortdepth --engine)")
+    from . import add_no_crc_flag, apply_no_crc
+
+    add_no_crc_flag(p)
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    apply_no_crc(a.no_crc)
     from ..parallel.mesh import init_distributed
 
     init_distributed()  # idempotent; the CLI dispatcher already ran it

@@ -393,8 +393,12 @@ def main(argv=None):
                    help="hybrid: fused C++ host reduction (default when "
                         "native io is available); device: per-read "
                         "segments to the chip")
+    from . import add_no_crc_flag, apply_no_crc
+
+    add_no_crc_flag(p)
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    apply_no_crc(a.no_crc)
     from ..parallel.mesh import init_distributed
 
     init_distributed()  # idempotent; the CLI dispatcher already ran it

@@ -364,8 +364,12 @@ def main(argv=None):
     p.add_argument("-p", "--processes", type=int, default=4,
                    help="files sampled in parallel (decode threads; "
                         "output order is unchanged)")
+    from . import add_no_crc_flag, apply_no_crc
+
+    add_no_crc_flag(p)
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    apply_no_crc(a.no_crc)
     run_covstats(a.bams, n=a.n, regions=a.regions,
                  processes=a.processes)
 

@@ -355,8 +355,12 @@ def main(argv=None):
     p.add_argument("--profile", default=None,
                    help="write a JAX profiler trace to this directory")
     p.add_argument("--prefix", required=True)
+    from . import add_no_crc_flag, apply_no_crc
+
+    add_no_crc_flag(p)
     p.add_argument("bam")
     a = p.parse_args(argv)
+    apply_no_crc(a.no_crc)
     run_depth(
         a.bam, a.prefix, reference=a.reference, window=a.windowsize,
         min_cov=a.mincov, max_mean_depth=a.maxmeandepth, mapq=a.mapq,

@@ -165,8 +165,12 @@ def main(argv=None):
     p.add_argument("-m", "--minsize", type=int, default=15)
     p.add_argument("-w", "--window", type=int, default=10_000_000)
     p.add_argument("--minsamples", type=float, default=0.5)
+    from . import add_no_crc_flag, apply_no_crc
+
+    add_no_crc_flag(p)
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
+    apply_no_crc(a.no_crc)
     run_multidepth(
         a.bams, a.chrom, mapq=a.mapq, min_cov=a.mincov, max_cov=a.maxcov,
         max_skip=a.maxskip, min_size=a.minsize, window=a.window,
