@@ -155,27 +155,43 @@ def _fabricate_bai_cohort(d: str, n_ix: int, chrom_lens, rng) -> list:
 
 
 def _thread_scaling_entry() -> dict:
-    """Decode-thread scaling measurement entry (pure host work)."""
+    """Decode-thread scaling entry (pure host work): the full
+    speedup-vs-workers curve plus the optimal count a cohort run
+    should use (round-4 VERDICT item 4 — a single 1-core ratio proved
+    GIL release but never scaling)."""
     import tempfile
 
     try:
         from goleft_tpu.utils.decode_scaling import (
-            build_cohort, effective_cores, measure_scaling,
+            build_cohort, effective_cores, measure_scaling_curve,
+            optimal_threads,
         )
         with tempfile.TemporaryDirectory(prefix="goleft_thr_") as td:
             paths, rl = build_cohort(td)
-            t_ser, t_thr, n_tasks = measure_scaling(paths, rl)
+            curve = measure_scaling_curve(paths, rl)
+        t_ser = curve[1]
+        opt = optimal_threads(curve)
+        n_tasks = len(paths)
+        # the historical bench point: a full-width pool (one worker
+        # per task), so threaded_over_serial compares across rounds
+        peak = n_tasks
         return {
-            "threads": n_tasks,
+            "threads": peak,
             "effective_cores": effective_cores(),
             "serial_seconds": round(t_ser, 4),
-            "threaded_seconds": round(t_thr, 4),
-            "threaded_over_serial": round(t_thr / t_ser, 3),
+            "threaded_seconds": round(curve[peak], 4),
+            "threaded_over_serial": round(curve[peak] / t_ser, 3),
+            "curve_seconds": {str(n): round(t, 4)
+                              for n, t in sorted(curve.items())},
+            "optimal_threads": opt,
+            "speedup_at_optimal": round(t_ser / curve[opt], 3),
             "platform": "host (no device work)",
-            "note": "N concurrent native window_reduce calls on "
-                    "distinct files; on a 1-core host the ratio bounds "
-                    "GIL-release overhead (speedup impossible), on "
-                    "multi-core it must approach 1/min(N, cores)",
+            "note": f"{n_tasks} native window_reduce tasks on distinct "
+                    "files under 1..N-thread pools; on a 1-core host "
+                    "the ratio bounds GIL-release overhead (speedup "
+                    "impossible), on multi-core the curve must fall "
+                    "toward serial/min(workers, cores). "
+                    "optimal_threads feeds the cohort e2e run",
         }
     except Exception as e:  # pragma: no cover - keep bench robust
         return {"error": str(e)}
@@ -646,22 +662,40 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
             pass
 
     fai = f"{d}/ref.fa.fai"
+    from goleft_tpu.utils.decode_scaling import (
+        auto_processes, measure_scaling_curve, optimal_threads,
+    )
+
     # the headline MUST measure the strict default: clear any inherited
     # skip-crc knob for the timed runs and restore it afterwards
     import os as _os
 
     prev_skip = _os.environ.pop("GOLEFT_TPU_SKIP_CRC", None)
     try:
+        # cold run FIRST (library load + first-touch included), at the
+        # product-default pool size — exactly what a fresh CLI run does
         t0 = _t.perf_counter()
-        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+        run_cohortdepth(bams, fai=fai, window=500, out=_Null(),
+                        processes=auto_processes())
         cold = _t.perf_counter() - t0
+        # decode-pool size for the steady-state runs: the MEASURED
+        # optimum on this host (round-4 VERDICT item 4). Probe with
+        # enough files that candidates are not capped below the core
+        # count — a 4-file probe would cap an 8-core host at 4 threads
+        n_probe = min(n_samples, max(4, 2 * auto_processes()))
+        # repeats=2: the pool size steering the headline must not be
+        # picked off a single noisy timing on a shared host
+        dec_curve = measure_scaling_curve(
+            bams[:n_probe], ref_len, window=500, repeats=2)
+        n_dec = optimal_threads(dec_curve)
         # steady state (caches warm — what a whole-genome run
         # amortizes to): best of two, the least-noise estimator on a
         # shared host (same policy as the numpy baseline's best-of-3)
         wall = float("inf")
         for _ in range(2):
             t0 = _t.perf_counter()
-            run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+            run_cohortdepth(bams, fai=fai, window=500, out=_Null(),
+                            processes=n_dec)
             wall = min(wall, _t.perf_counter() - t0)
         # non-default variant: BGZF payload CRC verification skipped
         # (GOLEFT_TPU_SKIP_CRC=1, trusted local files). Recorded for
@@ -669,7 +703,8 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
         # default.
         _os.environ["GOLEFT_TPU_SKIP_CRC"] = "1"
         t0 = _t.perf_counter()
-        run_cohortdepth(bams, fai=fai, window=500, out=_Null())
+        run_cohortdepth(bams, fai=fai, window=500, out=_Null(),
+                        processes=n_dec)
         wall_nocrc = _t.perf_counter() - t0
     finally:
         if prev_skip is None:
@@ -734,6 +769,12 @@ def bench_cohort(n_samples: int = 50, ref_len: int = 10_000_000,
         "samples": n_samples, "ref_bp": ref_len, "coverage": coverage,
         "wall_seconds_warm": round(wall, 3),
         "wall_seconds_cold": round(cold, 3),
+        "decode_threads_used": n_dec,
+        "decode_thread_probe": {str(k): round(v, 4)
+                                for k, v in sorted(dec_curve.items())},
+        "cold_note": "cold run uses the product-default pool "
+                     "(auto_processes) and includes library load + "
+                     "first touch; warm runs use the probed optimum",
         "gbases_per_sec": round(gbases / wall, 4),
         "gbases_per_sec_skip_crc": round(gbases / wall_nocrc, 4),
         "stage_seconds": {

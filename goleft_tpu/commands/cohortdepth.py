@@ -26,7 +26,7 @@ from ..io.bai import read_bai, query_voffset
 from ..io.bam import ReadColumns, open_bam_file
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
-from ..utils.decode_scaling import effective_cores
+from ..utils.decode_scaling import auto_processes, effective_cores
 from ..ops.depth_pipeline import shard_depth_pipeline
 from . import depth as _depth
 from .depth import DEPTH_CAP_EXTRA, gen_regions
@@ -387,7 +387,10 @@ def main(argv=None):
                         "analog of depth -b)")
     p.add_argument("-r", "--reference", default=None)
     p.add_argument("--fai", default=None)
-    p.add_argument("-p", "--processes", type=int, default=8)
+    p.add_argument("-p", "--processes", type=int, default=None,
+                   help="decode threads (default: one per effective "
+                        "core, capped at 8 — on a 1-core host that is "
+                        "1, which takes the serial no-churn path)")
     p.add_argument("--engine", choices=("auto", "hybrid", "device"),
                    default="auto",
                    help="hybrid: fused C++ host reduction (default when "
@@ -404,7 +407,9 @@ def main(argv=None):
     init_distributed()  # idempotent; the CLI dispatcher already ran it
     run_cohortdepth(
         a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
-        mapq=a.mapq, chrom=a.chrom, processes=a.processes,
+        mapq=a.mapq, chrom=a.chrom,
+        processes=(auto_processes() if a.processes is None
+                   else a.processes),
         engine=a.engine, bed=a.bed,
     )
 

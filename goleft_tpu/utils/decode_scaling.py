@@ -65,9 +65,39 @@ def _timed(fn) -> float:
 def measure_scaling(paths, ref_len: int, window: int = 500,
                     repeats: int = 2):
     """(serial_seconds, threaded_seconds, n_tasks) for one full-region
-    reduce per file, best-of-``repeats``."""
+    reduce per file, best-of-``repeats`` — the two-point special case
+    of :func:`measure_scaling_curve`."""
+    curve = measure_scaling_curve(paths, ref_len, window, repeats,
+                                  thread_counts=[1, len(paths)])
+    return curve[1], curve[len(paths)], len(paths)
+
+
+def default_thread_counts(cores: int | None = None, n_tasks: int = 4):
+    """Worker counts worth measuring on this host: 1, the core count,
+    the midpoint, one oversubscribed point (capped by tasks — more
+    workers than tasks measures nothing) and the full task width (the
+    historical bench point, kept so threaded_over_serial stays
+    comparable across rounds)."""
+    cores = effective_cores() if cores is None else cores
+    cand = {1, min(2, n_tasks), min(cores, n_tasks),
+            min(2 * cores, n_tasks), n_tasks}
+    return sorted(cand)
+
+
+def measure_scaling_curve(paths, ref_len: int, window: int = 500,
+                          repeats: int = 2, thread_counts=None):
+    """Speedup-vs-workers curve: {n_workers: best_seconds} for one
+    full-region reduce per file under an ``n_workers``-thread pool
+    (n=1 is the serial wall). The analog the reference tunes with its
+    process pool (depth/depth.go:392-394); on a 1-core host the curve
+    is flat-plus-overhead, on multi-core it must fall toward
+    serial/min(workers, cores)."""
     from ..io.bam import BamFile
 
+    if thread_counts is None:
+        thread_counts = default_thread_counts(n_tasks=len(paths))
+    # handles (and their mmaps) are function-local: the reduce outputs
+    # are fresh arrays, so nothing retains the mapped views past return
     handles = [BamFile.from_file(p, lazy=True) for p in paths]
 
     def reduce_one(h):
@@ -77,13 +107,29 @@ def measure_scaling(paths, ref_len: int, window: int = 500,
     for h in handles:  # warm page cache + native lib
         reduce_one(h)
 
-    t_serial = min(
-        _timed(lambda: [reduce_one(h) for h in handles])
-        for _ in range(repeats)
-    )
-    with cf.ThreadPoolExecutor(max_workers=len(handles)) as ex:
-        t_thread = min(
-            _timed(lambda: list(ex.map(reduce_one, handles)))
-            for _ in range(repeats)
-        )
-    return t_serial, t_thread, len(handles)
+    curve = {}
+    for n in thread_counts:
+        if n <= 1:
+            curve[1] = min(
+                _timed(lambda: [reduce_one(h) for h in handles])
+                for _ in range(repeats))
+            continue
+        with cf.ThreadPoolExecutor(max_workers=n) as ex:
+            curve[n] = min(
+                _timed(lambda: list(ex.map(reduce_one, handles)))
+                for _ in range(repeats))
+    return curve
+
+
+def optimal_threads(curve: dict) -> int:
+    """The worker count a cohort run should use: fastest point of the
+    measured curve; ties break toward FEWER threads (less memory, less
+    churn)."""
+    return min(sorted(curve), key=lambda n: (curve[n], n))
+
+
+def auto_processes(cap: int = 8) -> int:
+    """Affinity-aware default worker count for decode pools: one per
+    effective core, capped. On a 1-core host this is 1, which routes
+    the cohort engine onto its serial path (no thread churn)."""
+    return max(1, min(cap, effective_cores()))
