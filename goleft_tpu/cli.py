@@ -108,6 +108,26 @@ def main(argv: list[str] | None = None) -> int:
     sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
     try:
         ret = PROGS[prog][1](argv[1:])
+        # flush INSIDE the guard: when the downstream exits before
+        # reading anything (| head -c0), the EPIPE only surfaces at
+        # the exit-time flush — which would otherwise print
+        # "Exception ignored in <stdout>" and exit 120
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # downstream closed our stdout (`... | head`): the reference's
+        # Go tools die to SIGPIPE silently; match that (exit 141 =
+        # 128+SIGPIPE) instead of spraying a traceback. stdout's fd is
+        # pointed at devnull so the interpreter's exit flush cannot
+        # raise a second BrokenPipeError.
+        import os
+
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+        except (OSError, ValueError, AttributeError):
+            # (io.UnsupportedOperation subclasses OSError/ValueError)
+            pass
+        return 141
     except ValueError as e:
         # the io parsers raise typed ValueError on corrupt input (bai/
         # crai/fai/bed contract; bam/cram convert to SystemExit in

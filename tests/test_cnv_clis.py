@@ -151,3 +151,59 @@ def test_dcnv_plot_pages(tmp_path, monkeypatch):
     run_dcnv(p, fasta, out=io.StringIO(), plot_prefix="dd")
     page = (tmp_path / "dd-depth-chr9.html").read_text()
     assert "scaled coverage" in page and "dcnv_chr9" in page
+
+
+def test_cli_broken_pipe_is_silent(tmp_path, monkeypatch, capsys):
+    """`goleft-tpu emdepth m.tsv | head` must die like the reference's
+    SIGPIPE (exit 141), not spray a BrokenPipeError traceback."""
+    import numpy as np
+
+    m = tmp_path / "m.tsv"
+    rng = np.random.default_rng(3)
+    rows = ["#chrom\tstart\tend\ts1\ts2"]
+    for i in range(300):
+        rows.append(f"chr1\t{i * 500}\t{(i + 1) * 500}\t"
+                    f"{rng.poisson(30)}\t{rng.poisson(30)}")
+    m.write_text("\n".join(rows) + "\n")
+
+    class _ClosedPipe:
+        def write(self, *_):
+            raise BrokenPipeError(32, "Broken pipe")
+
+        def flush(self):
+            pass
+
+    monkeypatch.setattr("sys.stdout", _ClosedPipe())
+    rc = cli_main(["emdepth", str(m)])
+    assert rc == 141
+    err = capsys.readouterr().err
+    assert "Traceback" not in err and "BrokenPipeError" not in err
+
+
+def test_cli_broken_pipe_at_exit_flush_is_silent(tmp_path, monkeypatch,
+                                                 capsys):
+    """The pipe can also break only at the final flush (downstream
+    exited before reading while our output sat in the block buffer) —
+    the success path must route through the same silent-141 handler."""
+
+    class _BuffersThenBreaks:
+        def write(self, *_):
+            return None  # swallowed into the "buffer"
+
+        def flush(self):
+            raise BrokenPipeError(32, "Broken pipe")
+
+    monkeypatch.setattr("sys.stdout", _BuffersThenBreaks())
+    import numpy as np
+
+    m = tmp_path / "m.tsv"
+    rng = np.random.default_rng(3)
+    rows = ["#chrom\tstart\tend\ts1\ts2"]
+    for i in range(60):
+        rows.append(f"chr1\t{i * 500}\t{(i + 1) * 500}\t"
+                    f"{rng.poisson(30)}\t{rng.poisson(30)}")
+    m.write_text("\n".join(rows) + "\n")
+    rc = cli_main(["emdepth", str(m)])
+    assert rc == 141
+    err = capsys.readouterr().err
+    assert "Traceback" not in err and "BrokenPipeError" not in err
