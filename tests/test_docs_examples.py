@@ -70,6 +70,9 @@ def _artifact_value(keyspec: str) -> float:
     return float(cur)
 
 
+MARKED_DOCS = (README, os.path.join(REPO, "docs", "perf.md"))
+
+
 def test_readme_perf_numbers_match_recorded_artifacts():
     """Round-2 and round-3 both caught the README quoting performance
     numbers that no committed artifact contained. Every perf claim now
@@ -77,20 +80,26 @@ def test_readme_perf_numbers_match_recorded_artifacts():
     quotes; this test asserts the key EXISTS in the committed artifact
     and the displayed number (the last number before the marker)
     matches it within tolerance — making that drift class structurally
-    impossible (VERDICT r3 item 5)."""
-    text = open(README).read()
-    markers = list(MARKER.finditer(text))
-    assert len(markers) >= 5, "README lost its bench markers"
-    for m in markers:
-        keyspec, tol = m.group(1), float(m.group(2) or 0.25)
-        prefix = text[max(0, m.start() - 80):m.start()]
-        nums = re.findall(r"(\d+(?:\.\d+)?)", prefix)
-        assert nums, f"no displayed number before marker {keyspec}"
-        shown = float(nums[-1])
-        actual = _artifact_value(keyspec)
-        assert abs(shown - actual) <= tol * max(abs(actual), 1e-9), (
-            f"README shows {shown} for {keyspec} but the committed "
-            f"artifact records {actual} (tol {tol:.0%})")
+    impossible (VERDICT r3 item 5). docs/perf.md's scaling-model
+    numbers are held to the same contract."""
+    for doc in MARKED_DOCS:
+        text = open(doc).read()
+        markers = list(MARKER.finditer(text))
+        if doc == README:
+            assert len(markers) >= 5, "README lost its bench markers"
+        for m in markers:
+            keyspec, tol = m.group(1), float(m.group(2) or 0.25)
+            prefix = text[max(0, m.start() - 80):m.start()]
+            nums = re.findall(r"(\d+(?:\.\d+)?)", prefix)
+            assert nums, (
+                f"{doc}: no displayed number before marker {keyspec}")
+            shown = float(nums[-1])
+            actual = _artifact_value(keyspec)
+            assert abs(shown - actual) <= tol * max(abs(actual),
+                                                    1e-9), (
+                f"{os.path.basename(doc)} shows {shown} for {keyspec} "
+                f"but the committed artifact records {actual} "
+                f"(tol {tol:.0%})")
 
 
 def test_readme_perf_table_rows_all_carry_markers():

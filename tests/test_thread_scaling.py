@@ -149,7 +149,10 @@ def test_bench_entry_records_curve_and_optimal():
     assert e["optimal_threads"] in {int(k) for k in e["curve_seconds"]}
     assert e["curve_seconds"][str(1)] > 0
     assert e["speedup_at_optimal"] >= 0.9  # 1-core: ~1.0; multi-core: >1
-    # entry values are rounded for the artifact — compare loosely
+    # the entry computes the ratio from UNROUNDED timings while
+    # curve_seconds carries 4-decimal values: at ~15ms walls the
+    # rounding alone moves the recomputed ratio up to ~1%, so compare
+    # at 3% — this checks consistency, not precision
     assert e["threaded_over_serial"] == pytest.approx(
         e["curve_seconds"][str(e["threads"])]
-        / e["curve_seconds"]["1"], rel=5e-3)
+        / e["curve_seconds"]["1"], rel=3e-2)
