@@ -197,3 +197,41 @@ def test_valid_stream_data_flip_needs_crc(cohort, tmp_path, capsys,
     monkeypatch.setenv("GOLEFT_TPU_SKIP_CRC", "1")
     assert _run(bad, fai) == 0
     assert capsys.readouterr().out != good_out
+
+
+def test_no_crc_identity_depth_and_covstats(cohort, tmp_path, capsys):
+    """--no-crc is wired on every decode-heavy subcommand; depth and
+    covstats must also produce byte-identical output with it."""
+    import os
+
+    bam, fai = cohort
+    # the cohort fixture's fai already sits at ref.fa.fai; the stub
+    # fasta body is never read (depth only needs lengths)
+    ref = str(tmp_path / "ref.fa")
+    with open(ref, "w") as fh:
+        fh.write(">chr1\n" + "A" * 60 + "\n")
+
+    def run_and_check_knob(argv, flags):
+        os.environ.pop("GOLEFT_TPU_SKIP_CRC", None)
+        rc = cli_main(argv + list(flags) + [bam])
+        assert rc in (0, None)
+        if "--no-crc" in flags:
+            # the flag must have ENGAGED, or the comparison is
+            # vacuously strict-vs-strict
+            assert os.environ.get("GOLEFT_TPU_SKIP_CRC") == "1"
+
+    def beds(prefix, *flags):
+        run_and_check_knob(
+            ["depth", "--prefix", str(tmp_path / prefix),
+             "-r", ref, "-w", "500"], flags)
+        return (open(f"{tmp_path / prefix}.depth.bed").read(),
+                open(f"{tmp_path / prefix}.callable.bed").read())
+
+    assert beds("strict") == beds("fast", "--no-crc")
+
+    def covs(*flags):
+        capsys.readouterr()  # drain: only THIS run's stdout compares
+        run_and_check_knob(["covstats"], flags)
+        return capsys.readouterr().out
+
+    assert covs() == covs("--no-crc")
