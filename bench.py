@@ -998,11 +998,13 @@ def bench_cohort_device(n_samples: int = 20, ref_len: int = 4_000_000,
                 f"({len(out_h)} vs {len(out_d)} bytes)")
 
         # host-side segment extraction alone (the device engine's
-        # irreducible host work), serial like the runs above
+        # irreducible host work), serial like the runs above — the
+        # SAME read_segments streaming call the engine's decode stage
+        # makes (filtered/clipped endpoints, no column arrays)
         def extract_all():
             for p in bams:
                 bf = BamFile.from_file(p, lazy=True)
-                bf.read_columns(tid=0, start=0, end=ref_len)
+                bf.read_segments(0, 0, ref_len, 1, 0x704)
 
         extract_all()
         t_extract = min(_timed(extract_all) for _ in range(2))

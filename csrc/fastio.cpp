@@ -632,6 +632,29 @@ static long bwa_walk(void* stv, const uint8_t* buf, long have,
     return bam_walk_records((BwaState*)stv, buf, have, rpos_io);
 }
 
+// Segment collector: append each clipped, filter-passing M/=/X segment
+// instead of reducing — the device segment path's host stage. Using
+// the SAME walk template as the reduce paths means the shipped segment
+// set is the reduce engines' segment set by construction. Past cap the
+// walk keeps counting (no writes) so the caller can size one retry.
+struct BsgState : WalkCommon {
+    int32_t* seg_s;
+    int32_t* seg_e;
+    long cap, n;
+    inline void segment(long s, long e) {
+        if (n < cap) {
+            seg_s[n] = (int32_t)s;
+            seg_e[n] = (int32_t)e;
+        }
+        n++;
+    }
+};
+
+static long bsg_walk(void* stv, const uint8_t* buf, long have,
+                     long* rpos_io) {
+    return bam_walk_records((BsgState*)stv, buf, have, rpos_io);
+}
+
 extern "C" {
 
 // Capped cumsum + region mask + window sums in one scan, re-zeroing each
@@ -899,6 +922,29 @@ long bam_window_acc_stream(const uint8_t* comp, long comp_len,
     for (long w = 0; w < n_win; w++)
         if (wcount[w] > mx) mx = wcount[w];
     *max_overlap_out = mx;
+    return st.nk;
+}
+
+// Streaming segment extraction for the device segment path: walk the
+// region once and emit absolute [s, e) endpoints of every clipped,
+// mapq/flag-passing aligned segment (w0 = 0, clip ceiling = end).
+// Returns kept-read count; *n_out = segments emitted (when > cap the
+// buffers were too small and the caller re-calls with cap >= *n_out —
+// nothing was written past cap). Explicit end required.
+long bam_segments_stream(const uint8_t* comp, long comp_len,
+                         long c_begin, long in_block,
+                         int target_tid, int start, int end,
+                         int min_mapq, int flag_mask, int check_crc,
+                         int32_t* seg_s, int32_t* seg_e, long cap,
+                         long* n_out) {
+    if (end < 0) return -8;
+    BsgState st = {{target_tid, start, end, /*w0=*/0, /*length=*/end,
+                    min_mapq, flag_mask, 0},
+                   seg_s, seg_e, cap, 0};
+    long status = bgzf_stream_walk(comp, comp_len, c_begin, in_block,
+                                   check_crc, bsg_walk, &st);
+    if (status < 0) return status;
+    *n_out = st.n;
     return st.nk;
 }
 

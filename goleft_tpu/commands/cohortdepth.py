@@ -23,7 +23,7 @@ import jax
 import numpy as np
 
 from ..io.bai import read_bai, query_voffset
-from ..io.bam import ReadColumns, open_bam_file
+from ..io.bam import filter_clip_segments, open_bam_file
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
 from ..utils.decode_scaling import auto_processes, effective_cores
@@ -192,17 +192,25 @@ def cohort_matrix_blocks(
             sharding = NamedSharding(mesh, P("data", None))
             S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 
+    _EMPTY_SEGS = (np.empty(0, np.int32), np.empty(0, np.int32))
+
     def decode(args):
+        """(seg_start, seg_end) already filtered/clipped for the device
+        segment path. BamFile streams them through the C walk shared
+        with the reduce engines (io/bam.py::read_segments — no column
+        arrays, no uncompressed-body materialization); CRAM handles
+        fall back to columns + host filter with identical semantics."""
         h, bai, tid, s, e = args
         if tid < 0:
-            return ReadColumns.empty()
-        if bai is None:  # CRAM: .crai-driven access inside the handle
-            return h.read_columns(tid=tid, start=s, end=e)
-        voff = query_voffset(bai, tid, s)
-        if voff is None:
-            return ReadColumns.empty()
-        return h.read_columns(tid=tid, start=s, end=e, voffset=voff,
-                              end_voffset=query_voffset(bai, tid, e))
+            return _EMPTY_SEGS
+        rs = getattr(h, "read_segments", None)
+        if rs is not None and bai is not None:
+            voff = query_voffset(bai, tid, s)
+            if voff is None:
+                return _EMPTY_SEGS
+            return rs(tid, s, e, mapq, 0x704, voffset=voff)
+        cols = h.read_columns(tid=tid, start=s, end=e)
+        return filter_clip_segments(cols, s, e, mapq, 0x704)
 
     def submit_decodes(ex, c, s, e):
         return [
@@ -276,22 +284,21 @@ def cohort_matrix_blocks(
             # decode shard k+1 (native decode releases the GIL)
             pending = submit_decodes(ex, *regions[0])
             for ri, (c, s, e) in enumerate(regions):
-                cols = [f.result() for f in pending]
+                segs = [f.result() for f in pending]
                 if ri + 1 < len(regions):
                     pending = submit_decodes(ex, *regions[ri + 1])
-                n_max = max((len(cl.seg_start) for cl in cols), default=0)
+                n_max = max((len(ss) for ss, _ in segs), default=0)
                 b = bucket_size(max(n_max, 1))
                 seg_s = np.zeros((S_pad, b), dtype=np.int32)
                 seg_e = np.zeros((S_pad, b), dtype=np.int32)
                 keep = np.zeros((S_pad, b), dtype=bool)
-                for i, cl in enumerate(cols):
-                    n = len(cl.seg_start)
+                for i, (ss, ee) in enumerate(segs):
+                    n = len(ss)
                     if not n:
                         continue
-                    seg_s[i, :n] = cl.seg_start
-                    seg_e[i, :n] = cl.seg_end
-                    ok = (cl.mapq >= mapq) & ((cl.flag & 0x704) == 0)
-                    keep[i, :n] = ok[cl.seg_read]
+                    seg_s[i, :n] = ss
+                    seg_e[i, :n] = ee
+                    keep[i, :n] = True  # pre-filtered in decode()
                 w0 = s // window * window
                 args = (seg_s, seg_e, keep)
                 if sharding is not None:

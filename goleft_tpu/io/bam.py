@@ -591,6 +591,41 @@ class BamFile:
                 continue
             u_off += out["consumed"]
 
+    def read_segments(self, tid: int, start: int, end: int,
+                      min_mapq: int, flag_mask: int,
+                      voffset: int | None = None):
+        """(seg_start, seg_end) int32 arrays of the region's FILTERED
+        clipped M/=/X segments — the device segment path's host stage.
+
+        On lazy native handles this streams through the C walk shared
+        with :meth:`window_reduce` (one ring pass, no column arrays, no
+        uncompressed body materialization); elsewhere it falls back to
+        :meth:`read_columns` + host-side filter/clip. Both paths emit
+        the same segment set the reduce engines consume, so a depth
+        pipeline fed from either is byte-identical."""
+        from . import native
+
+        if end is None or end < 0:
+            raise ValueError("read_segments requires an explicit end")
+        if self.native and self.lazy and native.get_lib() is not None:
+            if voffset is not None:
+                c_begin = int(self._co[self._block_of(voffset)])
+                in_block = voffset & 0xFFFF
+            else:
+                c_begin = 0
+                in_block = self._body_start
+            # cap heuristic: ~5x coverage of 100bp reads over the span
+            # (span/16 segments) — an undersized cap costs a full
+            # re-walk of the stream, far worse than a few spare MB
+            return native.bam_segments_stream(
+                self._comp, c_begin, in_block, tid, start, end,
+                min_mapq, flag_mask,
+                cap_hint=max(65536, (end - start) // 16))
+        cols = self.read_columns(tid=tid, start=start, end=end,
+                                 voffset=voffset)
+        return filter_clip_segments(cols, start, end, min_mapq,
+                                    flag_mask)
+
     def window_reduce(self, tid: int, start: int, end: int,
                       w0: int, length: int, window: int,
                       depth_cap: int, min_mapq: int, flag_mask: int,
@@ -889,6 +924,25 @@ class BamWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def filter_clip_segments(cols, start: int, end: int, min_mapq: int,
+                         flag_mask: int):
+    """The ONE definition of decoded-columns → (seg_start, seg_end)
+    filtered/clipped segment arrays — the host reference semantics of
+    the C streaming extractor (``bam_segments_stream``). Shared by
+    BamFile.read_segments' fallback and the cohort device engine's
+    CRAM branch so the container types cannot desynchronize."""
+    n = len(cols.seg_start)
+    if not n:
+        z = np.empty(0, np.int32)
+        return z, z.copy()
+    ok = (cols.mapq >= min_mapq) & ((cols.flag & flag_mask) == 0)
+    kp = ok[cols.seg_read]
+    s = np.clip(cols.seg_start[kp], start, end).astype(np.int32)
+    e = np.clip(cols.seg_end[kp], start, end).astype(np.int32)
+    nz = e > s
+    return s[nz], e[nz]
 
 
 def parse_cigar(s: str) -> list[tuple[int, int]]:

@@ -421,3 +421,71 @@ def test_stream_decoder_corruption_fuzz(tmp_path):
     # both outcomes occur across 150 flips (headers vs payload bytes)
     assert n_err > 0
     assert n_ok > 0
+
+
+@needs_native
+@pytest.mark.parametrize("rs,re_", [(0, 100_000), (13_777, 61_003),
+                                    (99_000, 100_000)])
+def test_read_segments_matches_filtered_columns(tmp_path, rs, re_):
+    """read_segments (the device engine's streaming host stage) must
+    emit exactly the filtered/clipped segment set that columns decode +
+    host filter produces — on the C streaming path, the eager fallback,
+    and through a BAI voffset."""
+    rng = np.random.default_rng(21)
+    reads = []
+    for s in np.sort(rng.integers(0, 99_000, size=3000)):
+        cig = rng.choice(["100M", "40M20D40M", "30M10N60M", "10S80M",
+                          "50M2I48M"])
+        mq = int(rng.integers(0, 61))
+        fl = int(rng.choice([0, 0, 0, 0x400, 0x100, 0x200]))
+        reads.append((0, int(s), cig, mq, fl))
+    p = str(tmp_path / "t.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",),
+                      ref_lens=(100_000,))
+
+    lazy = BamFile.from_file(p, lazy=True)
+    got_s, got_e = lazy.read_segments(0, rs, re_, 20, 0x704)
+
+    cols = lazy.read_columns(tid=0, start=rs, end=re_)
+    ok = (cols.mapq >= 20) & ((cols.flag & 0x704) == 0)
+    kp = ok[cols.seg_read]
+    want_s = np.clip(cols.seg_start[kp], rs, re_).astype(np.int32)
+    want_e = np.clip(cols.seg_end[kp], rs, re_).astype(np.int32)
+    nz = want_e > want_s
+    want_s, want_e = want_s[nz], want_e[nz]
+    assert np.array_equal(got_s, want_s)
+    assert np.array_equal(got_e, want_e)
+
+    # eager fallback path (no streaming C call) — same set
+    eager = BamFile.from_file(p)
+    fb_s, fb_e = eager.read_segments(0, rs, re_, 20, 0x704)
+    assert np.array_equal(fb_s, got_s) and np.array_equal(fb_e, got_e)
+
+    # voffset entry (how the device engine actually calls it)
+    from goleft_tpu.io.bai import read_bai, query_voffset
+
+    voff = query_voffset(read_bai(p + ".bai"), 0, rs)
+    if voff is not None:
+        vs, ve = lazy.read_segments(0, rs, re_, 20, 0x704,
+                                    voffset=voff)
+        assert np.array_equal(vs, got_s) and np.array_equal(ve, got_e)
+
+
+@needs_native
+def test_read_segments_buffer_retry(tmp_path):
+    """A cap_hint smaller than the segment count must transparently
+    retry with an exact-size buffer (nothing written past cap)."""
+    from goleft_tpu.io import native
+
+    rng = np.random.default_rng(3)
+    reads = [(0, int(s), "100M", 60, 0)
+             for s in np.sort(rng.integers(0, 9000, size=500))]
+    p = str(tmp_path / "r.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",), ref_lens=(10_000,))
+    h = BamFile.from_file(p, lazy=True)
+    full_s, full_e = h.read_segments(0, 0, 10_000, 0, 0)
+    tiny_s, tiny_e = native.bam_segments_stream(
+        h._comp, 0, h._body_start, 0, 0, 10_000, 0, 0, cap_hint=7)
+    assert len(full_s) == 500
+    assert np.array_equal(full_s, tiny_s)
+    assert np.array_equal(full_e, tiny_e)
