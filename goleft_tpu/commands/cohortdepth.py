@@ -23,7 +23,8 @@ import jax
 import numpy as np
 
 from ..io.bai import read_bai, query_voffset
-from ..io.bam import filter_clip_segments, open_bam_file
+from ..io.bam import open_bam_file
+from .depth import _decode_shard_segments
 from ..io.fai import read_fai, write_fai
 from ..ops.coverage import bucket_size, window_bounds
 from ..utils.decode_scaling import auto_processes, effective_cores
@@ -192,25 +193,13 @@ def cohort_matrix_blocks(
             sharding = NamedSharding(mesh, P("data", None))
             S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 
-    _EMPTY_SEGS = (np.empty(0, np.int32), np.empty(0, np.int32))
-
     def decode(args):
         """(seg_start, seg_end) already filtered/clipped for the device
-        segment path. BamFile streams them through the C walk shared
-        with the reduce engines (io/bam.py::read_segments — no column
-        arrays, no uncompressed-body materialization); CRAM handles
-        fall back to columns + host filter with identical semantics."""
+        segment path — the ONE shared decode helper depth/multidepth
+        use (BamFile streams through the C walk; CRAM falls back to
+        columns + the shared filter/clip)."""
         h, bai, tid, s, e = args
-        if tid < 0:
-            return _EMPTY_SEGS
-        rs = getattr(h, "read_segments", None)
-        if rs is not None and bai is not None:
-            voff = query_voffset(bai, tid, s)
-            if voff is None:
-                return _EMPTY_SEGS
-            return rs(tid, s, e, mapq, 0x704, voffset=voff)
-        cols = h.read_columns(tid=tid, start=s, end=e)
-        return filter_clip_segments(cols, s, e, mapq, 0x704)
+        return _decode_shard_segments(h, bai, tid, s, e, mapq)
 
     def submit_decodes(ex, c, s, e):
         return [

@@ -83,6 +83,32 @@ def gen_regions(
     return out
 
 
+_EMPTY_SEGS = (np.empty(0, np.int32), np.empty(0, np.int32))
+
+
+def _decode_shard_segments(bam, bai, tid: int, start: int, end: int,
+                           min_mapq: int, flag_mask: int = 0x704):
+    """Host decode of the shard's FILTERED clipped segment endpoints —
+    what the device pipeline actually consumes. BamFile handles stream
+    them through the C walk shared with the cohort reduce engines
+    (io/bam.py::read_segments: no column arrays, no uncompressed-body
+    materialization); CRAM handles fall back to columns + the shared
+    filter/clip helper. Returns (seg_start, seg_end); pair with an
+    all-true keep mask."""
+    from ..io.bam import filter_clip_segments
+
+    if tid < 0:
+        return _EMPTY_SEGS
+    rs = getattr(bam, "read_segments", None)
+    if rs is not None and bai is not None:
+        voff = query_voffset(bai, tid, start)
+        if voff is None:
+            return _EMPTY_SEGS
+        return rs(tid, start, end, min_mapq, flag_mask, voffset=voff)
+    cols = _decode_shard(bam, bai, tid, start, end)
+    return filter_clip_segments(cols, start, end, min_mapq, flag_mask)
+
+
 def _decode_shard(bam, bai, tid: int, start: int, end: int) -> ReadColumns:
     """Host decode of records overlapping [start, end) on tid.
 
@@ -105,8 +131,9 @@ def _decode_shard(bam, bai, tid: int, start: int, end: int) -> ReadColumns:
 
 
 class DepthEngine:
-    """Reusable shard→(window sums, classes) runner (also used by
-    multidepth and the benchmark)."""
+    """Reusable shard→(window sums, classes) runner over
+    stream-extracted segment endpoints (_decode_shard_segments feeds
+    it here; multidepth shares the same decode helper)."""
 
     def __init__(self, window: int, min_cov: int, max_mean_depth: int,
                  mapq: int, max_span: int = STEP,
@@ -139,16 +166,20 @@ class DepthEngine:
             self.w_eff = window
             self.length = (max_span + window - 1) // window * window
 
-    def run_shard(self, cols: ReadColumns, start: int, end: int):
+    def run_segments(self, seg_start, seg_end, kp, start: int,
+                     end: int):
+        """Core shard runner over stream-extracted (or pre-filtered
+        column-decoded) segment endpoint arrays. ``kp=None`` means all
+        segments are already keepers (the _decode_shard_segments
+        contract) and skips the mask copies on the hot path."""
         w0 = start // self.window * self.window
         assert end - w0 <= self.length
-        n = len(cols.seg_start)
-        read_ok = (cols.mapq >= self.mapq) & ((cols.flag & 0x704) == 0)
-        kp = read_ok[cols.seg_read] if n else np.zeros(0, bool)
+        n = len(seg_start)
         scalars = (np.int32(w0), np.int32(start), np.int32(end),
                    np.int32(self.cap), np.int32(self.min_cov),
                    np.int32(self.max_mean))
-        packed = pack_segments_u16(cols.seg_start, cols.seg_end, kp) \
+        sel = slice(None) if kp is None else kp
+        packed = pack_segments_u16(seg_start, seg_end, sel) \
             if self.packed else None
         if packed is not None:
             d, l, base, n_ent = packed
@@ -167,9 +198,9 @@ class DepthEngine:
             seg_e = np.full(b, 0, dtype=np.int32)
             keep = np.zeros(b, dtype=bool)
             if n:
-                seg_s[:n] = cols.seg_start
-                seg_e[:n] = cols.seg_end
-                keep[:n] = kp
+                seg_s[:n] = seg_start
+                seg_e[:n] = seg_end
+                keep[:n] = True if kp is None else kp
             sums, cls_p = shard_depth_pipeline_cls_packed(
                 seg_s, seg_e, keep, *scalars,
                 length=self.length, window=self.w_eff,
@@ -291,12 +322,11 @@ def run_depth(
 
     def shard_fn(c, s, e, _fk):
         with timer.stage("host-decode"):
-            cols = (
-                _decode_shard(handle, bai, tid_of[c], s, e)
-                if c in tid_of else ReadColumns.empty()
-            )
+            seg_s, seg_e = _decode_shard_segments(
+                handle, bai, tid_of.get(c, -1), s, e, mapq)
         with timer.stage("device-compute"):
-            starts, ends, sums, cls = engine.run_shard(cols, s, e)
+            starts, ends, sums, cls = engine.run_segments(
+                seg_s, seg_e, None, s, e)
         return starts, ends, sums, cls
 
     params = (window, min_cov, max_mean_depth, mapq)

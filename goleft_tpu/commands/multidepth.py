@@ -26,7 +26,7 @@ import numpy as np
 from ..io.bai import read_bai
 from ..io.bam import open_bam_file
 from ..ops.coverage import bucket_size, depth_from_segments
-from .depth import _decode_shard
+from .depth import _decode_shard_segments
 from .indexcov import get_short_name
 
 CHUNK = 5_000_000
@@ -35,24 +35,23 @@ CHUNK = 5_000_000
 def _chunk_depth_matrix(bam_blobs, bais, tid, start, end, mapq, max_cov):
     """(n_samples, end-start) int32 depth matrix for one chunk."""
     L = end - start
-    cols = [
-        _decode_shard(handle, bai, tid, start, end)
+    segs = [
+        _decode_shard_segments(handle, bai, tid, start, end, mapq)
         for handle, bai in zip(bam_blobs, bais)
     ]
-    n_seg = max((len(c.seg_start) for c in cols), default=0)
+    n_seg = max((len(ss) for ss, _ in segs), default=0)
     b = bucket_size(max(n_seg, 1))
-    S = len(cols)
+    S = len(segs)
     seg_s = np.zeros((S, b), dtype=np.int32)
     seg_e = np.zeros((S, b), dtype=np.int32)
     keep = np.zeros((S, b), dtype=bool)
-    for i, c in enumerate(cols):
-        n = len(c.seg_start)
+    for i, (ss, ee) in enumerate(segs):
+        n = len(ss)
         if not n:
             continue
-        seg_s[i, :n] = c.seg_start
-        seg_e[i, :n] = c.seg_end
-        ok = (c.mapq >= mapq) & ((c.flag & 0x704) == 0)
-        keep[i, :n] = ok[c.seg_read]
+        seg_s[i, :n] = ss
+        seg_e[i, :n] = ee
+        keep[i, :n] = True  # pre-filtered in the segments decode
     fn = jax.vmap(
         lambda s, e, k: depth_from_segments(
             s, e, k, L, region_start=start, depth_cap=max_cov

@@ -489,3 +489,39 @@ def test_read_segments_buffer_retry(tmp_path):
     assert len(full_s) == 500
     assert np.array_equal(full_s, tiny_s)
     assert np.array_equal(full_e, tiny_e)
+
+
+@needs_native
+def test_depth_engine_packed_and_kp_none_paths(tmp_path):
+    """run_segments must give identical results across all four
+    combinations of {packed, unpacked} x {kp=None, explicit all-true}
+    — the packed wire is OFF by default on few-core hosts, so this
+    pins the multi-core-host configuration too."""
+    from goleft_tpu.commands.depth import (
+        DepthEngine, _decode_shard_segments,
+    )
+    from goleft_tpu.io.bai import read_bai
+
+    rng = np.random.default_rng(9)
+    reads = []
+    for s in np.sort(rng.integers(0, 49_000, size=2000)):
+        cig = rng.choice(["100M", "40M20D40M", "10S80M"])
+        reads.append((0, int(s), cig, int(rng.integers(0, 61)),
+                      int(rng.choice([0, 0, 0x400]))))
+    p = str(tmp_path / "p.bam")
+    write_bam_and_bai(p, reads, ref_names=("chr1",),
+                      ref_lens=(50_000,))
+    h = BamFile.from_file(p, lazy=True)
+    bai = read_bai(p + ".bai")
+    rs, re_ = 1_003, 48_777
+    ss, ee = _decode_shard_segments(h, bai, 0, rs, re_, 20)
+    assert len(ss) > 500
+    outs = []
+    for packed in (False, True):
+        eng = DepthEngine(250, 4, 0, 20, max_span=re_, packed=packed)
+        for kp in (None, np.ones(len(ss), bool)):
+            st, en, sums, cls = eng.run_segments(ss, ee, kp, rs, re_)
+            outs.append((st, en, sums, cls))
+    for o in outs[1:]:
+        for a, b in zip(outs[0], o):
+            assert np.array_equal(a, b)
