@@ -75,12 +75,27 @@ def arm_traceback_snippet(snippet: str, timeout_s: float) -> str:
             + snippet)
 
 
-def _probe_cache_path() -> str:
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _touch(path: str) -> None:
+    try:
+        with open(path, "w"):
+            pass
+    except OSError:
+        pass
+
+
+def _probe_cache_path(kind: str = "ok") -> str:
     import tempfile
 
     uid = os.getuid() if hasattr(os, "getuid") else 0
     return os.path.join(tempfile.gettempdir(),
-                        f"goleft-tpu-probe-ok-{uid}")
+                        f"goleft-tpu-probe-{kind}-{uid}")
 
 
 def probe_device(timeout_s: float | None = None, argv=None,
@@ -185,31 +200,57 @@ def ensure_usable_backend(probe_argv=None) -> str:
         return "unprobed"
     # cache a recent success: healthy hosts must not pay child bring-up
     # + settle on every CLI invocation (GOLEFT_TPU_PROBE_TTL_SECONDS
-    # overrides; 0 disables the cache)
-    try:
-        ttl = float(os.environ.get("GOLEFT_TPU_PROBE_TTL_SECONDS",
-                                   "300"))
-    except ValueError:
-        ttl = 300.0
+    # overrides; 0 disables probe caching entirely).
+    ttl = _env_float("GOLEFT_TPU_PROBE_TTL_SECONDS", 300.0)
+    # failures cache too, with their own (shorter) TTL: in a wedged-
+    # tunnel environment every CLI invocation would otherwise hang for
+    # the full probe timeout before degrading — 10 commands = 5 wasted
+    # minutes. The cost is up to fail-TTL of host-mode runs after the
+    # device RECOVERS, which the warning states. Defaults to 0 (off)
+    # when the main TTL knob disables caching, unless its own knob
+    # (GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS) is set explicitly.
+    fail_ttl = _env_float("GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS",
+                          120.0 if ttl > 0 else 0.0)
     cache = _probe_cache_path()
-    if ttl > 0 and probe_argv is None:
+    fail_cache = _probe_cache_path("fail")
+    rec = None
+    if probe_argv is None:
         import time
 
-        try:
-            if time.time() - os.path.getmtime(cache) < ttl:
-                return "device"
-        except OSError:
-            pass
-    rec = probe_device(argv=probe_argv)
-    if rec["ok"]:
-        if ttl > 0 and probe_argv is None:
+        if ttl > 0:
             try:
-                with open(cache, "w"):
-                    pass
-                os.utime(cache)
+                if time.time() - os.path.getmtime(cache) < ttl:
+                    return "device"
             except OSError:
                 pass
-        return "device"
+        if fail_ttl > 0:
+            try:
+                age = time.time() - os.path.getmtime(fail_cache)
+                if age < fail_ttl:
+                    rec = {"error": f"probe failed {age:.0f}s ago "
+                                    "(cached; set GOLEFT_TPU_PROBE_"
+                                    "FAIL_TTL_SECONDS=0 to re-probe "
+                                    "every run)"}
+            except OSError:
+                pass
+    if rec is None:
+        rec = probe_device(argv=probe_argv)
+        if rec["ok"]:
+            if probe_argv is None:
+                try:
+                    os.remove(fail_cache)  # recovered — forget failures
+                except OSError:
+                    pass
+                if ttl > 0:
+                    _touch(cache)
+            return "device"
+        # only cache failures that mean "the DEVICE is unusable" —
+        # a spawn failure (fork/ENOMEM) is about this host's moment,
+        # and pinning 120s of host mode on it would be wrong
+        if (fail_ttl > 0 and probe_argv is None
+                and not str(rec.get("error", "")).startswith(
+                    "spawn failed")):
+            _touch(fail_cache)
     import jax
 
     try:

@@ -129,3 +129,99 @@ def test_probe_skips(monkeypatch):
     _clear_probe_skips(monkeypatch)
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     assert device_guard.ensure_usable_backend() == "unprobed"
+
+
+def _cache_env(monkeypatch, tmp_path):
+    """Point both probe caches at tmp and strip bypass knobs."""
+    monkeypatch.setattr(
+        device_guard, "_probe_cache_path",
+        lambda kind="ok": str(tmp_path / f"probe-{kind}"))
+    for k in ("GOLEFT_TPU_CPU", "GOLEFT_TPU_COORDINATOR",
+              "JAX_PLATFORMS", "GOLEFT_TPU_PROBE",
+              "GOLEFT_TPU_PROBE_TTL_SECONDS",
+              "GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS"):
+        monkeypatch.delenv(k, raising=False)
+    import jax
+
+    class _Cfg:
+        def update(self, *_):
+            pass
+
+    monkeypatch.setattr(jax, "config", _Cfg())
+
+
+def test_probe_failure_is_cached_briefly(monkeypatch, tmp_path,
+                                         caplog):
+    """A wedged tunnel must cost the probe timeout ONCE, not once per
+    CLI invocation: failures cache for a short TTL (default 120s),
+    and a success clears the failure record."""
+    _cache_env(monkeypatch, tmp_path)
+    calls = []
+
+    def failing_probe(timeout_s=None, argv=None, settle_s=None):
+        calls.append(1)
+        return {"ok": False, "rc": None, "error": "wedged"}
+
+    monkeypatch.setattr(device_guard, "probe_device", failing_probe)
+    with caplog.at_level(logging.WARNING, logger="goleft-tpu.device"):
+        assert device_guard.ensure_usable_backend() == "host"
+        assert device_guard.ensure_usable_backend() == "host"
+    assert len(calls) == 1, "second invocation must hit the fail cache"
+    assert any("cached" in r.message for r in caplog.records)
+
+    # TTL expiry re-probes
+    import os
+
+    old = time.time() - 10_000
+    os.utime(str(tmp_path / "probe-fail"), (old, old))
+    assert device_guard.ensure_usable_backend() == "host"
+    assert len(calls) == 2
+
+    # recovery clears the failure record and caches success
+    monkeypatch.setattr(
+        device_guard, "probe_device",
+        lambda timeout_s=None, argv=None, settle_s=None:
+            {"ok": True, "rc": 0})
+    os.utime(str(tmp_path / "probe-fail"))  # fresh failure on file...
+    monkeypatch.setenv("GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS", "0")
+    assert device_guard.ensure_usable_backend() == "device"
+    monkeypatch.delenv("GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS")
+    assert not os.path.exists(str(tmp_path / "probe-fail"))
+    assert device_guard.ensure_usable_backend() == "device"  # ok cache
+
+
+def test_probe_cache_disable_and_spawn_failures(monkeypatch, tmp_path):
+    """GOLEFT_TPU_PROBE_TTL_SECONDS=0 disables probe caching entirely
+    (both directions), and transient spawn failures never pin host
+    mode — only genuine device-unusable results do."""
+    import os
+
+    _cache_env(monkeypatch, tmp_path)
+    calls = []
+
+    def failing_probe(timeout_s=None, argv=None, settle_s=None):
+        calls.append(1)
+        return {"ok": False, "rc": None, "error": "wedged"}
+
+    monkeypatch.setattr(device_guard, "probe_device", failing_probe)
+    monkeypatch.setenv("GOLEFT_TPU_PROBE_TTL_SECONDS", "0")
+    assert device_guard.ensure_usable_backend() == "host"
+    assert device_guard.ensure_usable_backend() == "host"
+    assert len(calls) == 2, "TTL=0 must re-probe every run"
+    assert not os.path.exists(str(tmp_path / "probe-fail"))
+    # ...but an explicit fail-TTL re-enables failure caching alone
+    monkeypatch.setenv("GOLEFT_TPU_PROBE_FAIL_TTL_SECONDS", "300")
+    assert device_guard.ensure_usable_backend() == "host"
+    assert device_guard.ensure_usable_backend() == "host"
+    assert len(calls) == 3
+
+    # spawn failures (this host's moment, not the device) never cache
+    _cache_env(monkeypatch, tmp_path)
+    os.remove(str(tmp_path / "probe-fail"))  # drop phase-2 record
+    monkeypatch.setattr(
+        device_guard, "probe_device",
+        lambda timeout_s=None, argv=None, settle_s=None:
+            {"ok": False, "rc": None,
+             "error": "spawn failed: OSError(12, 'ENOMEM')"})
+    assert device_guard.ensure_usable_backend() == "host"
+    assert not os.path.exists(str(tmp_path / "probe-fail"))
