@@ -760,6 +760,16 @@ class _PyBamAdapter:
             rdr.seek_virtual(voffset)
         return rdr.read_columns(tid=tid, start=start, end=end)
 
+    def read_segments(self, tid: int, start: int, end: int,
+                      min_mapq: int, flag_mask: int,
+                      voffset: int | None = None):
+        """Same contract as BamFile.read_segments (the device paths'
+        host stage), over the pure-Python reader."""
+        cols = self.read_columns(tid=tid, start=start, end=end,
+                                 voffset=voffset)
+        return filter_clip_segments(cols, start, end, min_mapq,
+                                    flag_mask)
+
     def stream_columns(self, window_bytes: int = 1 << 24,
                        chunk_records: int = 1 << 18):
         """Chunked sequential decode; loops to EOF (not a fixed record
