@@ -134,3 +134,25 @@ def test_depth_wholegenome_entry_no_recompile():
     assert set(e["stage_seconds"]) >= {"host-decode", "device-compute",
                                        "write-output"}
     assert e["gbases_per_sec_warm"] > 0
+
+
+def test_host_scale_validation_entries():
+    """Configs 4-5 must be provably executable on the host backend
+    (chip-less rounds need SOME committed record of them). Shapes are
+    shrunk here; the bench always runs the full BASELINE shapes."""
+    ran = {}
+
+    def emit(d):
+        ran.update(d)
+
+    out = bench.host_scale_validation(emit=emit, ix_shape=(50, 4096),
+                                      em_samples=64, em_windows=256)
+    assert set(out) == {"indexcov_cohort_hostcheck",
+                        "emdepth_em_hostcheck"}
+    for e in out.values():
+        assert "error" not in e, e
+        assert e["platform"] == "cpu"
+        assert "validation" in e["note"]
+        assert e["seconds_incl_compile"] >= 0
+    assert out["emdepth_em_hostcheck"]["windows"] == 256
+    assert ran == out
