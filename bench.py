@@ -26,17 +26,22 @@ path is how the multi-chip mesh is fed.
 
 A plain run on a usable accelerator records the FULL portfolio into
 BENCH_details.json (stdout still carries exactly one line): device
-kernels + rooflines, the cohort e2e headline, BASELINE configs 4-5
-(indexcov normalization over cohort index-size arrays, batched EM over
-a 2504-sample matrix) and the host-side entries (indexcov CLI e2e,
-decode thread scaling, CRAM 3.1 codec decode). ``--kernels-only``
-skips everything but the device kernels + cohort headline for fast
+kernels + rooflines first, then the device suite (BASELINE configs
+4-5 — indexcov QC over cohort index arrays, batched EM over a
+2504-sample matrix — pallas-vs-XLA, whole-genome depth), the
+device-vs-hybrid cohort engine side-by-side, and only then the host
+entries (cohort e2e headline, indexcov CLI e2e, decode thread
+scaling, CRAM 3.1 codec decode) — a mid-run wedge costs host entries,
+never chip numbers. Each successful device run pins its entries into
+the git-tracked BENCH_lastgood.json. ``--kernels-only`` skips
+everything but the device kernels + cohort headline for fast
 iteration. Without a usable accelerator the run records the host
-portfolio FIRST (in a child process), then re-probes with backoff
-spread across the run; every probe attempt is recorded in the
-``device_probe`` block so "tunnel down" is distinguishable from
-"device path regressed". On a successful probe the device kernels are
-captured immediately (salvage ordering) before the longer suite.
+portfolio FIRST (in a child process: headline, engine side-by-side,
+whole-genome depth, full-shape host-backend checks of configs 4-5),
+re-probes once, and merges the last-good device entries back as a
+loudly-flagged stale ``device_lastgood`` block; every probe attempt
+lands in ``device_probe`` (with a faulthandler traceback on hangs) so
+"tunnel down" stays distinguishable from "device path regressed".
 
 Usage: python bench.py [--quick] [--kernels-only] [--suite-host]
        [--no-probe] [--pin-baseline]
