@@ -424,6 +424,7 @@ def test_stream_decoder_corruption_fuzz(tmp_path):
 
 
 @needs_native
+@pytest.mark.native_io
 @pytest.mark.parametrize("rs,re_", [(0, 100_000), (13_777, 61_003),
                                     (99_000, 100_000)])
 def test_read_segments_matches_filtered_columns(tmp_path, rs, re_):
@@ -472,6 +473,7 @@ def test_read_segments_matches_filtered_columns(tmp_path, rs, re_):
 
 
 @needs_native
+@pytest.mark.native_io
 def test_read_segments_buffer_retry(tmp_path):
     """A cap_hint smaller than the segment count must transparently
     retry with an exact-size buffer (nothing written past cap)."""
@@ -492,6 +494,8 @@ def test_read_segments_buffer_retry(tmp_path):
 
 
 @needs_native
+# NOT native_io: runs the jitted depth pipeline (XLA aborts under
+# the ASan LD_PRELOAD the native_io selection is run with)
 def test_depth_engine_packed_and_kp_none_paths(tmp_path):
     """run_segments must give identical results across all four
     combinations of {packed, unpacked} x {kp=None, explicit all-true}

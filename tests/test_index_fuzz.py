@@ -44,6 +44,7 @@ def _mutations(data: bytes, rng, n: int):
         yield bytes(b)
 
 
+@pytest.mark.native_io
 def test_bai_fuzz_python_and_native(bai_bytes):
     rng = np.random.default_rng(1)
     survived = crashed_cleanly = 0
@@ -59,6 +60,7 @@ def test_bai_fuzz_python_and_native(bai_bytes):
     assert crashed_cleanly > 0, "no mutation was ever detected"
 
 
+@pytest.mark.native_io
 def test_bai_scan_native_fuzz(bai_bytes):
     """The C scanner itself: must return n_ref or a negative error for
     any mutation (ctypes wrapper raises ValueError on negatives)."""
@@ -206,6 +208,7 @@ def test_crai_sparse_high_seqid_is_cheap():
     assert sz[5000000].tolist() == [610]  # 100000*100/16384 per base
 
 
+@pytest.mark.native_io
 def test_segments_stream_corruption_fuzz(tmp_path):
     """The new streaming segment extractor shares bgzf_stream_walk with
     the reduce paths, so every corruption class must surface as the
