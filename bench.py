@@ -1307,7 +1307,7 @@ def host_suite(quick: bool, emit=None) -> dict:
     return out
 
 
-def _probe_once(timeout_s: float = 120.0) -> dict:
+def _probe_once(timeout_s: float = 30.0) -> dict:
     """One accelerator bring-up probe in a SUBPROCESS so a wedged tunnel
     (which hangs jax.devices() indefinitely) cannot turn the benchmark
     run into silence. The probe asserts a NON-CPU platform — a silent
