@@ -81,6 +81,8 @@ def cohort_matrix_blocks(
     processes: int = 8,
     engine: str = "auto",
     bed: str | None = None,
+    prefetch_depth: int = 0,
+    stage_timer=None,
 ):
     """(sample_names, total_windows, block generator) for the cohort
     depth matrix. ``bed`` restricts to the file's regions (the cohort
@@ -108,6 +110,13 @@ def cohort_matrix_blocks(
     window × depth_cap < 2**24 — the device path sums windows in f32
     (exact ints below 2**24; see depth_pipeline), the hybrid path in
     int64. Beyond that bound the hybrid values are the exact ones.
+
+    ``prefetch_depth`` >= 1 routes the shard loop through the async
+    staging pipeline (parallel/prefetch.py): up to that many shards are
+    decoded, packed and (device engine) transferred ahead of the shard
+    being computed, with per-stage decode/stage/transfer/compute spans
+    recorded into ``stage_timer`` (a utils.profiling.StageTimer).
+    ``0`` is today's serial path; both produce identical matrices.
     """
     import concurrent.futures as cf
     import os
@@ -267,6 +276,31 @@ def cohort_matrix_blocks(
                     pending = submit_reduces(ex, *regions[ri + 1])
                 yield emit_block(c, s, e, sums)
 
+    def pack_segblock(segs):
+        """The device engine's staging step: padded endpoint arrays —
+        the ONE packing used by the serial and prefetched paths."""
+        n_max = max((len(ss) for ss, _ in segs), default=0)
+        b = bucket_size(max(n_max, 1))
+        seg_s = np.zeros((S_pad, b), dtype=np.int32)
+        seg_e = np.zeros((S_pad, b), dtype=np.int32)
+        keep = np.zeros((S_pad, b), dtype=bool)
+        for i, (ss, ee) in enumerate(segs):
+            n = len(ss)
+            if not n:
+                continue
+            seg_s[i, :n] = ss
+            seg_e[i, :n] = ee
+            keep[i, :n] = True  # pre-filtered in decode()
+        return seg_s, seg_e, keep
+
+    def run_pipeline(args, c, s, e):
+        w0 = s // window * window
+        sums = np.asarray(_batched_pipeline(
+            *args, np.int32(w0), np.int32(s),
+            np.int32(e), cap, length, window,
+        ))[:S]
+        return emit_block(c, s, e, sums)
+
     def blocks():
         with cf.ThreadPoolExecutor(max_workers=processes) as ex:
             # double-buffer: while the device chews shard k, threads
@@ -276,33 +310,78 @@ def cohort_matrix_blocks(
                 segs = [f.result() for f in pending]
                 if ri + 1 < len(regions):
                     pending = submit_decodes(ex, *regions[ri + 1])
-                n_max = max((len(ss) for ss, _ in segs), default=0)
-                b = bucket_size(max(n_max, 1))
-                seg_s = np.zeros((S_pad, b), dtype=np.int32)
-                seg_e = np.zeros((S_pad, b), dtype=np.int32)
-                keep = np.zeros((S_pad, b), dtype=bool)
-                for i, (ss, ee) in enumerate(segs):
-                    n = len(ss)
-                    if not n:
-                        continue
-                    seg_s[i, :n] = ss
-                    seg_e[i, :n] = ee
-                    keep[i, :n] = True  # pre-filtered in decode()
-                w0 = s // window * window
-                args = (seg_s, seg_e, keep)
+                args = pack_segblock(segs)
                 if sharding is not None:
                     args = tuple(jax.device_put(a, sharding) for a in args)
-                sums = np.asarray(_batched_pipeline(
-                    *args, np.int32(w0), np.int32(s),
-                    np.int32(e), cap, length, window,
-                ))[:S]
-                yield emit_block(c, s, e, sums)
+                yield run_pipeline(args, c, s, e)
+
+    # ---- prefetched variants: the async staging pipeline ----
+    # (parallel/prefetch.py). The producer unit is a whole shard (all
+    # samples, decoded serially on one worker); parallelism comes from
+    # prefetch_depth shards in flight across the decode pool — vs the
+    # serial paths' one-region lookahead. Identical matrices either way.
+    from ..utils.profiling import StageTimer
+
+    timer = stage_timer if stage_timer is not None else StageTimer()
+
+    def produce_device(region):
+        c, s, e = region
+        with timer.stage("decode"):
+            segs = [decode((h, b2, tm.get(c, -1), s, e))
+                    for h, b2, tm in zip(handles, bais, tid_maps)]
+        with timer.stage("stage"):
+            return pack_segblock(segs)
+
+    def transfer_device(args, region):
+        with timer.stage("transfer"):
+            # asynchronous dispatch on the producer thread: the H2D
+            # copy of shard k+1 overlaps shard k's compute
+            if sharding is not None:
+                return tuple(jax.device_put(a, sharding) for a in args)
+            return tuple(jax.device_put(a) for a in args)
+
+    def blocks_prefetched():
+        from ..parallel.prefetch import ChunkPrefetcher
+
+        with ChunkPrefetcher(regions, produce_device,
+                             depth=prefetch_depth,
+                             transfer=transfer_device,
+                             processes=processes) as pf:
+            for ch in pf:
+                with timer.stage("compute"):
+                    blk = run_pipeline(ch.value, *ch.meta)
+                yield blk
+
+    def produce_hybrid(region):
+        c, s, e = region
+        w0 = s // window * window
+        length_r = ((e - w0) + window - 1) // window * window
+        with timer.stage("decode"):
+            return np.stack([
+                reduce_task(h, b2, tm.get(c, -1), s, e, w0, length_r)
+                for h, b2, tm in zip(handles, bais, tid_maps)
+            ])
+
+    def blocks_hybrid_prefetched():
+        from ..parallel.prefetch import ChunkPrefetcher
+
+        with ChunkPrefetcher(regions, produce_hybrid,
+                             depth=prefetch_depth,
+                             processes=processes) as pf:
+            for ch in pf:
+                with timer.stage("compute"):
+                    blk = emit_block(*ch.meta, ch.value)
+                yield blk
 
     total_windows = sum(
         (e - s // window * window + window - 1) // window
         for _, s, e in regions
     )
-    gen = blocks_hybrid() if engine == "hybrid" else blocks()
+    if prefetch_depth > 0:
+        gen = (blocks_hybrid_prefetched() if engine == "hybrid"
+               else blocks_prefetched())
+    else:
+        gen = blocks_hybrid() if engine == "hybrid" else blocks()
     return names, total_windows, gen
 
 
@@ -317,6 +396,8 @@ def run_cohortdepth(
     out=None,
     engine: str = "auto",
     bed: str | None = None,
+    prefetch_depth: int = 0,
+    stage_timer=None,
 ):
     out = out or sys.stdout
     if jax.process_count() > 1:
@@ -332,6 +413,8 @@ def run_cohortdepth(
                 bams, reference=reference, fai=fai, window=window,
                 mapq=mapq, chrom=chrom, processes=processes,
                 engine=engine, bed=bed,
+                prefetch_depth=prefetch_depth,
+                stage_timer=stage_timer,
             )
         if jax.process_index() != 0:
             return
@@ -350,7 +433,8 @@ def run_cohortdepth(
         names, _, blocks = cohort_matrix_blocks(
             bams, reference=reference, fai=fai, window=window,
             mapq=mapq, chrom=chrom, processes=processes, engine=engine,
-            bed=bed,
+            bed=bed, prefetch_depth=prefetch_depth,
+            stage_timer=stage_timer,
         )
     from ..io import native
 
@@ -392,6 +476,11 @@ def main(argv=None):
                    help="hybrid: fused C++ host reduction (default when "
                         "native io is available); device: per-read "
                         "segments to the chip")
+    p.add_argument("--prefetch-depth", type=int, default=0,
+                   help="async staging pipeline depth: decode/pack/"
+                        "transfer up to N shards ahead of the shard "
+                        "being computed (0 = serial path, identical "
+                        "output)")
     from . import add_no_crc_flag, apply_no_crc
 
     add_no_crc_flag(p)
@@ -406,7 +495,7 @@ def main(argv=None):
         mapq=a.mapq, chrom=a.chrom,
         processes=(auto_processes() if a.processes is None
                    else a.processes),
-        engine=a.engine, bed=a.bed,
+        engine=a.engine, bed=a.bed, prefetch_depth=a.prefetch_depth,
     )
 
 

@@ -1,1 +1,7 @@
 from .mesh import make_mesh, best_grid  # noqa: F401
+from .prefetch import (  # noqa: F401
+    ChunkPrefetcher,
+    PrefetchWorkerError,
+    StagedChunk,
+    run_prefetched_cohort,
+)

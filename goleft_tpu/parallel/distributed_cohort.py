@@ -69,7 +69,8 @@ def cohort_coords(fai_path: str, chrom: str, window: int,
 
 
 def _local_matrix(local_bams, n_win, reference, fai, window, mapq,
-                  chrom, processes, engine, bed):
+                  chrom, processes, engine, bed, prefetch_depth=0,
+                  stage_timer=None):
     """Drain cohort_matrix_blocks for this process's sample shard into
     an int32 (n_win, n_local) matrix of round-half-up window means."""
     from ..commands.cohortdepth import cohort_matrix_blocks
@@ -79,7 +80,8 @@ def _local_matrix(local_bams, n_win, reference, fai, window, mapq,
     names, total, blocks = cohort_matrix_blocks(
         local_bams, reference=reference, fai=fai, window=window,
         mapq=mapq, chrom=chrom, processes=processes, engine=engine,
-        bed=bed,
+        bed=bed, prefetch_depth=prefetch_depth,
+        stage_timer=stage_timer,
     )
     assert total == n_win, (total, n_win)
     mat = np.empty((n_win, len(names)), dtype=np.int32)
@@ -118,6 +120,8 @@ def distributed_cohort_matrix(
     processes: int = 8,
     engine: str = "auto",
     bed: str | None = None,
+    prefetch_depth: int = 0,
+    stage_timer=None,
 ):
     """(names, chroms, starts, ends, matrix) with matrix int32
     (n_windows, n_samples) of round-half-up window means, identical to
@@ -126,6 +130,11 @@ def distributed_cohort_matrix(
     Every process returns the full assembled result (process_allgather
     is symmetric), so callers can write output on process 0 and use the
     arrays everywhere else.
+
+    ``prefetch_depth`` >= 1 routes each process's LOCAL shard loop
+    through the async staging pipeline (parallel/prefetch.py) — the
+    decode/stage/transfer spans land in this process's ``stage_timer``;
+    the DCN gather is unaffected (it moves the already-reduced matrix).
     """
     import jax
 
@@ -154,13 +163,15 @@ def distributed_cohort_matrix(
     if P == 1:
         names, mat = _local_matrix(bams, n_win, reference, fai_path,
                                    window, mapq, chrom, processes,
-                                   engine, bed)
+                                   engine, bed, prefetch_depth,
+                                   stage_timer)
         return names, chroms, starts, ends, mat
 
     local = bams[pid::P]
     names_l, mat_l = _local_matrix(local, n_win, reference, fai_path,
                                    window, mapq, chrom, processes,
-                                   engine, bed)
+                                   engine, bed, prefetch_depth,
+                                   stage_timer)
     # fixed-shape padding: allgather needs identical shapes everywhere
     pad = (len(bams) + P - 1) // P
     mat_pad = np.zeros((n_win, pad), dtype=np.int32)

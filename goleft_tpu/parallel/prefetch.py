@@ -1,0 +1,283 @@
+"""Async prefetch & staging: overlap host decode + H2D transfer with
+device compute in the cohort path.
+
+The device-side cohort engine loses to the host hybrid on small meshes
+because decode/transfer is serialized with compute (round-5 VERDICT):
+the chip idles while the host decodes the next chunk of BAM/CRAM
+segments, and the host idles while the chip computes. This module is
+the missing execution subsystem — a bounded, double-buffered staging
+pipeline in the spirit of gpuPairHMM's streamed batch staging
+(arxiv 2411.11547) and GenPIP's decode/compute integration
+(arxiv 2209.08600):
+
+  producer workers (decode pool, utils/decode_scaling affinity sizing)
+      │  decode: BAM/CRAM → per-sample segment endpoint tuples
+      │  stage:  pack into padded host buffers (the wire layout)
+      │  transfer: jax.device_put onto the target sharding — dispatch
+      │           is asynchronous, so the H2D copy of chunk k+1 runs
+      │           while chunk k's jitted step executes
+      ▼
+  bounded ordered queue (backpressure at ``depth`` staged chunks)
+      ▼
+  consumer: the jitted cohort step (which, via
+      cohort_pipeline.build_chunked_cohort_step, donates consumed
+      staging buffers back to the allocator)
+
+Guarantees:
+  - deterministic chunk ordering: chunks are delivered strictly in
+    submission order no matter how producers complete
+  - backpressure: at most ``depth`` chunks are in flight beyond the one
+    being consumed, bounding host+device staging memory
+  - error propagation: a worker exception surfaces in the consumer at
+    the failing chunk's ordinal position as PrefetchWorkerError (the
+    original exception chained), after every earlier chunk was
+    delivered intact
+  - cancellation: closing the prefetcher (or abandoning iteration)
+    cancels queued work and stops workers at the next chunk boundary
+
+``depth=0`` is the caller's serial path — callers keep their existing
+loop; this module only ever runs with depth >= 1.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Sequence
+
+import numpy as np
+
+from ..utils.decode_scaling import auto_processes
+
+
+class PrefetchCancelled(Exception):
+    """Raised inside workers when the prefetcher was closed mid-run."""
+
+
+class PrefetchWorkerError(RuntimeError):
+    """A producer failed; re-raised at the chunk's ordered position."""
+
+    def __init__(self, index: int, meta, cause: BaseException):
+        super().__init__(
+            f"prefetch worker failed on chunk {index} ({meta!r}): "
+            f"{cause!r}")
+        self.index = index
+        self.meta = meta
+        self.cause = cause
+
+
+@dataclass
+class StagedChunk:
+    """One chunk, staged and (if a transfer fn was given) already on
+    its way to the device when the consumer receives it."""
+
+    index: int
+    meta: Any
+    value: Any
+
+
+class ChunkPrefetcher:
+    """Bounded ordered producer/consumer over a sequence of chunk
+    descriptors.
+
+    ``produce(meta)`` runs on a decode-pool worker thread (sized by the
+    host's effective cores, capped at ``depth`` — more workers than
+    in-flight slots measure nothing) and returns the staged host value;
+    ``transfer(value, meta)``, when given, runs on the same worker
+    immediately after — issuing an asynchronous ``jax.device_put``
+    there is what overlaps H2D with the consumer's compute. Iterating
+    yields :class:`StagedChunk` in exact submission order.
+
+    Use as a context manager (or call :meth:`close`); abandoning the
+    iterator mid-run cancels outstanding work.
+    """
+
+    def __init__(self, chunks: Sequence | Iterable,
+                 produce: Callable[[Any], Any],
+                 depth: int = 2,
+                 transfer: Callable[[Any, Any], Any] | None = None,
+                 processes: int | None = None):
+        if depth < 1:
+            raise ValueError(
+                f"prefetch depth must be >= 1 (got {depth}); depth 0 "
+                "is the caller's serial path")
+        self._meta = iter(enumerate(chunks))
+        self._produce = produce
+        self._transfer = transfer
+        self.depth = depth
+        if processes is None:
+            processes = auto_processes()
+        self._ex = cf.ThreadPoolExecutor(
+            max_workers=max(1, min(processes, depth)),
+            thread_name_prefix="goleft-prefetch")
+        self._pending: deque = deque()  # (index, meta, future), ordered
+        self._cancelled = threading.Event()
+        self._closed = False
+
+    def _run_one(self, index: int, meta):
+        if self._cancelled.is_set():
+            raise PrefetchCancelled(index)
+        value = self._produce(meta)
+        if self._transfer is not None and not self._cancelled.is_set():
+            value = self._transfer(value, meta)
+        return value
+
+    def _top_up(self) -> None:
+        while len(self._pending) < self.depth:
+            try:
+                index, meta = next(self._meta)
+            except StopIteration:
+                return
+            self._pending.append(
+                (index, meta, self._ex.submit(self._run_one, index,
+                                              meta)))
+
+    def __iter__(self):
+        try:
+            self._top_up()
+            while self._pending:
+                index, meta, fut = self._pending.popleft()
+                try:
+                    value = fut.result()
+                except PrefetchCancelled:
+                    return
+                except cf.CancelledError:
+                    return
+                except Exception as e:  # noqa: BLE001 — ordered rethrow
+                    raise PrefetchWorkerError(index, meta, e) from e
+                # refill BEFORE handing the chunk to the consumer, so
+                # decode/transfer of later chunks runs under its compute
+                self._top_up()
+                yield StagedChunk(index, meta, value)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Cancel outstanding work and release the pool (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._cancelled.set()
+        for _, _, fut in self._pending:
+            fut.cancel()
+        self._pending.clear()
+        self._ex.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _null_timer():
+    from ..utils.profiling import StageTimer
+
+    return StageTimer()
+
+
+def _pack_chunk(starts, ends, keep, n_seq: int, shard_len: int):
+    """Stage one chunk: partition endpoint arrays for P('data','seq')
+    and pad the per-shard width to a power-of-two bucket so every chunk
+    of similar occupancy hits the same compiled program."""
+    from ..ops.coverage import bucket_size
+    from .sharded_coverage import partition_segments
+
+    seg_s, seg_e, kp = partition_segments(starts, ends, keep, n_seq,
+                                          shard_len)
+    per = seg_s.shape[1] // n_seq
+    b = bucket_size(per, minimum=64)
+    if b != per:
+        seg_s, seg_e, kp = partition_segments(starts, ends, keep,
+                                              n_seq, shard_len,
+                                              pad_to=b)
+    return seg_s, seg_e, kp
+
+
+def run_prefetched_cohort(mesh, shard_len: int, window: int,
+                          chunks: Sequence, decode_chunk,
+                          n_samples: int,
+                          prefetch_depth: int = 2,
+                          carry_mode: str = "all_gather",
+                          timer=None, processes: int | None = None,
+                          keep_depth: bool = True):
+    """The chunked flagship cohort path through the staging pipeline.
+
+    ``chunks`` is an ordered list of chunk descriptors; each covers the
+    next ``mesh.shape['seq'] * shard_len`` genome positions.
+    ``decode_chunk(desc) → (starts, ends, keep)`` returns (S, n) int32
+    CHUNK-RELATIVE segment endpoint arrays (the producer stages them
+    with :func:`partition_segments` and transfers onto the
+    P('data','seq') layout). Per-stage spans land in ``timer``
+    (decode / stage / transfer / compute).
+
+    ``prefetch_depth=0`` runs the identical code strictly serially —
+    the byte-identity reference. Returns dict(depth?, wmeans, lambdas,
+    cn, carry): per-base depth (host np, concatenated across chunks;
+    omitted when ``keep_depth`` is False), window means and the EM
+    outputs over the full extent — bit-identical to the monolithic
+    :func:`~goleft_tpu.parallel.cohort_pipeline.build_cohort_step`
+    program fed the same segments, by the carry-threading argument in
+    build_chunked_cohort_step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .cohort_pipeline import build_chunked_cohort_step
+
+    timer = timer if timer is not None else _null_timer()
+    n_seq = mesh.shape["seq"]
+    chunk_fn, finalize_fn, in_shard, carry_shard = \
+        build_chunked_cohort_step(mesh, shard_len, window,
+                                  carry_mode=carry_mode)
+
+    def produce(desc):
+        with timer.stage("decode"):
+            starts, ends, keep = decode_chunk(desc)
+        with timer.stage("stage"):
+            seg_s, seg_e, kp = _pack_chunk(starts, ends, keep, n_seq,
+                                           shard_len)
+        return seg_s, seg_e, kp
+
+    def transfer(value, desc):
+        with timer.stage("transfer"):
+            # asynchronous dispatch: the H2D copy proceeds while the
+            # consumer's current chunk_fn executes
+            return tuple(jax.device_put(a, in_shard) for a in value)
+
+    carry = jax.device_put(
+        jnp.zeros(n_samples, jnp.int32), carry_shard)
+    depth_parts: list[np.ndarray] = []
+    wsums_parts = []
+
+    def consume(staged: StagedChunk):
+        nonlocal carry
+        with timer.stage("compute"):
+            depth, wsums, carry = chunk_fn(*staged.value, carry)
+            if keep_depth:
+                # D2H fetch synchronizes this chunk's compute; without
+                # depth the wsums stay device-resident until finalize
+                depth_parts.append(np.asarray(depth))
+            wsums_parts.append(wsums)
+
+    if prefetch_depth < 1:
+        for i, desc in enumerate(chunks):
+            consume(StagedChunk(i, desc, transfer(produce(desc), desc)))
+    else:
+        with ChunkPrefetcher(chunks, produce, depth=prefetch_depth,
+                             transfer=transfer,
+                             processes=processes) as pf:
+            for staged in pf:
+                consume(staged)
+
+    wsums_all = jnp.concatenate(wsums_parts, axis=1)
+    with timer.stage("compute"):
+        out = dict(finalize_fn(wsums_all))
+        jax.block_until_ready(out)
+    out["carry"] = np.asarray(carry)
+    if keep_depth:
+        out["depth"] = np.concatenate(depth_parts, axis=1)
+    return out

@@ -12,6 +12,11 @@ tasks feeding the device, run on a thread pool with:
   - an optional on-disk result cache keyed by (file identity, region,
     params) making reruns/resume nearly free (SURVEY.md §5 checkpoint
     gap: the reference restarts from scratch)
+
+``iter_prefetched`` runs the same pool as the PRODUCER of the async
+staging pipeline (parallel/prefetch.py): identical shard semantics,
+but results flow through the prefetcher's bounded ordered queue so
+decode overlaps the consumer's device compute.
 """
 
 from __future__ import annotations
@@ -145,3 +150,48 @@ def run_sharded(
                 top_up(live, live.add)
     if strict and first_error is not None:
         raise first_error
+
+
+def iter_prefetched(
+    tasks: Sequence[tuple] | Iterable[tuple],
+    fn: Callable[..., Any],
+    depth: int = 2,
+    processes: int | None = None,
+    retries: int = 1,
+    cache: ResultCache | None = None,
+) -> Iterable[ShardResult]:
+    """The scheduler's PRODUCER role in the async staging pipeline
+    (parallel/prefetch.py): run ``fn(*task)`` per task on the decode
+    pool with this module's shard semantics — retry-once (``Retries:
+    1``), optional result cache, failures yielded as ``.error`` results
+    while other shards keep running — delivered in task order through
+    the prefetcher's bounded queue, so at most ``depth`` results are
+    staged ahead of the consumer.
+
+    Equivalent to ``run_sharded(ordered=True, max_in_flight=depth)``
+    but on the prefetch machinery: chunk k+1's decode (and anything the
+    caller chains in ``fn``, e.g. packing + an async device_put) runs
+    under the consumer's processing of chunk k."""
+    from .prefetch import ChunkPrefetcher
+
+    def produce(task) -> ShardResult:
+        key = tuple(task)
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                return ShardResult(key, hit, from_cache=True)
+        err = None
+        for a in range(retries + 1):
+            try:
+                val = fn(*task)
+                if cache is not None:
+                    cache.put(key, val)
+                return ShardResult(key, val, attempts=a + 1)
+            except Exception as e:  # noqa: BLE001 - shard isolation
+                err = e
+        return ShardResult(key, error=err, attempts=retries + 1)
+
+    with ChunkPrefetcher(tasks, produce, depth=depth,
+                         processes=processes) as pf:
+        for chunk in pf:
+            yield chunk.value

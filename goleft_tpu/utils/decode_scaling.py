@@ -67,6 +67,11 @@ def measure_scaling(paths, ref_len: int, window: int = 500,
     """(serial_seconds, threaded_seconds, n_tasks) for one full-region
     reduce per file, best-of-``repeats`` — the two-point special case
     of :func:`measure_scaling_curve`."""
+    if not len(paths):
+        # without the guard this times the serial pass twice and then
+        # dies with an opaque KeyError(0) on curve[len(paths)]
+        raise ValueError("measure_scaling: paths is empty — need at "
+                         "least one BAM to measure decode scaling")
     curve = measure_scaling_curve(paths, ref_len, window, repeats,
                                   thread_counts=[1, len(paths)])
     return curve[1], curve[len(paths)], len(paths)
@@ -94,6 +99,10 @@ def measure_scaling_curve(paths, ref_len: int, window: int = 500,
     serial/min(workers, cores)."""
     from ..io.bam import BamFile
 
+    if not len(paths):
+        raise ValueError("measure_scaling_curve: paths is empty — "
+                         "need at least one BAM to measure decode "
+                         "scaling")
     if thread_counts is None:
         thread_counts = default_thread_counts(n_tasks=len(paths))
     # handles (and their mmaps) are function-local: the reduce outputs

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from collections import defaultdict
 
@@ -31,11 +32,20 @@ def trace(trace_dir: str | None):
 
 
 class StageTimer:
-    """Accumulating wall-clock timers keyed by stage name."""
+    """Accumulating wall-clock timers keyed by stage name.
+
+    Thread-safe: the prefetch staging pipeline records spans from
+    decode-pool worker threads concurrently with the consumer's compute
+    spans. Every ``stage`` use also appends a ``(name, t0, t1)`` span
+    (perf_counter seconds) so overlap between stages can be measured,
+    not just per-stage totals.
+    """
 
     def __init__(self):
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.spans: list[tuple[str, float, float]] = []
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -43,8 +53,31 @@ class StageTimer:
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            t1 = time.perf_counter()
+            with self._lock:
+                self.totals[name] += t1 - t0
+                self.counts[name] += 1
+                self.spans.append((name, t0, t1))
+
+    def as_dict(self, ndigits: int = 4) -> dict:
+        """{stage: {"seconds", "calls"}} snapshot for bench artifacts."""
+        with self._lock:
+            return {
+                name: {
+                    "seconds": round(self.totals[name], ndigits),
+                    "calls": self.counts[name],
+                }
+                for name in sorted(self.totals)
+            }
+
+    def wall(self) -> float:
+        """Span-extent wall clock: last span end minus first span start
+        (0.0 when nothing was recorded)."""
+        with self._lock:
+            if not self.spans:
+                return 0.0
+            return (max(t1 for _, _, t1 in self.spans)
+                    - min(t0 for _, t0, _ in self.spans))
 
     def report(self) -> str:
         lines = []
@@ -58,3 +91,26 @@ class StageTimer:
     def log_report(self) -> None:
         for line in self.report().splitlines():
             log.info("%s", line)
+
+
+def overlap_efficiency(timer: StageTimer, wall: float | None = None,
+                       compute_stage: str = "compute") -> float | None:
+    """How much of the non-compute pipeline work was hidden behind
+    ``compute_stage``, in [0, 1].
+
+    With per-stage totals summing to T and a measured wall clock W, the
+    pipeline hid ``T - W`` seconds of work by overlapping stages; the
+    maximum hideable is the total of every stage except compute (a
+    perfectly overlapped pipeline's wall equals its compute total,
+    assuming compute dominates). Returns None when nothing hideable was
+    recorded (no producer-side spans). ``wall`` defaults to the timer's
+    span extent.
+    """
+    totals = dict(timer.totals)
+    hideable = sum(v for k, v in totals.items() if k != compute_stage)
+    if hideable <= 0.0:
+        return None
+    if wall is None:
+        wall = timer.wall()
+    hidden = sum(totals.values()) - wall
+    return max(0.0, min(1.0, hidden / hideable))

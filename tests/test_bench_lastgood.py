@@ -32,7 +32,8 @@ def test_save_load_roundtrip_excludes_errored_entries(tmp_path):
             "platform": "tpu", "device": "TPU v5 lite0",
             "kernel_device_resident_gbases_per_sec": 50.0,
         },
-        "indexcov_cohort": {"samples": 500, "seconds": 0.1},
+        "indexcov_cohort": {"samples": 500, "seconds": 0.1,
+                            "platform": "tpu"},
         "emdepth_em": {"error": "RuntimeError('wedged')"},
         "cohort_e2e": {"gbases_per_sec": 0.5},  # host entry: not pinned
     })
@@ -53,6 +54,47 @@ def test_save_refuses_host_only_run(tmp_path):
                                     lastgood_path=lg_path)
     assert not os.path.exists(lg_path)
     assert bench._load_lastgood(lg_path) is None
+
+
+def test_save_pins_only_entries_with_own_device_platform(tmp_path):
+    """A device round must not stamp fresh device provenance onto
+    stale host-mode numbers riding along in the git-tracked
+    BENCH_details.json: each entry's OWN platform field gates pinning,
+    not just device_kernels'."""
+    det = _details(tmp_path, {
+        "device_kernels": {"platform": "tpu", "device": "TPU v5",
+                           "kernel_device_resident_gbases_per_sec": 50.0},
+        # stale --suite-host leftovers: own platform says cpu/host
+        "depth_wholegenome": {"platform": "cpu", "seconds_warm": 9.9},
+        "cohort_e2e_device": {
+            "platform": "cpu", "device": "TFRT_CPU_0",
+            "hybrid_gbases_per_sec": 0.1},
+        # no platform field at all: provenance unprovable — not pinned
+        "pallas_vs_xla_depth": {"pallas_ms": 1.0, "xla_ms": 2.0},
+        # fresh device entry: pinned
+        "emdepth_em": {"platform": "tpu", "seconds": 0.01},
+    })
+    lg_path = str(tmp_path / "lastgood.json")
+    assert bench._save_lastgood({"seconds": 1.0}, details_path=det,
+                                lastgood_path=lg_path)
+    doc = bench._load_lastgood(lg_path)
+    assert set(doc["entries"]) == {"device_kernels", "emdepth_em"}
+
+
+def test_save_skipped_entirely_in_kernels_only_mode(tmp_path):
+    """--kernels-only refreshes just device_kernels; pinning there
+    would stamp this run's provenance onto every stale suite entry in
+    the file — so the mode must not pin at all."""
+    det = _details(tmp_path, {
+        "device_kernels": {"platform": "tpu", "device": "TPU v5",
+                           "kernel_device_resident_gbases_per_sec": 50.0},
+        "emdepth_em": {"platform": "tpu", "seconds": 0.01},
+    })
+    lg_path = str(tmp_path / "lastgood.json")
+    assert not bench._save_lastgood({"seconds": 1.0}, details_path=det,
+                                    lastgood_path=lg_path,
+                                    kernels_only=True)
+    assert not os.path.exists(lg_path)
 
 
 def test_drop_details_removes_stale_carryover(tmp_path):

@@ -156,3 +156,16 @@ def test_bench_entry_records_curve_and_optimal():
     assert e["threaded_over_serial"] == pytest.approx(
         e["curve_seconds"][str(e["threads"])]
         / e["curve_seconds"]["1"], rel=3e-2)
+
+
+def test_empty_paths_raise_clear_valueerror():
+    """An empty cohort must fail with a clear ValueError up front —
+    not time the serial pass twice and die with KeyError(0)."""
+    from goleft_tpu.utils.decode_scaling import (
+        measure_scaling, measure_scaling_curve,
+    )
+
+    with pytest.raises(ValueError, match="paths is empty"):
+        measure_scaling([], 1000)
+    with pytest.raises(ValueError, match="paths is empty"):
+        measure_scaling_curve([], 1000)
