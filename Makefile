@@ -5,7 +5,8 @@
 CXX ?= g++
 SRC = csrc/fastio.cpp
 
-.PHONY: native asan tsan test test-native-asan test-native-tsan clean
+.PHONY: native asan tsan test test-native-asan test-native-tsan \
+        serve-smoke clean
 
 native: build/libgoleftio.so
 
@@ -26,6 +27,13 @@ asan: build/libgoleftio_asan.so
 
 test:
 	python -m pytest tests/ -q
+
+# serve daemon end-to-end: start on an ephemeral port, one depth
+# request through the client, clean SIGTERM drain, exit 0. Pinned to
+# the host platform inside (CI has no accelerator); whole run bounded
+# by the smoke's own 120s deadline.
+serve-smoke:
+	python -m goleft_tpu.serve.smoke
 
 # run the io test files with the AddressSanitized library preloaded.
 # Tests that execute XLA are excluded: ASan's allocator interposition is

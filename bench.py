@@ -1411,6 +1411,98 @@ def host_suite(quick: bool, emit=None) -> dict:
         _put("cram31_codec_decode", _cram31_codec_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("cram31_codec_decode", {"error": repr(e)})
+    try:
+        _put("serve_throughput", _serve_throughput_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("serve_throughput", {"error": repr(e)})
+    return out
+
+
+def _serve_throughput_entry(quick: bool) -> dict:
+    """The serve daemon under a concurrent depth-request load: an
+    in-process server (ephemeral port, real HTTP + micro-batcher +
+    warm vmapped engine) driven by client threads. Records req/s and
+    p50/p95 per-request latency for a cold burst (every request
+    computed, coalesced into batched device passes) and a warm burst
+    (same files — served from the session cache), plus the batch-size
+    histogram that proves the coalescing."""
+    import shutil
+    import threading
+
+    import jax as _jax
+
+    from goleft_tpu.serve.client import ServeClient
+    from goleft_tpu.serve.server import ServeApp, ServerThread
+    from goleft_tpu.utils.profiling import percentiles
+
+    n_clients = 4 if quick else 8
+    n_requests = 16 if quick else 48
+    ref_len = 200_000 if quick else 1_000_000
+    d, bams, fai, _ = _build_cohort_fixture(
+        min(n_requests, 8), ref_len, 4)
+    app = ServeApp(batch_window_s=0.05, max_batch=n_clients,
+                   max_queue=4 * n_requests,
+                   cache_dir=f"{d}/session-cache")
+    lat: dict[str, list] = {"cold": [], "warm": []}
+    walls = {}
+    try:
+        with ServerThread(app) as url:
+            def burst(phase):
+                times = lat[phase]
+                lock = threading.Lock()
+                todo = list(range(n_requests))
+
+                def worker():
+                    client = ServeClient(url, timeout_s=300.0)
+                    while True:
+                        with lock:
+                            if not todo:
+                                return
+                            i = todo.pop()
+                        t0 = time.perf_counter()
+                        # cache_buster=i: request i's key is unique, so
+                        # the COLD phase computes all n_requests (files
+                        # repeat across requests but keys don't) and
+                        # the warm phase (same i's again) replays all
+                        r = client.depth(bams[i % len(bams)], fai=fai,
+                                         cache_buster=i)
+                        assert r["depth_bed"]
+                        with lock:
+                            times.append(time.perf_counter() - t0)
+
+                threads = [threading.Thread(target=worker)
+                           for _ in range(n_clients)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                walls[phase] = time.perf_counter() - t0
+
+            # one request first: the geometry's compile is bring-up,
+            # not steady-state serving
+            ServeClient(url, timeout_s=300.0).depth(bams[0], fai=fai)
+            burst("cold")
+            burst("warm")  # identical files → session-cache replays
+            snap = app.metrics_snapshot()
+    finally:
+        app.close()
+        shutil.rmtree(d, ignore_errors=True)
+    out = {
+        "platform": _jax.default_backend(),
+        "clients": n_clients, "requests_per_phase": n_requests,
+        "ref_bp": ref_len,
+        "batch_size_hist": snap["batch_size_hist"],
+        "cache": snap.get("cache"),
+        "note": "in-process daemon, real HTTP loopback; cold = "
+                "computed (micro-batched device passes), warm = "
+                "session-cache replays on unchanged files",
+    }
+    for phase in ("cold", "warm"):
+        out[phase] = {
+            "req_per_sec": round(n_requests / walls[phase], 2),
+            "latency_s": percentiles(lat[phase], (50, 95)),
+        }
     return out
 
 

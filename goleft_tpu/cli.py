@@ -58,6 +58,8 @@ PROGS = {
                     _lazy(".commands.cohortdepth"), True),
     "cnv": ("CNV calls straight from bams (cohort depth + EM)",
             _lazy(".commands.cnv"), True),
+    "serve": ("warm-mesh coverage daemon with request micro-batching",
+              _lazy(".commands.serve"), True),
 }
 
 
@@ -81,8 +83,18 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     prog = argv[0]
     if prog not in PROGS:
-        print(f"unknown subcommand: {prog}\n", file=sys.stderr)
-        print(usage(), file=sys.stderr)
+        # a close match is almost always a typo: suggest it instead of
+        # dumping the whole table (which still prints when the guess
+        # would be noise)
+        import difflib
+
+        close = difflib.get_close_matches(prog, PROGS, n=1, cutoff=0.6)
+        if close:
+            print(f"unknown subcommand: {prog} — did you mean "
+                  f"{close[0]}?", file=sys.stderr)
+        else:
+            print(f"unknown subcommand: {prog}\n", file=sys.stderr)
+            print(usage(), file=sys.stderr)
         return 1
     # GOLEFT_TPU_CPU=1: pin the platform before any backend init — the
     # escape hatch when the accelerator (or its tunnel) is down. Device-

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 
+import functools
 import os
 import sys
 
@@ -84,6 +85,28 @@ def gen_regions(
 
 
 _EMPTY_SEGS = (np.empty(0, np.int32), np.empty(0, np.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_cls_packed():
+    """Jitted vmap of the per-sample shard pipeline over a batch axis —
+    the serve daemon's micro-batched depth pass (one device dispatch
+    for a whole batch of requests' samples on the same region). Built
+    lazily so importing this module keeps its no-jax-at-import
+    discipline; cached so every batch geometry reuses one wrapper."""
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("length", "window"))
+    def fn(seg_s, seg_e, keep, w0, rs, re, cap, mincov, maxmean,
+           length, window):
+        pipe = functools.partial(shard_depth_pipeline_cls_packed,
+                                 length=length, window=window)
+        return jax.vmap(
+            lambda a, b, c: pipe(a, b, c, w0, rs, re, cap, mincov,
+                                 maxmean)
+        )(seg_s, seg_e, keep)
+
+    return fn
 
 
 def _decode_shard_segments(bam, bai, tid: int, start: int, end: int,
@@ -212,6 +235,45 @@ class DepthEngine:
         # on host with vectorized shifts
         cls = unpack_cls_2bit(np.asarray(cls_p), self.length)
         cls = cls[start - w0 : end - w0]
+        return starts, ends, sums, cls
+
+    def run_segments_batch(self, segs, start: int, end: int):
+        """Batched variant of :meth:`run_segments`: B samples' already-
+        filtered ``(seg_start, seg_end)`` endpoint arrays for the SAME
+        region run as ONE vmapped device pass (the serve micro-batcher's
+        coalesced path). Value-identical to B single-sample calls on
+        either wire: per-base depths are exact small ints, window sums
+        are exact ints in f32 below 2**24, and vmap adds no cross-lane
+        ops. Returns (starts, ends, sums (B, n_win), cls (B, span))."""
+        w0 = start // self.window * self.window
+        assert end - w0 <= self.length
+        B = len(segs)
+        b = bucket_size(max(max((len(ss) for ss, _ in segs), default=0),
+                            1))
+        seg_s = np.zeros((B, b), np.int32)
+        seg_e = np.zeros((B, b), np.int32)
+        keep = np.zeros((B, b), bool)
+        for i, (ss, ee) in enumerate(segs):
+            n = len(ss)
+            if n:
+                seg_s[i, :n] = ss
+                seg_e[i, :n] = ee
+                keep[i, :n] = True
+        scalars = (np.int32(w0), np.int32(start), np.int32(end),
+                   np.int32(self.cap), np.int32(self.min_cov),
+                   np.int32(self.max_mean))
+        sums, cls_p = _batched_cls_packed()(
+            seg_s, seg_e, keep, *scalars,
+            length=self.length, window=self.w_eff,
+        )
+        starts, ends, _, _ = window_bounds(start, end, self.window)
+        n_win = len(starts)
+        sums = np.asarray(sums)[:, :n_win]
+        cls_p = np.asarray(cls_p)
+        cls = np.stack([
+            unpack_cls_2bit(cls_p[i], self.length)[start - w0:end - w0]
+            for i in range(B)
+        ])
         return starts, ends, sums, cls
 
 

@@ -1,0 +1,92 @@
+"""serve: the long-running warm-mesh coverage daemon.
+
+Dispatch brings the backend up once (under the device_guard probe +
+watchdog like every device command); from then on each request reuses
+the live mesh and the process-wide jit cache — no per-invocation
+bring-up, no cold compiles after the first request of each geometry.
+Concurrent requests micro-batch into coalesced device passes
+(serve/batcher.py, serve/executors.py); repeats on unchanged files are
+replayed from the session cache without touching the device.
+
+Lifecycle: prints one ``listening on http://host:port`` line (stdout,
+flushed) once the socket is bound — scripts scrape it when ``--port
+0`` picked an ephemeral port — then blocks until SIGTERM/SIGINT,
+drains in-flight requests, and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "goleft-tpu serve",
+        description="long-running coverage service with request "
+                    "micro-batching over a warm mesh",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 = ephemeral (actual port is printed)")
+    p.add_argument("--batch-window-ms", type=float, default=10.0,
+                   help="how long a batch anchor waits for compatible "
+                        "requests to coalesce")
+    p.add_argument("--max-batch", type=int, default=16,
+                   help="max requests per coalesced device pass")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="admission bound: beyond this many queued "
+                        "requests new ones get HTTP 429")
+    p.add_argument("--timeout-s", type=float, default=120.0,
+                   help="default per-request deadline (queue wait "
+                        "included; requests can override)")
+    p.add_argument("--cache", default=None,
+                   help="session result-cache directory: repeat "
+                        "requests on unchanged files skip the device")
+    p.add_argument("--cache-max-bytes", type=int,
+                   default=256 * 1024 * 1024,
+                   help="session cache bound (mtime-LRU eviction)")
+    p.add_argument("-p", "--processes", type=int, default=4,
+                   help="decode threads per batch")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the startup backend/compile warm pass")
+    a = p.parse_args(argv)
+
+    from ..serve.server import ServeApp, make_server
+
+    app = ServeApp(batch_window_s=a.batch_window_ms / 1000.0,
+                   max_batch=a.max_batch, max_queue=a.max_queue,
+                   default_timeout_s=a.timeout_s, cache_dir=a.cache,
+                   cache_max_bytes=a.cache_max_bytes,
+                   processes=a.processes)
+    if not a.no_warmup:
+        secs = app.warmup()
+        print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)
+    httpd = make_server(app, a.host, a.port)
+    host, port = httpd.server_address[:2]
+    print(f"goleft-tpu serve: listening on http://{host}:{port}",
+          flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    t = threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.1},
+                         name="goleft-serve-http")
+    t.start()
+    stop.wait()
+    print("goleft-tpu serve: draining", file=sys.stderr, flush=True)
+    app.draining = True
+    httpd.shutdown()      # stop accepting; serve_forever returns
+    t.join()
+    httpd.server_close()  # joins in-flight handler threads
+    app.close(drain=True)
+    print("goleft-tpu serve: drained, bye", file=sys.stderr,
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ import concurrent.futures as cf
 import hashlib
 import os
 import pickle
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Sequence
@@ -40,10 +41,24 @@ class ShardResult:
 
 
 class ResultCache:
-    """Pickle-per-key cache under a directory."""
+    """Pickle-per-key cache under a directory.
 
-    def __init__(self, directory: str):
+    ``max_bytes`` bounds the on-disk footprint with mtime-LRU eviction:
+    every hit touches the entry's mtime, and after each ``put`` the
+    oldest entries are removed until the directory fits the bound
+    (long-lived consumers — the serve daemon's session layer — would
+    otherwise grow it without limit). ``hits``/``misses`` count lookups
+    for observability; both are safe under concurrent get/put from many
+    threads (writes are tmp-file + atomic ``os.replace``, and eviction
+    tolerates entries vanishing under it).
+    """
+
+    def __init__(self, directory: str, max_bytes: int | None = None):
         self.dir = directory
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, key: tuple) -> str:
@@ -52,26 +67,81 @@ class ResultCache:
 
     def get(self, key: tuple):
         p = self._path(key)
-        if not os.path.exists(p):
-            return None
         try:
             with open(p, "rb") as fh:
-                return pickle.load(fh)
+                val = pickle.load(fh)
         except Exception:
+            with self._lock:
+                self.misses += 1
             return None
+        try:
+            os.utime(p)  # LRU touch: a hit is recent use
+        except OSError:
+            pass  # evicted/replaced underneath us — the value is fine
+        with self._lock:
+            self.hits += 1
+        return val
 
     def put(self, key: tuple, value) -> None:
         p = self._path(key)
-        tmp = p + ".tmp"
+        tmp = p + f".{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "wb") as fh:
             pickle.dump(value, fh)
         os.replace(tmp, p)
+        if self.max_bytes is not None:
+            self._evict()
+
+    def _evict(self) -> None:
+        entries = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for n in names:
+            if not n.endswith(".pkl"):
+                continue
+            try:
+                st = os.stat(os.path.join(self.dir, n))
+            except OSError:
+                continue  # concurrent eviction/replace
+            entries.append((st.st_mtime_ns, st.st_size, n))
+        total = sum(s for _, s, _ in entries)
+        for _, size, name in sorted(entries):
+            if total <= self.max_bytes:
+                break
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                continue
+            total -= size
+
+    def stats(self) -> dict:
+        """{hits, misses, entries, bytes} snapshot (entries/bytes scan
+        the directory; cheap at cache-bound entry counts)."""
+        n = b = 0
+        try:
+            for name in os.listdir(self.dir):
+                if not name.endswith(".pkl"):
+                    continue
+                try:
+                    b += os.stat(os.path.join(self.dir, name)).st_size
+                    n += 1
+                except OSError:
+                    continue
+        except OSError:
+            pass
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": n, "bytes": b}
 
 
 def file_key(path: str) -> tuple:
-    """Cache-key component identifying a file's content cheaply."""
+    """Cache-key component identifying a file's content cheaply.
+
+    Uses ``st_mtime_ns``: truncating to whole seconds aliased a
+    same-second same-size rewrite to a stale cache hit."""
     st = os.stat(path)
-    return (os.path.abspath(path), st.st_size, int(st.st_mtime))
+    return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
 
 
 def run_sharded(

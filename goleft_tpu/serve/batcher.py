@@ -1,0 +1,199 @@
+"""Request micro-batcher: coalesce concurrent requests into one pass.
+
+Requests arrive on HTTP handler threads; each ``submit`` enqueues a
+work item and blocks until its batch executes. A single dispatcher
+thread anchors a batch on the oldest queued item, then keeps pulling
+compatible items (same group key — same endpoint + parameter/geometry
+signature) until the batching window closes or the batch is full, and
+runs ``run_batch(key, payloads)`` once for all of them. Batches
+execute on the dispatcher thread, so device passes are serialized by
+construction — concurrency lives in the batch width, not in competing
+device dispatches.
+
+Bounds and failure behavior:
+
+  - admission control: ``submit`` raises :class:`Overloaded` when the
+    queue already holds ``max_queue`` items (the server maps it to
+    HTTP 429) — a burst beyond capacity degrades loudly instead of
+    growing an unbounded backlog
+  - per-request deadline: an item still queued past its deadline is
+    failed with :class:`DeadlineExceeded` (HTTP 504) at pickup time;
+    once its batch starts executing it runs to completion
+  - error isolation: an executor exception fails every item of THAT
+    batch (each waiter re-raises it); other groups keep flowing
+  - drain: ``close(drain=True)`` stops admission and lets the
+    dispatcher finish everything already queued — the SIGTERM path
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Hashable, Sequence
+
+
+class Overloaded(RuntimeError):
+    """Queue full (or draining) — the caller should shed load (429)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before its batch executed (504)."""
+
+
+@dataclass(eq=False)  # identity semantics: deque remove/in must not
+class _Item:          # compare payloads
+    seq: int
+    key: Hashable
+    payload: Any
+    deadline: float  # time.monotonic() when the item expires
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: BaseException | None = None
+
+    def finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class MicroBatcher:
+    """``run_batch(key, payloads) -> results`` (one result per payload,
+    in order) executed over coalesced same-key batches."""
+
+    def __init__(self, run_batch: Callable[[Hashable, Sequence], list],
+                 window_s: float = 0.01, max_batch: int = 16,
+                 max_queue: int = 64, metrics=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1 (got {max_batch})")
+        self._run_batch = run_batch
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.metrics = metrics
+        self._q: deque[_Item] = deque()
+        self._cond = threading.Condition()
+        self._seq = itertools.count()
+        self._accepting = True
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="goleft-serve-batcher")
+        self._thread.start()
+
+    # ---- producer side (handler threads) ----
+
+    def submit(self, key: Hashable, payload, timeout_s: float = 120.0):
+        """Block until the item's batch ran; return its result or
+        re-raise its error. ``timeout_s`` is the full request deadline
+        (queue wait + execution)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            if not self._accepting:
+                raise Overloaded("server is draining")
+            if len(self._q) >= self.max_queue:
+                if self.metrics is not None:
+                    self.metrics.inc("rejected_total")
+                raise Overloaded(
+                    f"queue full ({self.max_queue} requests pending)")
+            item = _Item(next(self._seq), key, payload, deadline)
+            self._q.append(item)
+            self._cond.notify_all()
+        # wait past the deadline by a grace period: if the batch STARTED
+        # in time it should be allowed to deliver (execution time is
+        # the executor's business, not the queue's)
+        while not item.done.wait(timeout=max(
+                0.05, deadline - time.monotonic() + 0.05)):
+            with self._cond:
+                if item in self._q and time.monotonic() > deadline:
+                    # still queued and expired — withdraw it ourselves
+                    self._q.remove(item)
+                    item.finish(error=DeadlineExceeded(
+                        f"request expired after {timeout_s:g}s in queue"))
+                    break
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # ---- consumer side (the one dispatcher thread) ----
+
+    def _take_batch(self) -> list[_Item] | None:
+        """Anchor on the oldest live item, then collect same-key items
+        until the window closes or the batch fills. Returns None when
+        stopping with an empty queue."""
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                while self._q and self._q[0].deadline < now:
+                    self._q.popleft().finish(error=DeadlineExceeded(
+                        "request expired in queue"))
+                    if self.metrics is not None:
+                        self.metrics.inc("deadline_timeouts_total")
+                if self._q:
+                    break
+                if self._stopped:
+                    return None
+                self._cond.wait(timeout=0.1)
+            anchor = self._q.popleft()
+            batch = [anchor]
+            window_end = time.monotonic() + self.window_s
+            while len(batch) < self.max_batch:
+                matched = [it for it in self._q if it.key == anchor.key]
+                for it in matched[: self.max_batch - len(batch)]:
+                    self._q.remove(it)
+                    batch.append(it)
+                remaining = window_end - time.monotonic()
+                if remaining <= 0 or len(batch) >= self.max_batch:
+                    break
+                if self._stopped and not self._q:
+                    break  # draining: nothing more can arrive
+                self._cond.wait(timeout=remaining)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            if self.metrics is not None:
+                self.metrics.observe_batch(len(batch))
+            try:
+                results = self._run_batch(batch[0].key,
+                                          [it.payload for it in batch])
+                if len(results) != len(batch):
+                    raise RuntimeError(
+                        f"executor returned {len(results)} results for "
+                        f"a batch of {len(batch)}")
+            except BaseException as e:  # noqa: BLE001 — batch isolation
+                for it in batch:
+                    it.finish(error=e)
+                continue
+            for it, res in zip(batch, results):
+                it.finish(result=res)
+
+    # ---- lifecycle ----
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission; with ``drain`` finish queued work first,
+        else fail everything still queued. Idempotent."""
+        with self._cond:
+            self._accepting = False
+            if not drain:
+                while self._q:
+                    self._q.popleft().finish(
+                        error=Overloaded("server shutting down"))
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=60.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,72 @@
+"""Thin stdlib client for the serve daemon.
+
+urllib-only so scripts, the bench and `make serve-smoke` need nothing
+beyond this repo. Methods mirror the routes; non-2xx responses raise
+:class:`ServeError` carrying the HTTP status and the server's error
+message (so a 429 is distinguishable from a 504 at the call site).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class ServeError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class ServeClient:
+    def __init__(self, base_url: str, timeout_s: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, path: str, payload: dict | None = None) -> dict:
+        url = self.base_url + path
+        data = None
+        headers = {"Accept": "application/json"}
+        if payload is not None:
+            data = json.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read().decode()).get("error", "")
+            except ValueError:
+                msg = e.reason
+            raise ServeError(e.code, msg) from e
+
+    # ---- operability ----
+
+    def healthz(self) -> dict:
+        return self._request("/healthz")
+
+    def metrics(self) -> dict:
+        return self._request("/metrics")
+
+    # ---- workloads ----
+
+    def depth(self, bam: str, **params) -> dict:
+        """→ {depth_bed, callable_bed, shards[, cached]} — the bytes
+        the one-shot `goleft-tpu depth` CLI writes for the same
+        fixture."""
+        return self._request("/v1/depth", {"bam": bam, **params})
+
+    def indexcov(self, bams: list[str], fai: str, **params) -> dict:
+        """→ {samples, chroms, cn, bin_counters[, cached]}."""
+        return self._request("/v1/indexcov",
+                             {"bams": list(bams), "fai": fai,
+                              **params})
+
+    def cohortdepth(self, bams: list[str], **params) -> dict:
+        """→ {matrix_tsv, samples, windows[, cached]}."""
+        return self._request("/v1/cohortdepth",
+                             {"bams": list(bams), **params})

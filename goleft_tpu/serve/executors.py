@@ -1,0 +1,332 @@
+"""Warm batch executors: one coalesced device pass per request batch.
+
+Each executor owns one request kind. ``group_key(req)`` is the
+compatibility signature the micro-batcher groups on (same parameters →
+same regions → same program geometry); ``run(reqs)`` executes the
+whole batch and returns one response dict per request, in order.
+
+Coalescing is genuine device-level batching, not loop fusion:
+
+  - depth: every sample (one per request) joins a single vmapped
+    ``shard_depth_pipeline_cls_packed`` dispatch per shard region
+    (DepthEngine.run_segments_batch) — a burst of B requests costs the
+    device one pass per region instead of B
+  - indexcov: all requests' samples stack into ONE ``chrom_qc`` call
+    per chromosome; the only cross-sample term (the missing-tail-bin
+    count, relative to the cohort's longest sample) is corrected back
+    to each request's own cohort on host, exactly, so responses are
+    independent of what else was in the batch
+  - cohortdepth: requests' cohorts concatenate into one
+    ``cohort_matrix_blocks`` run (window means are per-sample
+    independent) and each response slices its own sample columns
+
+Executors run on the batcher's single dispatcher thread: device passes
+are serialized, and all jitted programs stay warm in the process-wide
+compile cache across requests — the service's whole point.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import contextlib
+import io
+import os
+import re
+from typing import Sequence
+
+import numpy as np
+
+
+class BadRequest(ValueError):
+    """Malformed/unsupported request payload (HTTP 400)."""
+
+
+def _stage(metrics, name: str):
+    """metrics.timer span, or a no-op when running without metrics."""
+    if metrics is None:
+        return contextlib.nullcontext()
+    return metrics.timer.stage(name)
+
+
+def _require(req: dict, field: str):
+    v = req.get(field)
+    if not v:
+        raise BadRequest(f"missing required field {field!r}")
+    return v
+
+
+def _resolve_fai(req: dict) -> str:
+    """reference/fai resolution shared by depth and cohortdepth —
+    the same rules run_depth applies (reference implies reference.fai,
+    written on demand when only the fasta exists)."""
+    fai = req.get("fai")
+    reference = req.get("reference")
+    fai_path = fai or (reference + ".fai" if reference else None)
+    if fai_path is None:
+        raise BadRequest("need 'reference' (with .fai) or 'fai'")
+    if not os.path.exists(fai_path):
+        if reference and os.path.exists(reference):
+            from ..io.fai import write_fai
+
+            write_fai(reference)
+        else:
+            raise BadRequest(f"fasta index not found: {fai_path}")
+    return fai_path
+
+
+class DepthExecutor:
+    """`/v1/depth`: one BAM/CRAM per request → the depth.bed +
+    callable.bed bytes the one-shot CLI writes, byte-identical."""
+
+    kind = "depth"
+
+    def __init__(self, processes: int = 4, metrics=None):
+        self.processes = processes
+        self.metrics = metrics
+
+    def validate(self, req: dict) -> None:
+        bam = _require(req, "bam")
+        if not os.path.exists(bam):
+            raise BadRequest(f"no such file: {bam}")
+        if not req.get("bed"):
+            _resolve_fai(req)
+
+    def group_key(self, req: dict) -> tuple:
+        return (self.kind, int(req.get("window", 250)),
+                int(req.get("mincov", 4)),
+                int(req.get("maxmeandepth", 0)),
+                int(req.get("mapq", 1)), req.get("chrom", "") or "",
+                req.get("bed") or None,
+                None if req.get("bed") else _resolve_fai(req))
+
+    def cache_files(self, req: dict) -> list[str]:
+        return [req["bam"]]
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        from ..commands.depth import (
+            DepthEngine, _decode_shard_segments, gen_regions,
+            write_shard_output,
+        )
+        from ..io.bai import read_bai
+        from ..io.bam import open_bam_file
+        from ..io.fai import read_fai
+
+        p0 = reqs[0]
+        window = int(p0.get("window", 250))
+        mapq = int(p0.get("mapq", 1))
+        bed = p0.get("bed") or None
+        chrom = p0.get("chrom", "") or ""
+        fai_records = [] if bed else read_fai(_resolve_fai(p0))
+        regions = gen_regions(fai_records, chrom, window, bed)
+        max_span = max((e - (s // window) * window
+                        for _, s, e in regions), default=1)
+        engine = DepthEngine(window, int(p0.get("mincov", 4)),
+                             int(p0.get("maxmeandepth", 0)), mapq,
+                             max_span=max_span)
+
+        def _open(req):
+            handle = open_bam_file(req["bam"], lazy=True)
+            if getattr(handle, "is_cram", False):
+                bai = None
+            else:
+                b = req["bam"]
+                bai = read_bai(b + ".bai" if os.path.exists(b + ".bai")
+                               else b[:-4] + ".bai")
+            tid_of = {n: i
+                      for i, n in enumerate(handle.header.ref_names)}
+            return handle, bai, tid_of
+
+        opened = [_open(r) for r in reqs]
+        outs = [(io.StringIO(), io.StringIO()) for _ in reqs]
+        try:
+            with cf.ThreadPoolExecutor(
+                    max_workers=max(1, self.processes)) as ex:
+                for c, s, e in regions:
+                    def _dec(o, c=c, s=s, e=e):
+                        handle, bai, tid_of = o
+                        return _decode_shard_segments(
+                            handle, bai, tid_of.get(c, -1), s, e, mapq)
+
+                    with _stage(self.metrics, "decode"):
+                        segs = list(ex.map(_dec, opened))
+                    with _stage(self.metrics, "compute"):
+                        starts, ends, sums, cls = \
+                            engine.run_segments_batch(segs, s, e)
+                    if self.metrics:
+                        self.metrics.inc("device_passes_total")
+                    with _stage(self.metrics, "format"):
+                        for i, (dout, cout) in enumerate(outs):
+                            write_shard_output(c, starts, ends,
+                                               sums[i], cls[i], s,
+                                               dout, cout, None)
+        finally:
+            for handle, _, _ in opened:
+                close = getattr(handle, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+        return [{
+            "depth_bed": d.getvalue(),
+            "callable_bed": c.getvalue(),
+            "shards": len(regions),
+        } for d, c in outs]
+
+
+class IndexcovExecutor:
+    """`/v1/indexcov`: index-only cohort QC — per-sample copy number
+    and bin counters per chromosome, one fused chrom_qc device call per
+    chromosome for the WHOLE batch."""
+
+    kind = "indexcov"
+
+    def __init__(self, processes: int = 8, metrics=None):
+        self.processes = processes
+        self.metrics = metrics
+
+    def validate(self, req: dict) -> None:
+        for p in _require(req, "bams"):
+            if not os.path.exists(p):
+                raise BadRequest(f"no such file: {p}")
+        fai = _require(req, "fai")  # batching needs one shared ref dict
+        if not os.path.exists(fai):
+            raise BadRequest(f"no such file: {fai}")
+
+    def group_key(self, req: dict) -> tuple:
+        from ..commands.indexcov import DEFAULT_EXCLUDE
+
+        return (self.kind, req["fai"], req.get("chrom", "") or "",
+                req.get("excludepatt", DEFAULT_EXCLUDE))
+
+    def cache_files(self, req: dict) -> list[str]:
+        return list(req["bams"])
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        from ..commands.indexcov import (
+            DEFAULT_EXCLUDE, SampleIndex, _pad_rows, get_short_name,
+            references,
+        )
+        from ..ops import indexcov_ops as ops
+
+        p0 = reqs[0]
+        refs = references([], p0["fai"], p0.get("chrom", "") or "")
+        patt = p0.get("excludepatt", DEFAULT_EXCLUDE)
+        exclude = re.compile(patt) if patt else None
+
+        with cf.ThreadPoolExecutor(
+                max_workers=max(1, self.processes)) as ex:
+            idxs = list(ex.map(SampleIndex,
+                               [p for r in reqs for p in r["bams"]]))
+            names = list(ex.map(get_short_name,
+                                [p for r in reqs for p in r["bams"]]))
+        # sample-index ranges per request into the combined cohort
+        bounds = np.cumsum([0] + [len(r["bams"]) for r in reqs])
+        S = len(idxs)
+        out = [{"samples": names[lo:hi], "chroms": [], "cn": {},
+                "bin_counters": {k: [0] * (hi - lo)
+                                 for k in ("in", "out", "hi", "low")}}
+               for lo, hi in zip(bounds, bounds[1:])]
+
+        for ref_id, ref_name, _len in refs:
+            if exclude is not None and exclude.search(ref_name):
+                continue
+            rows = [idx.normalized_depth(ref_id) for idx in idxs]
+            mat, valid, lengths = _pad_rows(rows)
+            longest = int(lengths.max())
+            if longest == 0:
+                continue
+            with _stage(self.metrics, "compute"):
+                packed = np.asarray(
+                    ops.chrom_qc(mat, valid, np.int32(longest)))
+            if self.metrics:
+                self.metrics.inc("device_passes_total")
+            _rocs, counters, cn = ops.unpack_chrom_qc(packed, S)
+            for r, (lo, hi) in zip(out, zip(bounds, bounds[1:])):
+                # tail bins count vs the LONGEST sample; that was the
+                # batch-wide longest on device — correct out/low back
+                # to this request's own cohort so the response is
+                # independent of what else rode the batch (exact: the
+                # tail term is additive integer arithmetic)
+                own_longest = int(lengths[lo:hi].max())
+                if own_longest == 0:
+                    continue
+                delta = longest - own_longest
+                r["chroms"].append(ref_name)
+                r["cn"][ref_name] = [round(float(v), 4)
+                                     for v in cn[lo:hi]]
+                for k in ("in", "hi"):
+                    for j, v in enumerate(counters[k][lo:hi]):
+                        r["bin_counters"][k][j] += int(v)
+                for k in ("out", "low"):
+                    for j, v in enumerate(counters[k][lo:hi]):
+                        r["bin_counters"][k][j] += int(v) - delta
+        return out
+
+
+class CohortdepthExecutor:
+    """`/v1/cohortdepth`: requests' cohorts concatenate into one
+    cohort_matrix_blocks pass; each response carries its own
+    byte-identical `#chrom start end sample…` matrix."""
+
+    kind = "cohortdepth"
+
+    def __init__(self, processes: int = 4, metrics=None):
+        self.processes = processes
+        self.metrics = metrics
+
+    def validate(self, req: dict) -> None:
+        for p in _require(req, "bams"):
+            if not os.path.exists(p):
+                raise BadRequest(f"no such file: {p}")
+        _resolve_fai(req)
+
+    def group_key(self, req: dict) -> tuple:
+        return (self.kind, _resolve_fai(req),
+                int(req.get("window", 250)), int(req.get("mapq", 1)),
+                req.get("chrom", "") or "", req.get("bed") or None,
+                req.get("engine", "auto"))
+
+    def cache_files(self, req: dict) -> list[str]:
+        return list(req["bams"])
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        from ..commands.cohortdepth import cohort_matrix_blocks
+        from ..io import native
+
+        p0 = reqs[0]
+        all_bams = [p for r in reqs for p in r["bams"]]
+        bounds = np.cumsum([0] + [len(r["bams"]) for r in reqs])
+        names, total_windows, blocks = cohort_matrix_blocks(
+            all_bams, fai=_resolve_fai(p0),
+            window=int(p0.get("window", 250)),
+            mapq=int(p0.get("mapq", 1)),
+            chrom=p0.get("chrom", "") or "",
+            processes=max(1, self.processes),
+            engine=p0.get("engine", "auto"), bed=p0.get("bed") or None,
+            stage_timer=self.metrics.timer if self.metrics else None,
+        )
+        use_native_fmt = native.get_lib() is not None
+        bufs = [io.StringIO() for _ in reqs]
+        for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):
+            buf.write("#chrom\tstart\tend\t"
+                      + "\t".join(names[lo:hi]) + "\n")
+        for c, starts, ends, vals in blocks:
+            if self.metrics:
+                self.metrics.inc("device_passes_total")
+            for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):
+                sub = vals[lo:hi]
+                if use_native_fmt:
+                    buf.write(native.format_matrix_rows(
+                        c, starts, ends, sub).decode("ascii"))
+                else:
+                    buf.write("".join(
+                        f"{c}\t{starts[i]}\t{ends[i]}\t"
+                        + "\t".join(str(v) for v in sub[:, i]) + "\n"
+                        for i in range(len(starts))
+                    ))
+        return [{
+            "matrix_tsv": b.getvalue(),
+            "samples": names[lo:hi],
+            "windows": int(total_windows),
+        } for b, (lo, hi) in zip(bufs, zip(bounds, bounds[1:]))]

@@ -1,0 +1,63 @@
+"""Service observability: counters, batch-size histogram, latencies.
+
+One :class:`ServeMetrics` instance is shared by the HTTP handlers, the
+micro-batcher and the executors; ``snapshot()`` is the /metrics
+response body. Stage wall-clocks (decode/compute/format per batch)
+ride the same ``utils.profiling.StageTimer`` the CLI pipelines use, so
+a serve deployment exposes the stage breakdown the bench records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+
+from ..utils.profiling import StageTimer, percentiles
+
+
+class ServeMetrics:
+    def __init__(self, max_latencies: int = 4096):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._batch_sizes: dict[int, int] = defaultdict(int)
+        # bounded: long-lived daemons must not grow per-request state
+        self._latencies: dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=max_latencies))
+        self.timer = StageTimer()
+        self.started = time.time()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def observe_batch(self, size: int) -> None:
+        with self._lock:
+            self._counters["batches_total"] += 1
+            self._counters["batched_requests_total"] += size
+            self._batch_sizes[size] += 1
+
+    def observe_latency(self, endpoint: str, seconds: float) -> None:
+        with self._lock:
+            self._latencies[endpoint].append(seconds)
+
+    def snapshot(self, queue_depth: int | None = None,
+                 cache_stats: dict | None = None) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            hist = {str(k): v
+                    for k, v in sorted(self._batch_sizes.items())}
+            lat = {ep: percentiles(vals)
+                   for ep, vals in self._latencies.items()}
+        out = {
+            "uptime_s": round(time.time() - self.started, 1),
+            "counters": counters,
+            "batch_size_hist": hist,
+            "latency_s": lat,
+            "stage_seconds": self.timer.as_dict(),
+        }
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        if cache_stats is not None:
+            out["cache"] = cache_stats
+        return out

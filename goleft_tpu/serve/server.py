@@ -1,0 +1,275 @@
+"""The serve daemon: warm-mesh HTTP service over the coverage stack.
+
+Stdlib-only (``http.server.ThreadingHTTPServer``): every request is a
+JSON POST handled on its own thread, funneled through the
+:class:`~goleft_tpu.serve.batcher.MicroBatcher` into coalesced device
+passes (serve/executors.py). Layered on top:
+
+  - session cache: responses for unchanged input files are replayed
+    from a bounded :class:`~goleft_tpu.parallel.scheduler.ResultCache`
+    without touching the batcher or the device (keys carry
+    ``file_key`` identity — size + mtime_ns — so a rewritten BAM
+    misses)
+  - /healthz: backend platform/device state (the device_guard probe's
+    cached verdict feeds the CLI bring-up; here the live backend is
+    reported) + draining flag
+  - /metrics: request/response counters, queue depth, the batch-size
+    histogram (the coalescing evidence), per-endpoint latency
+    percentiles, stage wall-clocks and cache hit rates
+  - graceful drain: SIGTERM stops the accept loop, in-flight handler
+    threads finish through the batcher, exit 0
+
+Routes:
+  POST /v1/depth        {bam, reference|fai, window?, mincov?,
+                         maxmeandepth?, mapq?, chrom?, bed?}
+  POST /v1/indexcov     {bams: [...], fai, chrom?, excludepatt?}
+  POST /v1/cohortdepth  {bams: [...], reference|fai, window?, mapq?,
+                         chrom?, bed?, engine?}
+  GET  /healthz         GET /metrics
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .batcher import DeadlineExceeded, MicroBatcher, Overloaded
+from .executors import (
+    BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
+)
+from .metrics import ServeMetrics
+
+log = logging.getLogger("goleft-tpu.serve")
+
+
+class ServeApp:
+    """Wiring between the HTTP surface, the batcher, the executors and
+    the session cache; independent of any socket so tests (and the
+    bench) can drive it in-process."""
+
+    def __init__(self, batch_window_s: float = 0.01,
+                 max_batch: int = 16, max_queue: int = 64,
+                 default_timeout_s: float = 120.0,
+                 cache_dir: str | None = None,
+                 cache_max_bytes: int | None = 256 * 1024 * 1024,
+                 processes: int = 4):
+        self.metrics = ServeMetrics()
+        self.default_timeout_s = default_timeout_s
+        self.executors = {
+            ex.kind: ex for ex in (
+                DepthExecutor(processes, self.metrics),
+                IndexcovExecutor(max(processes, 8), self.metrics),
+                CohortdepthExecutor(processes, self.metrics),
+            )
+        }
+        self.cache = None
+        if cache_dir:
+            from ..parallel.scheduler import ResultCache
+
+            self.cache = ResultCache(cache_dir,
+                                     max_bytes=cache_max_bytes)
+        self.batcher = MicroBatcher(self._run_batch,
+                                    window_s=batch_window_s,
+                                    max_batch=max_batch,
+                                    max_queue=max_queue,
+                                    metrics=self.metrics)
+        self.draining = False
+
+    def _run_batch(self, key, payloads):
+        return self.executors[key[0]].run(payloads)
+
+    def _cache_key(self, kind: str, req: dict):
+        # the FULL canonical request (not just the batching signature)
+        # plus every input file's identity: any parameter the executor
+        # might read must miss, and a rewritten input — same second,
+        # same size — must miss too (file_key carries mtime_ns)
+        from ..parallel.scheduler import file_key
+
+        ex = self.executors[kind]
+        params = json.dumps(
+            {k: v for k, v in req.items() if k != "timeout_s"},
+            sort_keys=True)
+        files = tuple(file_key(p) for p in ex.cache_files(req))
+        return (kind, params, files)
+
+    def handle(self, kind: str, req: dict) -> tuple[int, dict]:
+        """One request → (http status, response dict)."""
+        ex = self.executors.get(kind)
+        if ex is None:
+            return 404, {"error": f"unknown endpoint {kind!r}"}
+        t0 = time.perf_counter()
+        self.metrics.inc(f"requests_total.{kind}")
+        try:
+            ex.validate(req)
+            ckey = self._cache_key(kind, req) if self.cache else None
+            if ckey is not None:
+                hit = self.cache.get(ckey)
+                if hit is not None:
+                    self.metrics.observe_latency(
+                        kind, time.perf_counter() - t0)
+                    return 200, {**hit, "cached": True}
+            timeout = float(req.get("timeout_s",
+                                    self.default_timeout_s))
+            result = self.batcher.submit(ex.group_key(req), req,
+                                         timeout_s=timeout)
+            if ckey is not None:
+                self.cache.put(ckey, result)
+        except BadRequest as e:
+            return 400, {"error": str(e)}
+        except Overloaded as e:
+            return 429, {"error": str(e)}
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — request isolation
+            log.exception("serve: %s request failed", kind)
+            return 500, {"error": repr(e)}
+        self.metrics.observe_latency(kind, time.perf_counter() - t0)
+        return 200, result
+
+    def healthz(self) -> tuple[int, dict]:
+        rec = {"status": "draining" if self.draining else "ok",
+               "uptime_s": round(time.time() - self.metrics.started,
+                                 1)}
+        try:
+            import jax
+
+            devs = jax.devices()
+            rec.update(platform=devs[0].platform, devices=len(devs))
+        except Exception as e:  # noqa: BLE001 — health must not crash
+            rec.update(status="degraded", error=repr(e))
+        code = 503 if self.draining else 200
+        return code, rec
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot(
+            queue_depth=self.batcher.queue_depth(),
+            cache_stats=self.cache.stats() if self.cache else None,
+        )
+
+    def warmup(self) -> float:
+        """Bring the backend up and compile a minimal depth program so
+        the first real request doesn't pay cold XLA bring-up. Geometry-
+        specific compiles still happen per request shape; this buys the
+        backend + the compile machinery. Returns seconds spent."""
+        import jax
+
+        from ..commands.depth import _batched_cls_packed
+
+        t0 = time.perf_counter()
+        jax.devices()
+        z = np.zeros((1, 64), np.int32)
+        i32 = np.int32
+        jax.block_until_ready(_batched_cls_packed()(
+            z, z, z.astype(bool), i32(0), i32(0), i32(256), i32(2500),
+            i32(4), i32(0), length=256, window=256))
+        return time.perf_counter() - t0
+
+    def close(self, drain: bool = True) -> None:
+        self.draining = True
+        self.batcher.close(drain=drain)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the server instance carries .app (set by make_server)
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route away from stderr spam
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _respond(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        # one request per connection: a lingering keep-alive socket
+        # would pin its handler thread and stall the drain join
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+        self.close_connection = True
+        self.app.metrics.inc(f"responses_total.{code}")
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app
+
+    def do_GET(self):  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            code, body = self.app.healthz()
+            self._respond(code, body)
+        elif self.path == "/metrics":
+            self._respond(200, self.app.metrics_snapshot())
+        else:
+            self._respond(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — http.server contract
+        if not self.path.startswith("/v1/"):
+            self._respond(404, {"error": f"no route {self.path}"})
+            return
+        kind = self.path[len("/v1/"):].strip("/")
+        if self.app.draining:
+            self._respond(503, {"error": "server is draining"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+        except ValueError as e:
+            self._respond(400, {"error": f"bad JSON body: {e}"})
+            return
+        code, body = self.app.handle(kind, req)
+        self._respond(code, body)
+
+
+class _Server(ThreadingHTTPServer):
+    # join in-flight handler threads on server_close(): the drain path
+    # must let queued work finish, not orphan it mid-response
+    daemon_threads = False
+    block_on_close = True
+    allow_reuse_address = True
+
+
+def make_server(app: ServeApp, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind (port 0 → ephemeral; read ``server_address`` for the
+    actual port). Caller runs ``serve_forever`` / ``shutdown``."""
+    srv = _Server((host, port), _Handler)
+    srv.app = app
+    return srv
+
+
+class ServerThread:
+    """In-process server harness: the tests' and bench's entry.
+
+    with ServerThread(app) as base_url: ...  # "http://127.0.0.1:PORT"
+    """
+
+    def __init__(self, app: ServeApp, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.app = app
+        self.httpd = make_server(app, host, port)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name="goleft-serve-http")
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> str:
+        self._thread.start()
+        return self.base_url
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self._thread.join(timeout=30.0)
+        self.httpd.server_close()
+        self.app.close()
+        return False

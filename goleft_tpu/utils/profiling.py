@@ -93,6 +93,23 @@ class StageTimer:
             log.info("%s", line)
 
 
+def percentiles(values, qs=(50, 95), ndigits: int = 4) -> dict:
+    """{"p50": ..., "p95": ..., "count": n} nearest-rank percentiles
+    over a sequence of seconds — the latency summary the serve daemon's
+    /metrics endpoint and the bench's serve_throughput entry share.
+    Empty input returns {"count": 0} (no fabricated zeros)."""
+    vals = sorted(float(v) for v in values)
+    out: dict = {"count": len(vals)}
+    if not vals:
+        return out
+    import math
+
+    for q in qs:
+        rank = max(1, min(len(vals), math.ceil(q / 100.0 * len(vals))))
+        out[f"p{q:g}"] = round(vals[rank - 1], ndigits)
+    return out
+
+
 def overlap_efficiency(timer: StageTimer, wall: float | None = None,
                        compute_stage: str = "compute") -> float | None:
     """How much of the non-compute pipeline work was hidden behind

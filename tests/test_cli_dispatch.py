@@ -1,0 +1,68 @@
+"""CLI dispatcher contract: exit codes 0/1/141 + typo suggestions.
+
+The exit codes are the scriptability surface (`goleft-tpu X && ...`):
+0 for help/version, 1 for unknown subcommands and bad input, 141
+(128+SIGPIPE) when downstream closes the pipe — pinned here so a
+dispatcher refactor can't silently change them.
+"""
+
+import numpy as np
+
+from goleft_tpu.cli import PROGS, main as cli_main
+from helpers import write_bam_and_bai
+
+
+def test_help_and_version_exit_zero(capsys):
+    assert cli_main([]) == 0
+    assert "depth" in capsys.readouterr().err
+    assert cli_main(["--help"]) == 0
+    assert cli_main(["--version"]) == 0
+
+
+def test_unknown_subcommand_suggests_close_match(capsys):
+    assert cli_main(["dept"]) == 1
+    err = capsys.readouterr().err
+    assert "unknown subcommand: dept" in err
+    assert "did you mean depth?" in err
+    # a suggestion replaces the table dump
+    assert "matricize" not in err
+
+
+def test_unknown_subcommand_far_from_any_prints_table(capsys):
+    assert cli_main(["qqzzxy"]) == 1
+    err = capsys.readouterr().err
+    assert "unknown subcommand: qqzzxy" in err
+    # no plausible guess: the full sorted table prints instead
+    for name in PROGS:
+        assert name in err
+
+
+def test_serve_is_registered():
+    assert "serve" in PROGS
+    assert PROGS["serve"][2] is True  # device command: warm bring-up
+
+
+def test_broken_pipe_exits_141(tmp_path, monkeypatch, capsys):
+    """`goleft-tpu samplename x.bam | head -c0` analog: stdout's pipe
+    is closed, the tool must die silently with 141."""
+    rng = np.random.default_rng(0)
+    bam = str(tmp_path / "t.bam")
+    write_bam_and_bai(bam, [(0, int(s), "50M", 60, 0)
+                            for s in sorted(rng.integers(0, 900, 20))],
+                      ref_names=("chr1",), ref_lens=(1000,),
+                      header_text="@HD\tVN:1.6\tSO:coordinate\n"
+                                  "@SQ\tSN:chr1\tLN:1000\n"
+                                  "@RG\tID:r\tSM:s1\n")
+
+    class _ClosedPipe:
+        def write(self, *_):
+            raise BrokenPipeError(32, "Broken pipe")
+
+        def flush(self):
+            pass
+
+    monkeypatch.setattr("sys.stdout", _ClosedPipe())
+    rc = cli_main(["samplename", bam])
+    assert rc == 141
+    err = capsys.readouterr().err
+    assert "Traceback" not in err

@@ -1,5 +1,8 @@
 """Sharded coverage on the 8-device virtual CPU mesh + scheduler tests."""
 
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -191,3 +194,88 @@ def test_run_sharded_unordered_bounded():
     out = list(run_sharded([(i,) for i in range(17)], lambda i: i + 1,
                            processes=3, ordered=False, max_in_flight=2))
     assert sorted(r.value for r in out) == list(range(1, 18))
+
+
+def test_file_key_mtime_ns_resolution(tmp_path):
+    """A same-second, same-size rewrite must change the key: truncating
+    to whole seconds aliased it to a stale cache hit."""
+    p = tmp_path / "f.txt"
+    p.write_text("hello")
+    k1 = file_key(str(p))
+    st = os.stat(p)
+    p.write_text("world")  # same size, new content
+    # pin the rewrite into the SAME integer second, different ns
+    os.utime(p, ns=(st.st_atime_ns,
+                    (st.st_mtime_ns // 1_000_000_000) * 1_000_000_000
+                    + (st.st_mtime_ns + 1) % 1_000_000_000))
+    k2 = file_key(str(p))
+    assert k1[1] == k2[1] == 5  # size did not tell them apart
+    assert k1 != k2
+
+
+def test_result_cache_counters_and_lru_bound(tmp_path):
+    cache = ResultCache(str(tmp_path / "c"), max_bytes=1)
+    cache.put(("a",), "x" * 100)
+    cache.put(("b",), "y" * 100)
+    # bound of 1 byte: after each put the older entries are evicted
+    st = cache.stats()
+    assert st["entries"] <= 1
+    assert cache.get(("a",)) is None  # evicted (oldest)
+    assert cache.misses >= 1
+
+
+def test_result_cache_lru_touch_on_hit(tmp_path):
+    """A get() refreshes the entry's recency: the UNTOUCHED entry is
+    the eviction victim."""
+    # bound sized so evicting ONE ~3KB entry suffices after the 8KB put
+    cache = ResultCache(str(tmp_path / "c"), max_bytes=12_000)
+    cache.put(("old",), "a" * 3000)
+    cache.put(("mid",), "b" * 3000)
+    # make mtimes strictly ordered regardless of fs timestamp
+    # granularity, then touch "old" via a hit
+    now = time.time()
+    os.utime(cache._path(("old",)), (now - 20, now - 20))
+    os.utime(cache._path(("mid",)), (now - 10, now - 10))
+    assert cache.get(("old",)) == "a" * 3000  # touches mtime to ~now
+    cache.put(("new",), "c" * 8000)  # forces eviction of one entry
+    assert cache.get(("mid",)) is None  # the stale one went
+    assert cache.get(("old",)) == "a" * 3000
+    st = cache.stats()
+    assert st["hits"] >= 2 and st["misses"] >= 1
+
+
+def test_result_cache_concurrent_get_put(tmp_path):
+    """Many threads hammering overlapping keys: every get returns a
+    COMPLETE value or None — the tmp-write + os.replace path must never
+    expose a torn read under contention."""
+    import threading
+
+    cache = ResultCache(str(tmp_path / "c"))
+    keys = [(f"k{i}",) for i in range(4)]
+    payloads = {k: k[0] * 5000 for k in keys}
+    errors = []
+    stop = time.monotonic() + 1.5
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        while time.monotonic() < stop:
+            k = keys[int(rng.integers(len(keys)))]
+            if rng.integers(2):
+                cache.put(k, payloads[k])
+            else:
+                v = cache.get(k)
+                if v is not None and v != payloads[k]:
+                    errors.append((k, len(v)))
+                    return
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    # and no stray tmp files survive the storm
+    leftovers = [n for n in os.listdir(cache.dir)
+                 if not n.endswith(".pkl")]
+    assert leftovers == []

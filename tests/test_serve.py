@@ -1,0 +1,427 @@
+"""Serve daemon tests: micro-batcher semantics, end-to-end byte
+identity with the one-shot CLIs, coalescing evidence via /metrics,
+session-cache replay, overload/deadline codes, SIGTERM drain.
+
+Every blocking wait carries an explicit timeout (client timeouts,
+thread joins, subprocess waits) so a wedged server fails the test
+instead of hanging tier-1.
+"""
+
+import io
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from goleft_tpu.serve.batcher import (
+    DeadlineExceeded, MicroBatcher, Overloaded,
+)
+from goleft_tpu.serve.client import ServeClient, ServeError
+from goleft_tpu.serve.server import ServeApp, ServerThread
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+REF_LEN = 20_000
+
+
+def _hdr(sm: str, ref_len: int = REF_LEN) -> str:
+    return ("@HD\tVN:1.6\tSO:coordinate\n"
+            f"@SQ\tSN:chr1\tLN:{ref_len}\n"
+            f"@RG\tID:rg\tSM:{sm}\n")
+
+
+def make_cohort(tmp_path, n: int, seed: int = 0, n_reads: int = 250,
+                ref_len: int = REF_LEN):
+    """n small single-chromosome BAMs + a real fasta with .fai."""
+    rng = np.random.default_rng(seed)
+    bams = []
+    for i in range(n):
+        reads = random_reads(rng, n_reads, 0, ref_len, mapq_lo=20)
+        p = str(tmp_path / f"s{seed}_{i}.bam")
+        write_bam_and_bai(p, reads, ref_names=("chr1",),
+                          ref_lens=(ref_len,),
+                          header_text=_hdr(f"s{seed}_{i}", ref_len))
+        bams.append(p)
+    ref = str(tmp_path / "ref.fa")
+    if not os.path.exists(ref):
+        write_fasta(ref, {"chr1": "ACGT" * (ref_len // 4)})
+        from goleft_tpu.io.fai import write_fai
+
+        write_fai(ref)
+    return bams, ref + ".fai"
+
+
+# ---------------- micro-batcher unit semantics ----------------
+
+
+def test_batcher_coalesces_compatible_requests():
+    batches = []
+
+    def run(key, payloads):
+        batches.append(list(payloads))
+        return [p * 10 for p in payloads]
+
+    with MicroBatcher(run, window_s=0.25, max_batch=8) as mb:
+        out = [None] * 6
+
+        def fire(i):
+            out[i] = mb.submit(("k",), i, timeout_s=30)
+
+        ts = [threading.Thread(target=fire, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert out == [i * 10 for i in range(6)]
+    assert sum(len(b) for b in batches) == 6
+    assert len(batches) <= 2  # coalesced, not one pass per request
+
+
+def test_batcher_keeps_groups_apart():
+    seen = []
+
+    def run(key, payloads):
+        seen.append((key, sorted(payloads)))
+        return payloads
+
+    with MicroBatcher(run, window_s=0.2, max_batch=8) as mb:
+        res = {}
+
+        def fire(key, i):
+            res[(key, i)] = mb.submit(key, i, timeout_s=30)
+
+        ts = [threading.Thread(target=fire, args=(("a",) if i % 2
+                                                  else ("b",), i))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    assert all(res[(k, i)] == i for (k, i) in res)
+    for key, payloads in seen:
+        # a batch never mixes signatures
+        assert all(p % 2 == (1 if key == ("a",) else 0)
+                   for p in payloads)
+
+
+def test_batcher_overload_and_drain():
+    release = threading.Event()
+
+    def run(key, payloads):
+        release.wait(timeout=30)
+        return payloads
+
+    mb = MicroBatcher(run, window_s=0.0, max_batch=1, max_queue=2)
+    results = []
+    errors = []
+
+    def fire(i):
+        try:
+            results.append(mb.submit(("k",), i, timeout_s=30))
+        except Overloaded as e:
+            errors.append(e)
+
+    # first request gets picked up by the dispatcher (leaves the
+    # queue), two more fill the queue, the rest must bounce
+    t0 = threading.Thread(target=fire, args=(0,))
+    t0.start()
+    time.sleep(0.2)
+    ts = [threading.Thread(target=fire, args=(i,))
+          for i in range(1, 6)]
+    for t in ts:
+        t.start()
+        time.sleep(0.05)
+    time.sleep(0.2)
+    assert len(errors) >= 1  # admission control kicked in
+    release.set()
+    for t in [t0] + ts:
+        t.join(timeout=30)
+    mb.close()
+    assert len(results) + len(errors) == 6  # accepted ones completed
+
+
+def test_batcher_deadline_504_path():
+    gate = threading.Event()
+
+    def run(key, payloads):
+        gate.wait(timeout=30)
+        return payloads
+
+    mb = MicroBatcher(run, window_s=0.0, max_batch=1)
+    slow = threading.Thread(
+        target=lambda: mb.submit(("k",), "anchor", timeout_s=30))
+    slow.start()
+    time.sleep(0.2)  # anchor now executing; next request queues
+    with pytest.raises(DeadlineExceeded):
+        mb.submit(("k",), "late", timeout_s=0.1)
+    gate.set()
+    slow.join(timeout=30)
+    mb.close()
+
+
+def test_batcher_error_isolation():
+    def run(key, payloads):
+        if key == ("bad",):
+            raise RuntimeError("executor blew up")
+        return payloads
+
+    with MicroBatcher(run, window_s=0.0) as mb:
+        with pytest.raises(RuntimeError, match="blew up"):
+            mb.submit(("bad",), 1, timeout_s=10)
+        assert mb.submit(("ok",), 2, timeout_s=10) == 2  # still alive
+
+
+# ---------------- end-to-end over real HTTP ----------------
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One warm app/server for the module: the whole point of serve is
+    program reuse across requests, and the tests get tier-1-cheap by
+    sharing the compile."""
+    tmp_path = tmp_path_factory.mktemp("serve")
+    bams, fai = make_cohort(tmp_path, 8)
+    app = ServeApp(batch_window_s=0.3, max_batch=8,
+                   cache_dir=str(tmp_path / "session-cache"))
+    with ServerThread(app) as url:
+        yield {"url": url, "app": app, "bams": bams, "fai": fai,
+               "tmp_path": tmp_path}
+
+
+def test_depth_byte_identity_with_oneshot_cli(served, tmp_path):
+    """Acceptance: the daemon's depth response bytes == the one-shot
+    `goleft depth` CLI files on the same fixture."""
+    from goleft_tpu.commands.depth import run_depth
+
+    bam, fai = served["bams"][0], served["fai"]
+    dp, cp = run_depth(bam, str(tmp_path / "oneshot"), fai=fai,
+                       window=250)
+    client = ServeClient(served["url"], timeout_s=120)
+    r = client.depth(bam, fai=fai, window=250)
+    with open(dp) as fh:
+        assert r["depth_bed"] == fh.read()
+    with open(cp) as fh:
+        assert r["callable_bed"] == fh.read()
+    assert r["depth_bed"].startswith("chr1\t0\t250\t")
+
+
+def test_depth_burst_coalesces_and_matches_singles(served):
+    """Acceptance: a burst of >= 8 concurrent depth requests lands in
+    <= 2 device passes (batch-size histogram), every response byte-
+    identical to its request served alone."""
+    url, bams, fai = served["url"], served["bams"], served["fai"]
+    app = served["app"]
+    # distinct params from other tests so this burst owns its group
+    params = dict(fai=fai, window=125)
+    before = dict(app.metrics.snapshot()["batch_size_hist"])
+    results = [None] * 8
+    errs = []
+
+    def fire(i):
+        try:
+            # the cache_buster field keeps each request out of the
+            # session cache (it joins the cache key, not the batching
+            # signature) so all 8 really reach the batcher
+            results[i] = ServeClient(url, timeout_s=120).depth(
+                bams[i], **params, cache_buster=i)
+        except Exception as e:  # noqa: BLE001 — assert below
+            errs.append(e)
+
+    ts = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errs
+    after = app.metrics.snapshot()["batch_size_hist"]
+    new = {int(k): after.get(k, 0) - before.get(k, 0)
+           for k in set(after) | set(before)}
+    n_batches = sum(v for v in new.values() if v > 0)
+    n_requests = sum(k * v for k, v in new.items() if v > 0)
+    assert n_requests == 8
+    assert n_batches <= 2, f"burst fragmented into {new}"
+    # batched outputs == freshly computed solo outputs, byte for byte
+    client = ServeClient(url, timeout_s=120)
+    for i in range(2):
+        solo = client.depth(bams[i], **params)
+        assert "cached" not in solo  # distinct key: computed, not replayed
+        assert results[i]["depth_bed"] == solo["depth_bed"]
+        assert results[i]["callable_bed"] == solo["callable_bed"]
+
+
+def test_session_cache_replays_unchanged_files(served):
+    url, bam, fai = served["url"], served["bams"][3], served["fai"]
+    app = served["app"]
+    client = ServeClient(url, timeout_s=120)
+    params = dict(fai=fai, window=250)
+    r1 = client.depth(bam, **params)
+    passes = app.metrics.snapshot()["counters"].get(
+        "device_passes_total", 0)
+    r2 = client.depth(bam, **params)
+    assert r2.get("cached") is True
+    assert r2["depth_bed"] == r1["depth_bed"]
+    assert app.metrics.snapshot()["counters"].get(
+        "device_passes_total", 0) == passes  # no device touch
+    # rewriting the file (same size, fresh mtime_ns) must invalidate
+    with open(bam, "rb") as fh:
+        raw = fh.read()
+    with open(bam, "wb") as fh:
+        fh.write(raw)
+    r3 = client.depth(bam, **params)
+    assert "cached" not in r3 and r3["depth_bed"] == r1["depth_bed"]
+
+
+def test_healthz_and_metrics_surface(served):
+    client = ServeClient(served["url"], timeout_s=30)
+    h = client.healthz()
+    assert h["status"] == "ok" and h["platform"] == "cpu"
+    m = client.metrics()
+    assert {"counters", "batch_size_hist", "latency_s",
+            "stage_seconds", "queue_depth", "cache",
+            "uptime_s"} <= set(m)
+    assert m["cache"]["hits"] >= 1  # the session-cache test ran
+    lat = m["latency_s"].get("depth")
+    assert lat and lat["count"] >= 1 and "p50" in lat and "p95" in lat
+    assert {"decode", "compute", "format"} <= set(m["stage_seconds"])
+
+
+def test_indexcov_batching_invariance(served, tmp_path):
+    """Responses are independent of batch composition: two cohorts
+    with DIFFERENT longest-bin counts served concurrently (one fused
+    chrom_qc) must equal their solo runs — the tail-term correction."""
+    url, fai = served["url"], served["fai"]
+    # cohort B's reads span 4× further → more index bins, so in a
+    # combined batch cohort A is the one needing the tail correction
+    bams_a = served["bams"][:3]
+    bams_b, _ = make_cohort(served["tmp_path"], 2, seed=9,
+                            n_reads=120, ref_len=REF_LEN * 4)
+    client = ServeClient(url, timeout_s=120)
+    solo_a = client.indexcov(bams_a, fai, cache_buster="a1")
+    solo_b = client.indexcov(bams_b, fai, cache_buster="b1")
+    out = {}
+
+    def fire(name, bams):
+        out[name] = ServeClient(url, timeout_s=120).indexcov(
+            bams, fai, cache_buster=name + "2")
+
+    ts = [threading.Thread(target=fire, args=("a", bams_a)),
+          threading.Thread(target=fire, args=("b", bams_b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    for solo, key in ((solo_a, "a"), (solo_b, "b")):
+        got = out[key]
+        assert got["samples"] == solo["samples"]
+        assert got["cn"] == solo["cn"]
+        assert got["bin_counters"] == solo["bin_counters"]
+    # the cohorts genuinely had different bin counts (in+out == each
+    # cohort's own longest) — otherwise the tail-term correction that
+    # makes the batch invariant wasn't exercised
+    total_b = solo_b["bin_counters"]["in"][0] + \
+        solo_b["bin_counters"]["out"][0]
+    total_a = solo_a["bin_counters"]["in"][0] + \
+        solo_a["bin_counters"]["out"][0]
+    assert total_b > total_a
+
+
+def test_cohortdepth_byte_identity_and_batching(served):
+    from goleft_tpu.commands.cohortdepth import run_cohortdepth
+
+    url, fai = served["url"], served["fai"]
+    bams_a, bams_b = served["bams"][:2], served["bams"][2:5]
+    buf = io.StringIO()
+    run_cohortdepth(bams_a, fai=fai, window=500, out=buf, processes=2)
+    want_a = buf.getvalue()
+    out = {}
+
+    def fire(name, bams):
+        out[name] = ServeClient(url, timeout_s=120).cohortdepth(
+            bams, fai=fai, window=500, cache_buster=name)
+
+    ts = [threading.Thread(target=fire, args=("a", bams_a)),
+          threading.Thread(target=fire, args=("b", bams_b))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert out["a"]["matrix_tsv"] == want_a
+    assert out["a"]["samples"] == ["s0_0", "s0_1"]
+    assert len(out["b"]["samples"]) == 3
+    hdr_b = out["b"]["matrix_tsv"].splitlines()[0]
+    assert hdr_b == "#chrom\tstart\tend\t" + "\t".join(
+        out["b"]["samples"])
+
+
+def test_bad_requests_get_400(served):
+    client = ServeClient(served["url"], timeout_s=30)
+    with pytest.raises(ServeError) as ei:
+        client.depth(str(served["tmp_path"] / "nope.bam"),
+                     fai=served["fai"])
+    assert ei.value.status == 400
+    with pytest.raises(ServeError) as ei:
+        client._request("/v1/depth", {})
+    assert ei.value.status == 400
+    with pytest.raises(ServeError) as ei:
+        client._request("/v1/unknown-kind", {})
+    assert ei.value.status == 404
+
+
+def test_overload_maps_to_429():
+    """Past max_queue pending requests the app sheds load with 429."""
+    app = ServeApp(batch_window_s=0.0, max_batch=1, max_queue=1)
+    gate = threading.Event()
+
+    class StubExec:
+        kind = "depth"
+
+        def validate(self, req):
+            pass
+
+        def group_key(self, req):
+            return ("depth", "stub")  # [0] routes back to this stub
+
+        def cache_files(self, req):
+            return []
+
+        def run(self, reqs):
+            gate.wait(timeout=30)
+            return [{"ok": True} for _ in reqs]
+
+    app.executors["depth"] = StubExec()
+    codes = []
+    lock = threading.Lock()
+
+    def fire():
+        code, _ = app.handle("depth", {"bam": "x"})
+        with lock:
+            codes.append(code)
+
+    try:
+        ts = [threading.Thread(target=fire) for _ in range(5)]
+        ts[0].start()
+        time.sleep(0.25)  # dispatcher takes it → queue empty again
+        ts[1].start()
+        time.sleep(0.1)  # fills the 1-slot queue
+        for t in ts[2:]:
+            t.start()
+            time.sleep(0.05)
+        time.sleep(0.2)
+        gate.set()
+        for t in ts:
+            t.join(timeout=30)
+        assert codes.count(429) == 3, codes
+        assert codes.count(200) == 2, codes
+    finally:
+        gate.set()
+        app.close()
+
+
+def test_sigterm_drain_exits_zero():
+    """Acceptance: a real `goleft-tpu serve` subprocess drains on
+    SIGTERM and exits 0 (also the `make serve-smoke` body)."""
+    from goleft_tpu.serve.smoke import run_smoke
+
+    assert run_smoke(timeout_s=120.0, verbose=False) == 0
