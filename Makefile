@@ -6,7 +6,7 @@ CXX ?= g++
 SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
-        serve-smoke clean
+        serve-smoke obs-smoke clean
 
 native: build/libgoleftio.so
 
@@ -34,6 +34,13 @@ test:
 # by the smoke's own 120s deadline.
 serve-smoke:
 	python -m goleft_tpu.serve.smoke
+
+# observability end-to-end: a real depth invocation with --trace-out +
+# --metrics-out on a fabricated fixture, then schema-validate both
+# artifacts (Chrome-trace-event shape Perfetto loads; run manifest
+# with required provenance keys). Host-pinned like serve-smoke.
+obs-smoke:
+	python -m goleft_tpu.obs.smoke
 
 # run the io test files with the AddressSanitized library preloaded.
 # Tests that execute XLA are excluded: ASan's allocator interposition is

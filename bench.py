@@ -86,6 +86,19 @@ def numpy_pipeline(seg_s, seg_e, keep, length, window, cap=2500,
     return wsums, cls
 
 
+def _backend_provenance() -> dict:
+    """{platform, device, device_kind} from the ONE shared provenance
+    answer (goleft_tpu.obs.provenance) — the same fields a
+    ``--metrics-out`` run manifest carries, ingested here directly so
+    bench entries and manifests can never disagree about what ran."""
+    from goleft_tpu.obs import backend_provenance
+
+    prov = backend_provenance()
+    if "error" in prov:
+        return {"platform": "unavailable", "error": prov["error"]}
+    return {k: prov[k] for k in ("platform", "device", "device_kind")}
+
+
 def chip_limits():
     """(device_kind, {hbm_gbps, bf16_tflops} or None) for roofline
     accounting. Published chip specs: v5e (v5 lite) 819 GB/s HBM,
@@ -872,6 +885,12 @@ class _CompileCounter(logging.Handler):
         msg = record.getMessage()
         if msg.startswith("Compiling "):
             self.names.append(msg.split(" with ")[0])
+            # the unified registry keeps the process-lifetime tally —
+            # compile-cache deltas land in --metrics-out manifests
+            # alongside the bench's per-phase counts
+            from goleft_tpu.obs import get_registry
+
+            get_registry().counter("xla.compiles_total").inc()
 
 
 @_contextlib.contextmanager
@@ -971,13 +990,10 @@ def bench_depth_wholegenome(quick: bool) -> dict:
         t_cold, st_cold, c_cold, cache_cold = run("cold")
         t_warm, st_warm, c_warm, cache_warm = run("warm")
         total_bp = sum(chrom_lens)
-        import jax
-
-        dev = jax.devices()[0]
         entry = {
             "chromosomes": n_chrom, "genome_bp": total_bp,
             "coverage": coverage, "window": 250, "mapq_min": 20,
-            "platform": dev.platform, "device": str(dev),
+            **_backend_provenance(),
             "seconds_cold": round(t_cold, 3),
             "seconds_warm": round(t_warm, 3),
             "gbases_per_sec_warm": round(total_bp / t_warm / 1e9, 4),
@@ -1140,11 +1156,10 @@ def bench_cohort_device(n_samples: int = 20, ref_len: int = 4_000_000,
             f"extraction-bound here, so 1 chip suffices wherever "
             f"extraction ({r_extract:.3f} Gbases/s/core) outpaces "
             f"the hybrid reduce ({r_hybrid:.3f} Gbases/s/core)")
-        dev = jax.devices()[0]
         return {
             "samples": n_samples, "ref_bp": ref_len,
             "coverage": coverage,
-            "platform": dev.platform, "device": str(dev),
+            **_backend_provenance(),
             "hybrid_seconds": round(t_h, 3),
             "device_seconds": round(t_d, 3),
             "hybrid_gbases_per_sec": round(r_hybrid, 4),
@@ -1501,7 +1516,9 @@ def _serve_throughput_entry(quick: bool) -> dict:
     for phase in ("cold", "warm"):
         out[phase] = {
             "req_per_sec": round(n_requests / walls[phase], 2),
-            "latency_s": percentiles(lat[phase], (50, 95)),
+            # default qs: p50/p95/p99 + max — the same summary the
+            # daemon's /metrics serves
+            "latency_s": percentiles(lat[phase]),
         }
     return out
 
@@ -1731,10 +1748,9 @@ def bench_kernels(quick: bool) -> dict:
     )
     np_gbps = length / np_dt / 1e9
 
-    dev = jax.devices()[0]
     return {
         "window": window,
-        "device": str(dev), "platform": dev.platform,
+        **_backend_provenance(),
         "kernel_device_resident_gbases_per_sec": round(gbps, 4),
         "kernel_e2e_incl_transfer_gbases_per_sec": round(e2e_gbps, 4),
         "kernel_e2e_packed_wire_gbases_per_sec": round(packed_gbps, 4),

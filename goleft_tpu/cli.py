@@ -3,6 +3,23 @@
 Mirrors the reference's command-plugin table (cmd/goleft/goleft.go:24-31):
 a name → (help, main) registry; unknown or missing subcommands print the
 sorted table. New tools register by adding one entry.
+
+Global observability flags — valid before OR after the subcommand name
+(they are stripped here, so individual commands never re-declare them):
+
+  --trace-out FILE    write the run's span timeline as Chrome
+                      trace-event JSON (loads in Perfetto); also turns
+                      on per-dispatch device-event fencing
+  --metrics-out FILE  write the run manifest (env + backend provenance
+                      + span summary + metrics-registry snapshot)
+  --log-level LEVEL   debug/info/warning/error on the goleft-tpu.*
+                      logger tree
+  -v / -vv            shorthand for --log-level info / debug
+                      (``goleft-tpu -v`` as the sole argument still
+                      prints the version, as it always has)
+
+Every invocation runs under a run-scoped trace: the ``run.<cmd>`` root
+span parents the pipeline stages, whichever threads record them.
 """
 
 from __future__ import annotations
@@ -62,6 +79,54 @@ PROGS = {
               _lazy(".commands.serve"), True),
 }
 
+_VALUE_FLAGS = {"--trace-out": "trace_out",
+                "--metrics-out": "metrics_out",
+                "--log-level": "log_level"}
+
+
+def _extract_global_flags(argv: list[str]):
+    """Strip the global observability flags from anywhere in argv.
+
+    Returns (opts dict, remaining argv) or raises ValueError on a flag
+    missing its value / an unknown level. ``-v``/``-vv`` count as
+    verbosity here; the caller handles the historical ``goleft-tpu -v``
+    == version case before calling this.
+    """
+    opts = {"trace_out": None, "metrics_out": None, "log_level": None,
+            "verbose": 0}
+    rest: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        key = _VALUE_FLAGS.get(a)
+        if key is not None:
+            if i + 1 >= len(argv):
+                raise ValueError(f"{a} needs a value")
+            opts[key] = argv[i + 1]
+            i += 2
+            continue
+        flag, _, val = a.partition("=")
+        key = _VALUE_FLAGS.get(flag)
+        if key is not None and _ == "=":
+            opts[key] = val
+            i += 1
+            continue
+        if a == "-v":
+            opts["verbose"] += 1
+            i += 1
+            continue
+        if a == "-vv":
+            opts["verbose"] += 2
+            i += 1
+            continue
+        rest.append(a)
+        i += 1
+    if opts["log_level"] is not None:
+        from .obs.logging import parse_level
+
+        parse_level(opts["log_level"])  # fail fast on a bad level
+    return opts, rest
+
 
 def usage() -> str:
     lines = [
@@ -70,56 +135,24 @@ def usage() -> str:
     ]
     for name in sorted(PROGS):
         lines.append(f"{name:<11}: {PROGS[name][0]}")
+    lines += [
+        "",
+        "global flags (before or after the subcommand):",
+        "  --trace-out FILE    Perfetto/Chrome trace of the run's spans",
+        "  --metrics-out FILE  run manifest (provenance + span summary "
+        "+ metrics)",
+        "  --log-level LEVEL   debug|info|warning|error",
+        "  -v / -vv            info / debug logging",
+    ]
     return "\n".join(lines)
 
 
-def main(argv: list[str] | None = None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help", "help"):
-        print(usage(), file=sys.stderr)
-        return 0
-    if argv[0] in ("-v", "--version", "version"):
-        print(__version__)
-        return 0
-    prog = argv[0]
-    if prog not in PROGS:
-        # a close match is almost always a typo: suggest it instead of
-        # dumping the whole table (which still prints when the guess
-        # would be noise)
-        import difflib
-
-        close = difflib.get_close_matches(prog, PROGS, n=1, cutoff=0.6)
-        if close:
-            print(f"unknown subcommand: {prog} — did you mean "
-                  f"{close[0]}?", file=sys.stderr)
-        else:
-            print(f"unknown subcommand: {prog}\n", file=sys.stderr)
-            print(usage(), file=sys.stderr)
-        return 1
-    # GOLEFT_TPU_CPU=1: pin the platform before any backend init — the
-    # escape hatch when the accelerator (or its tunnel) is down. Device-
-    # using commands then bring the backend up HERE, under the hang
-    # watchdog, so a wedged tunnel warns with that knob instead of
-    # hanging silently inside the first jit call.
-    from .utils.device_guard import (
-        devices_with_watchdog, ensure_usable_backend, maybe_force_cpu,
-    )
-
-    maybe_force_cpu()
-    # multi-host world (no-op without GOLEFT_TPU_COORDINATOR): must come
-    # before the watchdog's jax.devices() initializes the XLA backend
-    from .parallel.mesh import init_distributed
-
-    init_distributed()
-    if PROGS[prog][2]:
-        # subprocess-probe first: a wedged tunnel degrades to host mode
-        # with one warning line instead of hanging this process inside
-        # backend bring-up (GOLEFT_TPU_PROBE=0 skips)
-        ensure_usable_backend()
-        devices_with_watchdog()
-    sys.argv = [f"goleft-tpu {prog}"] + argv[1:]
+def _run_command(prog: str, argv: list[str]) -> int:
+    """Dispatch to the subcommand with the historical error contract
+    (exit 0/1/141, see tests/test_cli_dispatch.py)."""
+    sys.argv = [f"goleft-tpu {prog}"] + argv
     try:
-        ret = PROGS[prog][1](argv[1:])
+        ret = PROGS[prog][1](argv)
         # flush INSIDE the guard: when the downstream exits before
         # reading anything (| head -c0), the EPIPE only surfaces at
         # the exit-time flush — which would otherwise print
@@ -153,6 +186,103 @@ def main(argv: list[str] | None = None) -> int:
         print(f"goleft-tpu {prog}: {e}", file=sys.stderr)
         return 1
     return int(ret or 0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # historical contract first: `goleft-tpu -v` is the version, not
+    # verbosity (scripts pin it); -v elsewhere means verbose logging
+    if argv and argv[0] in ("-v", "--version", "version"):
+        print(__version__)
+        return 0
+    try:
+        gopts, argv = _extract_global_flags(argv)
+    except ValueError as e:
+        print(f"goleft-tpu: {e}", file=sys.stderr)
+        return 1
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(usage(), file=sys.stderr)
+        return 0
+    prog = argv[0]
+    if prog not in PROGS:
+        # a close match is almost always a typo: suggest it instead of
+        # dumping the whole table (which still prints when the guess
+        # would be noise)
+        import difflib
+
+        close = difflib.get_close_matches(prog, PROGS, n=1, cutoff=0.6)
+        if close:
+            print(f"unknown subcommand: {prog} — did you mean "
+                  f"{close[0]}?", file=sys.stderr)
+        else:
+            print(f"unknown subcommand: {prog}\n", file=sys.stderr)
+            print(usage(), file=sys.stderr)
+        return 1
+
+    from . import obs
+
+    level = gopts["log_level"] or (
+        "debug" if gopts["verbose"] >= 2
+        else "info" if gopts["verbose"] else "warning")
+    obs.configure_logging(level)
+    if gopts["trace_out"]:
+        # a trace artifact without honest per-dispatch device time is
+        # half an artifact: --trace-out implies device-event fencing
+        obs.set_device_events(True)
+
+    # GOLEFT_TPU_CPU=1: pin the platform before any backend init — the
+    # escape hatch when the accelerator (or its tunnel) is down. Device-
+    # using commands then bring the backend up HERE, under the hang
+    # watchdog, so a wedged tunnel warns with that knob instead of
+    # hanging silently inside the first jit call.
+    from .utils.device_guard import (
+        devices_with_watchdog, ensure_usable_backend, maybe_force_cpu,
+    )
+
+    maybe_force_cpu()
+    # multi-host world (no-op without GOLEFT_TPU_COORDINATOR): must come
+    # before the watchdog's jax.devices() initializes the XLA backend
+    from .parallel.mesh import init_distributed
+
+    init_distributed()
+    if PROGS[prog][2]:
+        # subprocess-probe first: a wedged tunnel degrades to host mode
+        # with one warning line instead of hanging this process inside
+        # backend bring-up (GOLEFT_TPU_PROBE=0 skips)
+        ensure_usable_backend()
+        devices_with_watchdog()
+
+    trace_id = None
+    rc = 1
+    try:
+        with obs.trace(f"run.{prog}", kind="cli",
+                       argv=" ".join(argv[1:])) as root:
+            trace_id = root.trace_id
+            rc = _run_command(prog, argv[1:])
+            root.attrs["exit_code"] = rc
+        return rc
+    finally:
+        # artifacts are written even when the command failed: a failed
+        # run's evidence is the evidence most worth keeping. The CLI
+        # process IS the run, so spans are exported unfiltered (pool
+        # threads included) with the run's trace id recorded alongside.
+        if gopts["trace_out"]:
+            try:
+                obs.get_tracer().write_chrome_trace(gopts["trace_out"])
+            except OSError as e:
+                print(f"goleft-tpu: could not write --trace-out: {e}",
+                      file=sys.stderr)
+        if gopts["metrics_out"]:
+            from .obs.manifest import write_manifest
+
+            try:
+                write_manifest(
+                    gopts["metrics_out"], trace_id=trace_id,
+                    argv=[f"goleft-tpu {prog}"] + argv[1:],
+                    extra={"command": prog, "exit_code": rc})
+            except OSError as e:
+                print(f"goleft-tpu: could not write --metrics-out: "
+                      f"{e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

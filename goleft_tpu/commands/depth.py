@@ -375,12 +375,14 @@ def run_depth(
     call_path = f"{prefix}{suffix}.callable.bed"
     tid_of = {n: i for i, n in enumerate(hdr.ref_names)}
 
+    from ..obs import get_registry
     from ..parallel.scheduler import ResultCache, file_key, run_sharded
     from ..utils.profiling import StageTimer, trace
 
     rc = ResultCache(cache_dir) if cache_dir else None
     fkey = file_key(bam) if cache_dir else bam
     timer = StageTimer()
+    reg = get_registry()
 
     def shard_fn(c, s, e, _fk):
         with timer.stage("host-decode"):
@@ -401,6 +403,7 @@ def run_depth(
             run_sharded(tasks, shard_fn, processes=processes,
                         retries=1, cache=rc, ordered=True),
         ):
+            reg.counter("depth.shards_total").inc()
             if res.error is not None:
                 # reference behavior: failed shard reports in red, others
                 # keep going, nonzero exit at the end
@@ -410,6 +413,7 @@ def run_depth(
                     msg = f"\033[31m{msg}\033[0m"
                 print(msg, file=sys.stderr)
                 n_failed += 1
+                reg.counter("depth.shards_failed_total").inc()
                 continue
             starts, ends, sums, cls = res.value
             with timer.stage("write-output"):

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import glob as _glob
-import logging
 import os
 import re
 import sys
@@ -31,7 +30,9 @@ from ..io.fai import read_fai
 from ..ops import indexcov_ops as ops
 from ..utils import report
 
-log = logging.getLogger("goleft-tpu.indexcov")
+from ..obs.logging import get_logger
+
+log = get_logger("indexcov")
 
 DEFAULT_EXCLUDE = r"^chrEBV$|^NC|_random$|Un_|^HLA\-|_alt$|hap\d$"
 MAX_SAMPLES = 100  # above this, interactive depth plots are skipped

@@ -54,13 +54,17 @@ def main(argv=None) -> int:
                    help="skip the startup backend/compile warm pass")
     a = p.parse_args(argv)
 
+    from .. import obs
     from ..serve.server import ServeApp, make_server
 
+    # the daemon publishes into the process-global registry: its
+    # counters share the namespace the prefetch/caching layers and a
+    # --metrics-out manifest snapshot
     app = ServeApp(batch_window_s=a.batch_window_ms / 1000.0,
                    max_batch=a.max_batch, max_queue=a.max_queue,
                    default_timeout_s=a.timeout_s, cache_dir=a.cache,
                    cache_max_bytes=a.cache_max_bytes,
-                   processes=a.processes)
+                   processes=a.processes, registry=obs.get_registry())
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)

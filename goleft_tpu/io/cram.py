@@ -34,6 +34,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs.logging import get_logger as _get_logger
+
 CRAM_MAGIC = b"CRAM"
 
 # block compression methods
@@ -1445,10 +1447,8 @@ class CramFile:
             # share the handle, so the fill is locked
             with self._cache_lock:
                 if self._all_records is None:
-                    import logging
-
                     if tid is not None:
-                        logging.getLogger("goleft-tpu.cram").warning(
+                        _get_logger("cram").warning(
                             "no .crai alongside CRAM — region queries "
                             "fall back to one full-file decode held in "
                             "memory"

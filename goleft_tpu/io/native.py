@@ -8,14 +8,15 @@ native calls release the GIL, so the shard-decode thread pool scales.
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
 import subprocess
 import threading
 
 import numpy as np
 
-log = logging.getLogger("goleft-tpu.native")
+from ..obs.logging import get_logger
+
+log = get_logger("native")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None

@@ -1,0 +1,148 @@
+"""goleft_tpu.obs — the unified tracing & metrics subsystem.
+
+One observability layer for every execution path (CLI one-shot,
+prefetched cohort, warm serve batch):
+
+  - :mod:`~goleft_tpu.obs.tracing` — run-scoped hierarchical spans
+    with cross-thread propagation + Chrome/Perfetto export
+    (``--trace-out``)
+  - :mod:`~goleft_tpu.obs.metrics` — the process-wide registry of
+    counters/gauges/histograms (``--metrics-out``, serve /metrics)
+  - :mod:`~goleft_tpu.obs.provenance` — the one backend/platform
+    answer the manifest, the device spans and the bench all share
+  - :mod:`~goleft_tpu.obs.manifest` — the per-run evidence document
+  - :mod:`~goleft_tpu.obs.logging` — ``goleft-tpu.*`` logger tree +
+    the CLI's ``--log-level`` config
+
+Import is jax-free and cheap (the CLI touches this before backend
+bring-up); anything needing jax resolves it lazily per call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .logging import configure as configure_logging, get_logger
+from .metrics import (  # noqa: F401 — public API
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, get_registry,
+)
+from .provenance import (  # noqa: F401
+    backend_provenance, device_span_attrs, env_provenance,
+)
+from .tracing import (  # noqa: F401
+    Span, SpanContext, TRACER, Tracer, get_tracer,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "Span", "SpanContext", "TRACER", "Tracer",
+    "backend_provenance", "configure_logging", "capture", "attach",
+    "device_span", "device_span_attrs", "dispatch", "env_provenance",
+    "get_logger", "get_registry", "get_tracer", "span", "trace",
+]
+
+
+# ---- ambient-tracer conveniences (the process tracer) ----
+
+def span(name: str, category: str = "", **attrs):
+    """Context manager: a span on the process tracer."""
+    return TRACER.span(name, category=category, **attrs)
+
+
+def trace(name: str, kind: str = "run", **attrs):
+    """Context manager: a run-scoped root span + fresh trace id."""
+    return TRACER.trace(name, kind=kind, **attrs)
+
+
+def capture() -> "SpanContext":
+    return TRACER.capture()
+
+
+def attach(ctx: "SpanContext | None"):
+    return TRACER.attach(ctx)
+
+
+# ---- device-event instrumentation ----
+
+def device_events_enabled() -> bool:
+    return TRACER.device_events
+
+
+def set_device_events(enabled: bool) -> None:
+    """Turn per-dispatch fencing on/off (the CLI's ``--trace-out``
+    sets it; GOLEFT_TPU_DEVICE_EVENTS=1 preseeds it)."""
+    TRACER.device_events = bool(enabled)
+
+
+def device_span(name: str, **attrs):
+    """A span carrying the backend/platform/device-kind attribute set
+    — for dispatch sites that already synchronize (np.asarray fetches
+    etc.), where no extra fence is needed for the time to be honest."""
+    return TRACER.span(name, category="device",
+                       **device_span_attrs(), **attrs)
+
+
+def _under_jit_trace() -> bool:
+    """True when called during jax tracing (vmap/jit of a wrapped
+    dispatch): instrumenting there would record compile-time as device
+    time and bake a host callback into the program."""
+    try:
+        import jax
+
+        return not jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — jax version drift: stay safe
+        return False
+
+
+def dispatch(name: str, fn, *args, **kwargs):
+    """Run ``fn(*args, **kwargs)`` as an honest device event.
+
+    When device events are off (the default) this is a plain call —
+    async dispatch keeps its pipelining. When on (``--trace-out`` /
+    GOLEFT_TPU_DEVICE_EVENTS=1), the call is wrapped in a span with
+    backend/platform/device-kind attributes and fenced with
+    ``block_until_ready`` so the span's duration is the dispatch's
+    device time, not the microseconds of enqueueing it.
+    """
+    if not TRACER.device_events or _under_jit_trace():
+        return fn(*args, **kwargs)
+    import jax
+
+    with device_span(f"device.{name}", fenced=True):
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+    return out
+
+
+class InstrumentedDispatch:
+    """Transparent proxy over a jitted callable: ``__call__`` routes
+    through :func:`dispatch`; every other attribute (``_cache_size``,
+    ``lower``, …) forwards to the wrapped function, so compile-cache
+    cross-checks and AOT tooling keep working."""
+
+    def __init__(self, fn, name: str):
+        self.__wrapped__ = fn
+        self._obs_name = name
+        self.__name__ = name
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        return dispatch(self._obs_name, self.__wrapped__,
+                        *args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self.__wrapped__, item)
+
+    def __repr__(self):
+        return f"InstrumentedDispatch({self.__wrapped__!r})"
+
+
+@contextlib.contextmanager
+def maybe_span(enabled: bool, name: str, **attrs):
+    """span() when ``enabled``, else a no-op — for call sites whose
+    instrumentation is conditional on a flag they already hold."""
+    if not enabled:
+        yield None
+        return
+    with span(name, **attrs) as sp:
+        yield sp

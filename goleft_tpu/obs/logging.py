@@ -1,0 +1,58 @@
+"""Consistent ``goleft-tpu.*`` logger naming + one CLI-level config.
+
+Every module logs under ``goleft-tpu.<area>`` via :func:`get_logger`
+(the dotted hierarchy hangs off one root, so the CLI's ``--log-level``
+/ ``-v`` flag configures the whole tree at once and third-party
+loggers — jax's included — stay untouched).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+ROOT = "goleft-tpu"
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def get_logger(area: str = "") -> logging.Logger:
+    """``get_logger("serve")`` → the ``goleft-tpu.serve`` logger."""
+    return logging.getLogger(f"{ROOT}.{area}" if area else ROOT)
+
+
+def parse_level(spec: str) -> int:
+    try:
+        return _LEVELS[spec.strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown log level {spec!r} (choose from "
+            f"{'/'.join(_LEVELS)})")
+
+
+def configure(level: int | str = logging.WARNING) -> logging.Logger:
+    """Install (once) a stderr handler with a uniform format on the
+    ``goleft-tpu`` root and set its level. Idempotent: repeat calls
+    only adjust the level, so tests and nested CLI invocations cannot
+    stack handlers."""
+    if isinstance(level, str):
+        level = parse_level(level)
+    root = logging.getLogger(ROOT)
+    if not any(getattr(h, "_goleft_cli", False)
+               for h in root.handlers):
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s",
+            datefmt="%H:%M:%S"))
+        h._goleft_cli = True
+        root.addHandler(h)
+        # propagation stays ON: having a handler here already stops
+        # logging.lastResort from double-printing, and test harnesses
+        # (pytest caplog) capture via root-logger propagation
+    root.setLevel(level)
+    return root

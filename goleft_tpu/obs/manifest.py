@@ -1,0 +1,80 @@
+"""The run manifest: ``--metrics-out run.json``.
+
+One JSON document per invocation tying together what three artifacts
+used to carry separately: the environment it ran in, the backend it
+actually dispatched to (obs/provenance.py — the same fields
+``bench.py`` pins into its device entries), the span totals of where
+the wall clock went, and the full metrics-registry snapshot. The
+bench ingests this file directly instead of re-deriving provenance;
+a run whose manifest says ``"platform": "cpu"`` can never be mistaken
+for device evidence.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+
+from .metrics import MetricsRegistry, get_registry
+from .provenance import backend_provenance, env_provenance
+from .tracing import Tracer, get_tracer
+
+#: keys every manifest must carry — validated by the obs smoke and by
+#: bench-side ingestion (a manifest missing one of these is not run
+#: evidence)
+REQUIRED_KEYS = ("schema", "ts", "argv", "env", "backend", "spans",
+                 "metrics", "trace_id")
+
+SCHEMA = "goleft-tpu.run-manifest/1"
+
+
+def build_manifest(tracer: Tracer | None = None,
+                   registry: MetricsRegistry | None = None,
+                   trace_id: str | None = None,
+                   argv: list[str] | None = None,
+                   extra: dict | None = None) -> dict:
+    tracer = tracer if tracer is not None else get_tracer()
+    registry = registry if registry is not None else get_registry()
+    doc = {
+        "schema": SCHEMA,
+        "ts": datetime.datetime.now(datetime.timezone.utc)
+                .isoformat(timespec="seconds"),
+        "argv": list(argv) if argv is not None else None,
+        "env": env_provenance(),
+        "backend": backend_provenance(),
+        "spans": tracer.summary(trace_id=trace_id),
+        "spans_dropped": tracer.spans_dropped,
+        "metrics": registry.snapshot(),
+        "trace_id": trace_id,
+    }
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def write_manifest(path: str, **kw) -> dict:
+    doc = build_manifest(**kw)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def load_manifest(path: str) -> dict:
+    """Parse + validate a manifest (the bench's ingestion entry): the
+    REQUIRED_KEYS must be present and the backend block must carry
+    either provenance fields or an explicit error."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    missing = [k for k in REQUIRED_KEYS if k not in doc]
+    if missing:
+        raise ValueError(f"manifest {path}: missing keys {missing}")
+    backend = doc["backend"]
+    if "error" not in backend and "platform" not in backend:
+        raise ValueError(
+            f"manifest {path}: backend block has neither platform "
+            "nor error")
+    return doc

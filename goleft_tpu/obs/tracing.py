@@ -1,0 +1,279 @@
+"""Run-scoped hierarchical tracing: the one span model every path uses.
+
+Every CLI invocation and every serve request runs under a *trace* — a
+string id grouping all the spans that invocation caused, across every
+thread it touched. A *span* is one named wall-clock interval with
+attributes and a parent: the CLI's run span parents the shard spans,
+a shard's decode span parents nothing further, the serve batcher's
+batch span parents the executors' decode/compute/format stages.
+
+Design constraints (why this is not a logging framework):
+
+  - recording must be cheap enough for the hot paths that already use
+    ``StageTimer`` (one perf_counter pair + one lock-guarded append);
+  - spans cross threads: the prefetch producers and the serve
+    dispatcher record work on behalf of a consumer/request that lives
+    on another thread, so the ambient context is thread-local but
+    explicitly *portable* (:meth:`Tracer.capture` /
+    :meth:`Tracer.attach`);
+  - the buffer is bounded: a long-lived serve daemon must not grow
+    per-request state, so the span ring drops oldest-first and counts
+    what it dropped (``spans_dropped``);
+  - export is Chrome trace-event JSON (the ``traceEvents`` array
+    format) so ``--trace-out`` artifacts load directly in Perfetto /
+    chrome://tracing next to the XLA profiler's own dumps.
+
+Stdlib-only; jax never imports here (device attributes are the
+caller's business — see obs/provenance.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+# perf_counter gives monotonic durations; the offset maps them onto the
+# epoch so exported timestamps line up across processes (and with the
+# jax profiler's traces, which use epoch-based clocks)
+_EPOCH_OFFSET = time.time() - time.perf_counter()
+
+
+@dataclass
+class Span:
+    """One finished (or in-flight) named interval."""
+
+    name: str
+    span_id: int
+    parent_id: int | None
+    trace_id: str
+    t0: float  # perf_counter seconds
+    t1: float | None = None
+    attrs: dict = field(default_factory=dict)
+    thread_id: int = 0
+    thread_name: str = ""
+    category: str = ""
+
+    def duration(self) -> float:
+        return (self.t1 if self.t1 is not None
+                else time.perf_counter()) - self.t0
+
+
+class _Context(threading.local):
+    """Per-thread ambient state: the active trace id and span stack."""
+
+    def __init__(self):
+        self.trace_id: str | None = None
+        self.stack: list[Span] = []
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """A portable snapshot of (trace, parent span) — what a worker
+    thread attaches to record on behalf of the thread that captured
+    it."""
+
+    trace_id: str | None
+    parent_id: int | None
+
+
+class Tracer:
+    """Process-wide span recorder with a bounded ring buffer.
+
+    One instance (:data:`TRACER`) serves the whole process; tests may
+    build private ones. All methods are thread-safe; the ambient
+    context (current trace + span stack) is thread-local.
+    """
+
+    def __init__(self, max_spans: int = 100_000):
+        self._spans: deque[Span] = deque(maxlen=max_spans)
+        self.spans_dropped = 0
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._trace_ids = itertools.count(1)
+        self._ctx = _Context()
+        # --trace-out / GOLEFT_TPU_DEVICE_EVENTS=1 turn on per-dispatch
+        # device fencing (obs.dispatch): off by default so the async
+        # dispatch pipelines keep their overlap when nobody is looking
+        self.device_events = bool(
+            os.environ.get("GOLEFT_TPU_DEVICE_EVENTS"))
+
+    # ---- trace scoping ----
+
+    def new_trace_id(self, kind: str = "run") -> str:
+        return f"{kind}-{os.getpid()}-{next(self._trace_ids)}"
+
+    @contextlib.contextmanager
+    def trace(self, name: str, kind: str = "run", **attrs):
+        """Run-scoped root: sets this thread's trace id and opens the
+        root span; yields the root :class:`Span` (its ``trace_id`` is
+        the invocation's id)."""
+        prev = self._ctx.trace_id
+        self._ctx.trace_id = self.new_trace_id(kind)
+        try:
+            with self.span(name, **attrs) as root:
+                yield root
+        finally:
+            self._ctx.trace_id = prev
+
+    def current_trace_id(self) -> str | None:
+        return self._ctx.trace_id
+
+    # ---- span recording ----
+
+    @contextlib.contextmanager
+    def span(self, name: str, category: str = "", **attrs):
+        """Open a child of this thread's innermost open span (or a
+        trace root when the stack is empty)."""
+        th = threading.current_thread()
+        parent = self._ctx.stack[-1] if self._ctx.stack else None
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent is not None else None,
+            trace_id=self._ctx.trace_id or f"proc-{os.getpid()}",
+            t0=time.perf_counter(),
+            attrs=dict(attrs) if attrs else {},
+            thread_id=th.ident or 0,
+            thread_name=th.name,
+            category=category,
+        )
+        self._ctx.stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.t1 = time.perf_counter()
+            self._ctx.stack.pop()
+            with self._lock:
+                if len(self._spans) == self._spans.maxlen:
+                    self.spans_dropped += 1
+                self._spans.append(sp)
+
+    # ---- cross-thread propagation ----
+
+    def capture(self) -> SpanContext:
+        """Snapshot this thread's (trace, innermost span) for a worker
+        thread to attach — how prefetch producers and the serve
+        dispatcher parent their spans under the submitting request."""
+        parent = self._ctx.stack[-1] if self._ctx.stack else None
+        return SpanContext(
+            trace_id=self._ctx.trace_id,
+            parent_id=parent.span_id if parent is not None else None)
+
+    @contextlib.contextmanager
+    def attach(self, ctx: SpanContext | None):
+        """Adopt a captured context on the current thread: spans
+        recorded inside parent under ``ctx`` (a synthetic stack entry
+        carries the foreign parent id)."""
+        if ctx is None:
+            yield
+            return
+        prev_trace = self._ctx.trace_id
+        pushed = False
+        if ctx.trace_id is not None:
+            self._ctx.trace_id = ctx.trace_id
+        if ctx.parent_id is not None and not self._ctx.stack:
+            # a placeholder open span carrying only identity: children
+            # parent to it, it is never itself recorded
+            self._ctx.stack.append(Span(
+                name="<attached>", span_id=ctx.parent_id,
+                parent_id=None,
+                trace_id=ctx.trace_id or f"proc-{os.getpid()}",
+                t0=time.perf_counter()))
+            pushed = True
+        try:
+            yield
+        finally:
+            if pushed:
+                self._ctx.stack.pop()
+            self._ctx.trace_id = prev_trace
+
+    # ---- inspection / export ----
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.spans_dropped = 0
+
+    def summary(self, trace_id: str | None = None) -> dict:
+        """{name: {seconds, calls}} totals over the buffered spans —
+        the manifest's spans block (StageTimer.as_dict's shape, so the
+        bench can ingest either)."""
+        out: dict[str, dict] = {}
+        for sp in self.snapshot():
+            if trace_id is not None and sp.trace_id != trace_id:
+                continue
+            rec = out.setdefault(sp.name, {"seconds": 0.0, "calls": 0})
+            rec["seconds"] += sp.duration()
+            rec["calls"] += 1
+        return {k: {"seconds": round(v["seconds"], 4),
+                    "calls": v["calls"]}
+                for k, v in sorted(out.items())}
+
+    def to_chrome_trace(self, trace_id: str | None = None,
+                        epoch_offset: float | None = None) -> dict:
+        """The Chrome trace-event JSON object (Perfetto-loadable).
+
+        Spans become ``ph: "X"`` complete events (ts/dur in
+        microseconds); per-thread ``thread_name`` metadata events name
+        the rows. ``trace_id`` filters to one invocation's spans (a
+        serve daemon's ring holds many); attributes land in ``args``.
+        """
+        off = _EPOCH_OFFSET if epoch_offset is None else epoch_offset
+        pid = os.getpid()
+        events = []
+        threads: dict[int, str] = {}
+        for sp in self.snapshot():
+            if trace_id is not None and sp.trace_id != trace_id:
+                continue
+            threads.setdefault(sp.thread_id, sp.thread_name)
+            args = {"trace_id": sp.trace_id, "span_id": sp.span_id}
+            if sp.parent_id is not None:
+                args["parent_id"] = sp.parent_id
+            args.update(sp.attrs)
+            events.append({
+                "name": sp.name,
+                "cat": sp.category or "span",
+                "ph": "X",
+                "ts": round((sp.t0 + off) * 1e6, 3),
+                "dur": round(sp.duration() * 1e6, 3),
+                "pid": pid,
+                "tid": sp.thread_id,
+                "args": args,
+            })
+        events.sort(key=lambda e: e["ts"])
+        meta = [{
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": nm or f"thread-{tid}"},
+        } for tid, nm in sorted(threads.items())]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "goleft-tpu obs",
+                          "spans_dropped": self.spans_dropped},
+        }
+
+    def write_chrome_trace(self, path: str,
+                           trace_id: str | None = None) -> None:
+        doc = self.to_chrome_trace(trace_id=trace_id)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+
+
+#: the process-wide tracer every module records into
+TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return TRACER

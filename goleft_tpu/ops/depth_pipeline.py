@@ -15,6 +15,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs import InstrumentedDispatch as _InstrumentedDispatch
+
 
 def _pipeline_body(seg_start, seg_end, keep, w0, region_start,
                    region_end, depth_cap, min_cov, max_mean_depth,
@@ -171,3 +173,25 @@ def shard_depth_pipeline_packed(
     return _pipeline_body(s, e, keep, w0, region_start, region_end,
                           depth_cap, min_cov, max_mean_depth, length,
                           window)
+
+
+# Device-event instrumentation: the module's dispatch boundaries are
+# proxies that (only when device events are on — --trace-out /
+# GOLEFT_TPU_DEVICE_EVENTS=1) wrap each call in a span carrying
+# backend/platform/device-kind attributes and fence it with
+# block_until_ready, so per-dispatch device time is honest instead of
+# enqueue-microseconds. Off (the default), a call is a flag check away
+# from the raw jitted function, async dispatch intact. Jit attributes
+# (_cache_size, lower, …) forward through — bench.py's compile-cache
+# cross-check keeps working — and calls made INSIDE a jax trace (the
+# vmapped wrappers in commands/depth.py and commands/cohortdepth.py
+# close over these names) pass straight through untouched.
+shard_depth_pipeline = _InstrumentedDispatch(
+    shard_depth_pipeline, "shard_depth_pipeline")
+shard_depth_pipeline_cls_packed = _InstrumentedDispatch(
+    shard_depth_pipeline_cls_packed, "shard_depth_pipeline_cls_packed")
+shard_depth_pipeline_packed_cls_packed = _InstrumentedDispatch(
+    shard_depth_pipeline_packed_cls_packed,
+    "shard_depth_pipeline_packed_cls_packed")
+shard_depth_pipeline_packed = _InstrumentedDispatch(
+    shard_depth_pipeline_packed, "shard_depth_pipeline_packed")

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.emdepth import em_depth_batch, cn_batch
+from ..obs import InstrumentedDispatch as _InstrumentedDispatch
 from .sharded_coverage import sharded_depth_fn
 
 
@@ -64,7 +65,10 @@ def build_cohort_step(mesh: Mesh, shard_len: int, window: int,
         }
 
     in_shard = NamedSharding(mesh, P("data", "seq"))
-    return jax.jit(step, in_shardings=(in_shard,) * 3)
+    # dispatch boundary: span + block_until_ready fence when device
+    # events are on (obs.dispatch), plain jitted call otherwise
+    return _InstrumentedDispatch(
+        jax.jit(step, in_shardings=(in_shard,) * 3), "cohort_step")
 
 
 def build_chunked_cohort_step(mesh: Mesh, shard_len: int, window: int,
@@ -118,10 +122,12 @@ def build_chunked_cohort_step(mesh: Mesh, shard_len: int, window: int,
         # donation is a no-op (with a warning) on CPU; only ask for it
         # where the runtime can actually alias buffers
         donate = next(iter(mesh.devices.flat)).platform != "cpu"
-    chunk_fn = jax.jit(
+    chunk_fn = _InstrumentedDispatch(jax.jit(
         chunk,
         in_shardings=(in_shard,) * 3 + (carry_shard,),
         donate_argnums=(0, 1, 2) if donate else (),
-    )
-    finalize_fn = jax.jit(finalize, in_shardings=(in_shard,))
+    ), "cohort_chunk")
+    finalize_fn = _InstrumentedDispatch(
+        jax.jit(finalize, in_shardings=(in_shard,)),
+        "cohort_finalize")
     return chunk_fn, finalize_fn, in_shard, carry_shard

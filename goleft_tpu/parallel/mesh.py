@@ -17,8 +17,9 @@ within a slice and DCN across slices.
 
 from __future__ import annotations
 
-import logging
 import os
+
+from ..obs.logging import get_logger as _get_logger
 
 import jax
 import numpy as np
@@ -65,7 +66,7 @@ def make_mesh(n_devices: int | None = None,
             return Mesh(grid, axis_names)
         except Exception as e:  # noqa: BLE001 - virtual/CPU platforms
             if devs[0].platform not in ("cpu",):
-                logging.getLogger("goleft-tpu.mesh").warning(
+                _get_logger("mesh").warning(
                     "topology-aware mesh unavailable (%s); falling back "
                     "to enumeration order — ICI adjacency not guaranteed",
                     e,

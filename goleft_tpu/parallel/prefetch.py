@@ -49,7 +49,16 @@ from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
+from .. import obs
 from ..utils.decode_scaling import auto_processes
+
+
+def _nbytes(value) -> int:
+    """Best-effort byte count of a staged/transferred chunk value
+    (tuples of host or device arrays); 0 for opaque values."""
+    if isinstance(value, (tuple, list)):
+        return sum(int(getattr(a, "nbytes", 0) or 0) for a in value)
+    return int(getattr(value, "nbytes", 0) or 0)
 
 
 class PrefetchCancelled(Exception):
@@ -115,13 +124,29 @@ class ChunkPrefetcher:
         self._pending: deque = deque()  # (index, meta, future), ordered
         self._cancelled = threading.Event()
         self._closed = False
+        # cross-thread trace propagation: workers record their decode/
+        # stage/transfer spans under the CONSUMER's trace and parent
+        # span (captured here, on the constructing thread), so a
+        # --trace-out timeline shows producer work overlapping the
+        # consumer's compute as real same-trace data
+        self._span_ctx = obs.capture()
+        reg = obs.get_registry()
+        self._c_chunks = reg.counter("prefetch.chunks_total")
+        self._c_staged = reg.counter("prefetch.bytes_staged_total")
+        self._c_xfer = reg.counter("prefetch.bytes_transferred_total")
+        self._g_depth = reg.gauge("prefetch.queue_depth")
 
     def _run_one(self, index: int, meta):
         if self._cancelled.is_set():
             raise PrefetchCancelled(index)
-        value = self._produce(meta)
-        if self._transfer is not None and not self._cancelled.is_set():
-            value = self._transfer(value, meta)
+        with obs.attach(self._span_ctx):
+            value = self._produce(meta)
+            self._c_staged.inc(_nbytes(value))
+            if self._transfer is not None \
+                    and not self._cancelled.is_set():
+                value = self._transfer(value, meta)
+                self._c_xfer.inc(_nbytes(value))
+        self._c_chunks.inc()
         return value
 
     def _top_up(self) -> None:
@@ -129,10 +154,13 @@ class ChunkPrefetcher:
             try:
                 index, meta = next(self._meta)
             except StopIteration:
-                return
+                break
             self._pending.append(
                 (index, meta, self._ex.submit(self._run_one, index,
                                               meta)))
+        # decode-pool queue depth: staged chunks in flight beyond the
+        # one being consumed (the registry's live gauge)
+        self._g_depth.set(len(self._pending))
 
     def __iter__(self):
         try:

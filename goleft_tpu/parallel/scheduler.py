@@ -30,6 +30,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Sequence
 
+from ..obs import get_registry
+
 
 @dataclass
 class ShardResult:
@@ -73,6 +75,7 @@ class ResultCache:
         except Exception:
             with self._lock:
                 self.misses += 1
+            get_registry().counter("result_cache.misses_total").inc()
             return None
         try:
             os.utime(p)  # LRU touch: a hit is recent use
@@ -80,6 +83,7 @@ class ResultCache:
             pass  # evicted/replaced underneath us — the value is fine
         with self._lock:
             self.hits += 1
+        get_registry().counter("result_cache.hits_total").inc()
         return val
 
     def put(self, key: tuple, value) -> None:
@@ -106,6 +110,8 @@ class ResultCache:
                 continue  # concurrent eviction/replace
             entries.append((st.st_mtime_ns, st.st_size, n))
         total = sum(s for _, s, _ in entries)
+        evictions = get_registry().counter(
+            "result_cache.evictions_total")
         for _, size, name in sorted(entries):
             if total <= self.max_bytes:
                 break
@@ -114,6 +120,7 @@ class ResultCache:
             except OSError:
                 continue
             total -= size
+            evictions.inc()
 
     def stats(self) -> dict:
         """{hits, misses, entries, bytes} snapshot (entries/bytes scan
@@ -165,22 +172,30 @@ def run_sharded(
     results in completed futures (round-1 VERDICT weak #5).
     """
 
+    # worker spans (the shard fn's decode/compute stages) parent under
+    # the submitting thread's trace — captured once here, attached per
+    # attempt on the pool threads
+    from .. import obs
+
+    span_ctx = obs.capture()
+
     def attempt(task) -> ShardResult:
         key = tuple(task)
-        if cache is not None:
-            hit = cache.get(key)
-            if hit is not None:
-                return ShardResult(key, hit, from_cache=True)
-        err = None
-        for a in range(retries + 1):
-            try:
-                val = fn(*task)
-                if cache is not None:
-                    cache.put(key, val)
-                return ShardResult(key, val, attempts=a + 1)
-            except Exception as e:  # noqa: BLE001 - shard isolation
-                err = e
-        return ShardResult(key, error=err, attempts=retries + 1)
+        with obs.attach(span_ctx):
+            if cache is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    return ShardResult(key, hit, from_cache=True)
+            err = None
+            for a in range(retries + 1):
+                try:
+                    val = fn(*task)
+                    if cache is not None:
+                        cache.put(key, val)
+                    return ShardResult(key, val, attempts=a + 1)
+                except Exception as e:  # noqa: BLE001 - shard isolation
+                    err = e
+            return ShardResult(key, error=err, attempts=retries + 1)
 
     if max_in_flight is None:
         max_in_flight = 2 * max(processes, 1)

@@ -156,6 +156,8 @@ class MicroBatcher:
         return batch
 
     def _loop(self) -> None:
+        from .. import obs
+
         while True:
             batch = self._take_batch()
             if batch is None:
@@ -163,8 +165,16 @@ class MicroBatcher:
             if self.metrics is not None:
                 self.metrics.observe_batch(len(batch))
             try:
-                results = self._run_batch(batch[0].key,
-                                          [it.payload for it in batch])
+                # the dispatcher thread's own trace: one root per
+                # coalesced pass, so the executors' stage spans (which
+                # run on this thread) group under the batch they served
+                key = batch[0].key
+                kind = key[0] if isinstance(key, tuple) and key \
+                    else key
+                with obs.trace(f"batch.{kind}", kind="serve-batch",
+                               batch=len(batch)):
+                    results = self._run_batch(
+                        batch[0].key, [it.payload for it in batch])
                 if len(results) != len(batch):
                     raise RuntimeError(
                         f"executor returned {len(results)} results for "

@@ -36,6 +36,8 @@ from typing import Sequence
 
 import numpy as np
 
+from .. import obs
+
 
 class BadRequest(ValueError):
     """Malformed/unsupported request payload (HTTP 400)."""
@@ -46,6 +48,19 @@ def _stage(metrics, name: str):
     if metrics is None:
         return contextlib.nullcontext()
     return metrics.timer.stage(name)
+
+
+@contextlib.contextmanager
+def _device_stage(metrics, name: str, **attrs):
+    """The executors' dispatch boundary: the shared ``compute`` stage
+    wall-clock PLUS a device-event span carrying backend/platform/
+    device-kind attributes. The wrapped calls fetch their results to
+    host numpy before returning, so the span's extent already fences
+    on the device work — per-dispatch time here is honest without an
+    extra block_until_ready."""
+    with _stage(metrics, "compute"), \
+            obs.device_span(name, **attrs):
+        yield
 
 
 def _require(req: dict, field: str):
@@ -149,7 +164,10 @@ class DepthExecutor:
 
                     with _stage(self.metrics, "decode"):
                         segs = list(ex.map(_dec, opened))
-                    with _stage(self.metrics, "compute"):
+                    with _device_stage(self.metrics,
+                                       "serve.depth.dispatch",
+                                       batch=len(segs),
+                                       region=f"{c}:{s}-{e}"):
                         starts, ends, sums, cls = \
                             engine.run_segments_batch(segs, s, e)
                     if self.metrics:
@@ -236,7 +254,9 @@ class IndexcovExecutor:
             longest = int(lengths.max())
             if longest == 0:
                 continue
-            with _stage(self.metrics, "compute"):
+            with _device_stage(self.metrics,
+                               "serve.indexcov.dispatch",
+                               samples=S, chrom=ref_name):
                 packed = np.asarray(
                     ops.chrom_qc(mat, valid, np.int32(longest)))
             if self.metrics:
@@ -290,6 +310,23 @@ class CohortdepthExecutor:
     def cache_files(self, req: dict) -> list[str]:
         return list(req["bams"])
 
+    def _iter_blocks(self, blocks):
+        """Advance the lazy block generator under the dispatch span:
+        each block's decode + vmapped device pass happens inside
+        ``next()``, so this is the cohortdepth executor's device-event
+        boundary (the values arrive as host numpy — already fenced)."""
+        it = iter(blocks)
+        i = 0
+        while True:
+            with _device_stage(self.metrics,
+                               "serve.cohortdepth.dispatch", block=i):
+                try:
+                    blk = next(it)
+                except StopIteration:
+                    return
+            i += 1
+            yield blk
+
     def run(self, reqs: Sequence[dict]) -> list[dict]:
         from ..commands.cohortdepth import cohort_matrix_blocks
         from ..io import native
@@ -311,7 +348,7 @@ class CohortdepthExecutor:
         for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):
             buf.write("#chrom\tstart\tend\t"
                       + "\t".join(names[lo:hi]) + "\n")
-        for c, starts, ends, vals in blocks:
+        for c, starts, ends, vals in self._iter_blocks(blocks):
             if self.metrics:
                 self.metrics.inc("device_passes_total")
             for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):

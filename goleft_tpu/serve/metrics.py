@@ -1,60 +1,75 @@
-"""Service observability: counters, batch-size histogram, latencies.
+"""Service observability: the serve facade over the unified registry.
 
 One :class:`ServeMetrics` instance is shared by the HTTP handlers, the
 micro-batcher and the executors; ``snapshot()`` is the /metrics
-response body. Stage wall-clocks (decode/compute/format per batch)
-ride the same ``utils.profiling.StageTimer`` the CLI pipelines use, so
-a serve deployment exposes the stage breakdown the bench records.
+response body, and it is generated SOLELY from the unified metrics
+registry (:mod:`goleft_tpu.obs.metrics`) plus the shared StageTimer —
+the daemon no longer keeps bespoke counter dicts. Instruments live
+under the ``serve.`` prefix, so a daemon handed the process-global
+registry (commands/serve.py does) publishes its counters into the same
+namespace the CLI pipelines and the prefetch/caching layers populate,
+while tests constructing :class:`~goleft_tpu.serve.server.ServeApp`
+directly get a private registry and stay isolated.
+
+Stage wall-clocks (decode/compute/format per batch) ride the same
+``utils.profiling.StageTimer`` the CLI pipelines use — now a bounded
+ring (spans_dropped counts evictions; totals/counts are exact
+forever), so a long-lived daemon's per-request state stays bounded.
 """
 
 from __future__ import annotations
 
-import threading
 import time
-from collections import defaultdict, deque
 
-from ..utils.profiling import StageTimer, percentiles
+from ..obs.metrics import MetricsRegistry
+from ..utils.profiling import StageTimer
+
+_PREFIX = "serve."
+_BATCH = "serve.batch_size."
+_LATENCY = "serve.latency_s."
 
 
 class ServeMetrics:
-    def __init__(self, max_latencies: int = 4096):
-        self._lock = threading.Lock()
-        self._counters: dict[str, int] = defaultdict(int)
-        self._batch_sizes: dict[int, int] = defaultdict(int)
-        # bounded: long-lived daemons must not grow per-request state
-        self._latencies: dict[str, deque] = defaultdict(
-            lambda: deque(maxlen=max_latencies))
+    def __init__(self, max_latencies: int = 4096,
+                 registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._max_latencies = max_latencies
         self.timer = StageTimer()
         self.started = time.time()
 
     def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] += n
+        self.registry.counter(_PREFIX + name).inc(n)
 
     def observe_batch(self, size: int) -> None:
-        with self._lock:
-            self._counters["batches_total"] += 1
-            self._counters["batched_requests_total"] += size
-            self._batch_sizes[size] += 1
+        self.registry.counter(_PREFIX + "batches_total").inc()
+        self.registry.counter(
+            _PREFIX + "batched_requests_total").inc(size)
+        self.registry.counter(f"{_BATCH}{size}").inc()
 
     def observe_latency(self, endpoint: str, seconds: float) -> None:
-        with self._lock:
-            self._latencies[endpoint].append(seconds)
+        self.registry.histogram(_LATENCY + endpoint,
+                                self._max_latencies).observe(seconds)
 
     def snapshot(self, queue_depth: int | None = None,
                  cache_stats: dict | None = None) -> dict:
-        with self._lock:
-            counters = dict(self._counters)
-            hist = {str(k): v
-                    for k, v in sorted(self._batch_sizes.items())}
-            lat = {ep: percentiles(vals)
-                   for ep, vals in self._latencies.items()}
+        counters = {
+            n: v for n, v in self.registry.counters(_PREFIX).items()
+            if not n.startswith("batch_size.")
+            and not n.startswith("latency_s.")
+        }
+        hist = {
+            str(size): v for size, v in sorted(
+                (int(n), v)
+                for n, v in self.registry.counters(_BATCH).items())
+        }
         out = {
             "uptime_s": round(time.time() - self.started, 1),
             "counters": counters,
             "batch_size_hist": hist,
-            "latency_s": lat,
+            "latency_s": self.registry.histograms(_LATENCY),
             "stage_seconds": self.timer.as_dict(),
+            "stage_spans_dropped": self.timer.spans_dropped,
         }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth

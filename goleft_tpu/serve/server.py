@@ -31,7 +31,6 @@ Routes:
 from __future__ import annotations
 
 import json
-import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -44,7 +43,9 @@ from .executors import (
 )
 from .metrics import ServeMetrics
 
-log = logging.getLogger("goleft-tpu.serve")
+from ..obs.logging import get_logger
+
+log = get_logger("serve")
 
 
 class ServeApp:
@@ -57,8 +58,11 @@ class ServeApp:
                  default_timeout_s: float = 120.0,
                  cache_dir: str | None = None,
                  cache_max_bytes: int | None = 256 * 1024 * 1024,
-                 processes: int = 4):
-        self.metrics = ServeMetrics()
+                 processes: int = 4, registry=None):
+        # registry=None → a private obs.MetricsRegistry (test/app
+        # isolation); the serve CLI passes the process-global one so
+        # the daemon's counters join the unified namespace
+        self.metrics = ServeMetrics(registry=registry)
         self.default_timeout_s = default_timeout_s
         self.executors = {
             ex.kind: ex for ex in (
@@ -98,7 +102,18 @@ class ServeApp:
         return (kind, params, files)
 
     def handle(self, kind: str, req: dict) -> tuple[int, dict]:
-        """One request → (http status, response dict)."""
+        """One request → (http status, response dict). Runs under its
+        own run-scoped trace: every serve request gets a trace id, and
+        the spans its handler thread records (cache lookup, batcher
+        wait) parent under the request root."""
+        from .. import obs
+
+        with obs.trace(f"request.{kind}", kind="serve") as root:
+            code, body = self._handle(kind, req)
+            root.attrs["status"] = code
+        return code, body
+
+    def _handle(self, kind: str, req: dict) -> tuple[int, dict]:
         ex = self.executors.get(kind)
         if ex is None:
             return 404, {"error": f"unknown endpoint {kind!r}"}

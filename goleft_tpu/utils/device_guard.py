@@ -16,11 +16,12 @@ backend bring-up with no way out. Two mechanisms:
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 
-log = logging.getLogger("goleft-tpu.device")
+from ..obs.logging import get_logger
+
+log = get_logger("device")
 
 def _watchdog_seconds() -> float:
     raw = os.environ.get("GOLEFT_TPU_DEVICE_WATCHDOG_SECONDS", "30")

@@ -5,17 +5,26 @@ the cnveval CLI (cnveval/cmd/cnveval/cnveval.go:41-46, SURVEY.md §5); the
 TPU rebuild gets first-class hooks: a ``trace(dir)`` context manager
 around any pipeline (view with TensorBoard / xprof) and a ``StageTimer``
 whose report shows where host decode vs device compute time goes.
+
+``StageTimer`` is now a compatibility shim over the unified tracing
+subsystem (:mod:`goleft_tpu.obs`): every ``stage`` use still feeds the
+local totals/counts/spans this module always kept, AND records a real
+hierarchical span on the process tracer — so a ``--trace-out`` run
+shows the same stages on the Perfetto timeline that ``--profile``
+logs as totals, in the right parent/thread rows.
 """
 
 from __future__ import annotations
 
 import contextlib
-import logging
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
-log = logging.getLogger("goleft-tpu.profile")
+from ..obs import get_tracer
+from ..obs.logging import get_logger
+
+log = get_logger("profile")
 
 
 @contextlib.contextmanager
@@ -38,26 +47,39 @@ class StageTimer:
     decode-pool worker threads concurrently with the consumer's compute
     spans. Every ``stage`` use also appends a ``(name, t0, t1)`` span
     (perf_counter seconds) so overlap between stages can be measured,
-    not just per-stage totals.
+    not just per-stage totals — and mirrors the same interval onto the
+    process tracer (:mod:`goleft_tpu.obs`), where it lands under the
+    caller's current trace/span context.
+
+    The span list is a RING: a long-lived holder (the serve daemon
+    keeps one timer for its whole life) retains only the most recent
+    ``max_spans`` intervals, counting evictions in ``spans_dropped``.
+    ``totals``/``counts`` are unaffected by the bound — they accumulate
+    forever — and ``wall()`` measures the retained window's extent.
     """
 
-    def __init__(self):
+    def __init__(self, max_spans: int = 8192):
         self.totals: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
-        self.spans: list[tuple[str, float, float]] = []
+        self.spans: deque[tuple[str, float, float]] = \
+            deque(maxlen=max_spans)
+        self.spans_dropped = 0
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            t1 = time.perf_counter()
-            with self._lock:
-                self.totals[name] += t1 - t0
-                self.counts[name] += 1
-                self.spans.append((name, t0, t1))
+        with get_tracer().span(name, category="stage"):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                t1 = time.perf_counter()
+                with self._lock:
+                    self.totals[name] += t1 - t0
+                    self.counts[name] += 1
+                    if len(self.spans) == self.spans.maxlen:
+                        self.spans_dropped += 1
+                    self.spans.append((name, t0, t1))
 
     def as_dict(self, ndigits: int = 4) -> dict:
         """{stage: {"seconds", "calls"}} snapshot for bench artifacts."""
@@ -72,7 +94,7 @@ class StageTimer:
 
     def wall(self) -> float:
         """Span-extent wall clock: last span end minus first span start
-        (0.0 when nothing was recorded)."""
+        over the RETAINED ring (0.0 when nothing was recorded)."""
         with self._lock:
             if not self.spans:
                 return 0.0
@@ -93,10 +115,11 @@ class StageTimer:
             log.info("%s", line)
 
 
-def percentiles(values, qs=(50, 95), ndigits: int = 4) -> dict:
-    """{"p50": ..., "p95": ..., "count": n} nearest-rank percentiles
-    over a sequence of seconds — the latency summary the serve daemon's
-    /metrics endpoint and the bench's serve_throughput entry share.
+def percentiles(values, qs=(50, 95, 99), ndigits: int = 4) -> dict:
+    """{"p50": ..., "p95": ..., "p99": ..., "max": ..., "count": n}
+    nearest-rank percentiles over a sequence of seconds — the latency
+    summary the serve daemon's /metrics endpoint, the obs registry's
+    histograms and the bench's serve_throughput entry all share.
     Empty input returns {"count": 0} (no fabricated zeros)."""
     vals = sorted(float(v) for v in values)
     out: dict = {"count": len(vals)}
@@ -107,6 +130,7 @@ def percentiles(values, qs=(50, 95), ndigits: int = 4) -> dict:
     for q in qs:
         rank = max(1, min(len(vals), math.ceil(q / 100.0 * len(vals))))
         out[f"p{q:g}"] = round(vals[rank - 1], ndigits)
+    out["max"] = round(vals[-1], ndigits)
     return out
 
 

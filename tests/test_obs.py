@@ -1,0 +1,489 @@
+"""Unified tracing & metrics subsystem (goleft_tpu.obs).
+
+Pins the PR-3 observability contracts: the Perfetto/Chrome trace-event
+export schema (golden-file round-trip — a schema drift breaks loading
+in Perfetto silently, so the exact normalized shape is committed),
+concurrent cross-thread span recording under the prefetch pool,
+metrics-registry snapshot determinism, the serve daemon's /metrics
+being derived solely from the unified registry (byte-for-byte), the
+bounded StageTimer ring, p99/max percentiles, the run manifest schema,
+and the CLI's global --trace-out/--metrics-out/--log-level/-v flags.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from goleft_tpu import obs
+from goleft_tpu.obs.manifest import (
+    REQUIRED_KEYS, build_manifest, load_manifest,
+)
+from goleft_tpu.obs.metrics import MetricsRegistry
+from goleft_tpu.obs.tracing import Tracer
+from helpers import write_bam_and_bai, random_reads
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "golden", "obs_trace_golden.json")
+
+
+# ---------------- trace export: golden-file round-trip ----------------
+
+
+def _golden_span_script(tracer: Tracer) -> None:
+    """The fixed span scenario the golden file pins: a CLI-style root,
+    two sequential stages (one carrying device attrs), and one span
+    recorded from a worker thread under an attached context."""
+    with tracer.trace("run.golden", kind="cli", argv="golden") as root:
+        assert root.trace_id.startswith("cli-")
+        with tracer.span("decode", category="stage", shard=0):
+            pass
+        with tracer.span("compute", category="device",
+                         platform="cpu", fenced=True):
+            pass
+        ctx = tracer.capture()
+
+        def worker():
+            with tracer.attach(ctx):
+                with tracer.span("stage", category="stage"):
+                    pass
+
+        t = threading.Thread(target=worker, name="goleft-prefetch-0")
+        t.start()
+        t.join(timeout=30)
+
+
+def _normalize(doc: dict) -> dict:
+    """Strip the volatile fields (timestamps, pids, tids, id values)
+    while preserving the schema AND the id topology (which span
+    parents which, which spans share a thread/trace)."""
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    tid_map: dict = {}
+    span_map: dict = {}
+    for e in xs:
+        tid_map.setdefault(e["tid"], f"T{len(tid_map)}")
+        span_map.setdefault(e["args"]["span_id"],
+                            f"S{len(span_map)}")
+    events = []
+    for e in xs:
+        args = dict(e["args"])
+        args["span_id"] = span_map[args["span_id"]]
+        if "parent_id" in args:
+            args["parent_id"] = span_map[args["parent_id"]]
+        args["trace_id"] = "TRACE"
+        events.append({
+            "name": e["name"], "cat": e["cat"], "ph": "X",
+            "ts": 0, "dur": 0, "pid": "PID",
+            "tid": tid_map[e["tid"]], "args": args,
+        })
+    meta = [
+        {"name": "thread_name", "ph": "M", "pid": "PID", "tid": t}
+        for t in sorted(set(tid_map.values()))
+    ]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": doc["displayTimeUnit"],
+            "otherData": {
+                "producer": doc["otherData"]["producer"],
+                "spans_dropped": doc["otherData"]["spans_dropped"],
+            }}
+
+
+def test_perfetto_export_schema_matches_golden():
+    tracer = Tracer()
+    _golden_span_script(tracer)
+    got = _normalize(tracer.to_chrome_trace())
+    with open(GOLDEN) as fh:
+        want = json.load(fh)
+    assert got == want, (
+        "Chrome trace-event export schema drifted from the golden "
+        "file — if intentional, regenerate tests/golden/"
+        "obs_trace_golden.json (see this test's module docstring)")
+
+
+def test_perfetto_export_round_trips_and_validates(tmp_path):
+    from goleft_tpu.obs.smoke import validate_trace
+
+    tracer = Tracer()
+    _golden_span_script(tracer)
+    p = str(tmp_path / "t.json")
+    tracer.write_chrome_trace(p)
+    doc = validate_trace(p)  # the smoke's schema checks
+    # round-trip: export → parse → same normalized document
+    assert _normalize(doc) == _normalize(tracer.to_chrome_trace())
+    # the cross-thread span parents under the captured root span
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    root = by_name["run.golden"]
+    stage = by_name["stage"]
+    assert stage["args"]["parent_id"] == root["args"]["span_id"]
+    assert stage["args"]["trace_id"] == root["args"]["trace_id"]
+    assert stage["tid"] != root["tid"]  # genuinely another thread
+    assert by_name["compute"]["args"]["platform"] == "cpu"
+
+
+# ---------------- cross-thread recording under the prefetch pool ----
+
+
+def test_concurrent_spans_under_prefetch_pool():
+    """Producer-thread spans land on the shared tracer under the
+    consumer's trace, completely and race-free, while the consumer
+    records its own compute spans concurrently."""
+    from goleft_tpu.parallel.prefetch import ChunkPrefetcher
+    from goleft_tpu.utils.profiling import StageTimer
+
+    tracer = obs.get_tracer()
+    timer = StageTimer()
+    n = 24
+
+    def produce(i):
+        with timer.stage("decode"):
+            return i * 2
+
+    with obs.trace("run.prefetch-test", kind="cli") as root:
+        trace_id = root.trace_id
+        got = []
+        with ChunkPrefetcher(range(n), produce, depth=4,
+                             processes=4) as pf:
+            for ch in pf:
+                with timer.stage("compute"):
+                    got.append(ch.value)
+    assert got == [i * 2 for i in range(n)]
+    assert timer.counts["decode"] == n
+    assert timer.counts["compute"] == n
+    mine = [sp for sp in tracer.snapshot()
+            if sp.trace_id == trace_id]
+    by_name = {}
+    for sp in mine:
+        by_name.setdefault(sp.name, []).append(sp)
+    assert len(by_name["decode"]) == n
+    assert len(by_name["compute"]) == n
+    # decode spans really ran on pool threads, attached to the
+    # consumer's trace and parented under its root
+    root_sp = by_name["run.prefetch-test"][0]
+    consumer_tid = root_sp.thread_id
+    assert all(sp.parent_id == root_sp.span_id
+               for sp in by_name["decode"])
+    assert any(sp.thread_id != consumer_tid
+               for sp in by_name["decode"])
+    # prefetch populated the unified registry
+    snap = obs.get_registry().snapshot()
+    assert snap["counters"]["prefetch.chunks_total"] >= n
+
+
+# ---------------- registry snapshot determinism ----------------
+
+
+def _populate(reg: MetricsRegistry, order):
+    for name in order:
+        reg.counter(f"c.{name}").inc(ord(name[0]))
+    reg.gauge("g.depth").set(3)
+    for v in (0.1, 0.2, 0.3):
+        reg.histogram("h.lat").observe(v)
+
+
+def test_registry_snapshot_deterministic():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    _populate(a, ["x", "y", "z"])
+    _populate(b, ["z", "x", "y"])  # creation order must not matter
+    assert json.dumps(a.snapshot()) == json.dumps(b.snapshot())
+    # and a re-snapshot of unchanged state is byte-identical
+    assert json.dumps(a.snapshot()) == json.dumps(a.snapshot())
+    snap = a.snapshot()
+    assert snap["counters"]["c.x"] == ord("x")
+    assert snap["histograms"]["h.lat"]["count"] == 3
+    assert snap["histograms"]["h.lat"]["max"] == 0.3
+
+
+def test_histogram_count_outlives_window():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", maxlen=4)
+    for i in range(10):
+        h.observe(i)
+    s = h.summary()
+    assert s["count"] == 10       # all-time
+    assert s["max"] == 9.0        # window holds the recent 6,7,8,9
+    assert s["p50"] >= 6.0
+
+
+# ---------------- serve /metrics: solely the unified registry -------
+
+
+def test_serve_metrics_snapshot_is_registry_derived_byte_for_byte():
+    """Rebuild the /metrics body from NOTHING but the public registry
+    API (+ the shared StageTimer and start time) and require the
+    daemon's own snapshot to serialize byte-identically — proving no
+    bespoke counter state is left."""
+    from goleft_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    m.inc("requests_total.depth")
+    m.inc("requests_total.depth")
+    m.inc("device_passes_total", 3)
+    m.observe_batch(4)
+    m.observe_batch(4)
+    m.observe_batch(1)
+    m.observe_latency("depth", 0.25)
+    m.observe_latency("indexcov", 0.5)
+    with m.timer.stage("compute"):
+        pass
+
+    got = m.snapshot(queue_depth=2, cache_stats={"hits": 1})
+
+    reg = m.registry
+    counters = {n: v for n, v in reg.counters("serve.").items()
+                if not n.startswith(("batch_size.", "latency_s."))}
+    rebuilt = {
+        "uptime_s": got["uptime_s"],  # wall clock, not metric state
+        "counters": counters,
+        "batch_size_hist": {
+            str(k): v for k, v in sorted(
+                (int(n), v) for n, v in
+                reg.counters("serve.batch_size.").items())},
+        "latency_s": reg.histograms("serve.latency_s."),
+        "stage_seconds": m.timer.as_dict(),
+        "stage_spans_dropped": m.timer.spans_dropped,
+        "queue_depth": 2,
+        "cache": {"hits": 1},
+    }
+    assert json.dumps(got) == json.dumps(rebuilt)
+    # legacy shape intact: the serve tests' key contract
+    assert got["batch_size_hist"] == {"1": 1, "4": 2}
+    assert got["counters"]["batched_requests_total"] == 9
+    lat = got["latency_s"]["depth"]
+    assert lat["count"] == 1 and "p99" in lat and "max" in lat
+
+
+def test_serve_app_uses_private_registry_by_default():
+    from goleft_tpu.serve.server import ServeApp
+
+    app = ServeApp(batch_window_s=0.0, max_batch=1)
+    try:
+        assert app.metrics.registry is not obs.get_registry()
+    finally:
+        app.close()
+
+
+# ---------------- StageTimer ring + percentiles ----------------
+
+
+def test_stagetimer_ring_bounds_spans_not_totals():
+    from goleft_tpu.utils.profiling import StageTimer
+
+    tm = StageTimer(max_spans=4)
+    for _ in range(10):
+        with tm.stage("s"):
+            pass
+    assert len(tm.spans) == 4
+    assert tm.spans_dropped == 6
+    assert tm.counts["s"] == 10           # totals/counts unaffected
+    assert tm.as_dict()["s"]["calls"] == 10
+    assert tm.wall() > 0.0
+
+
+def test_percentiles_include_p99_and_max():
+    from goleft_tpu.utils.profiling import percentiles
+
+    vals = [i / 100.0 for i in range(1, 101)]
+    out = percentiles(vals)
+    assert out["p50"] == 0.5
+    assert out["p95"] == 0.95
+    assert out["p99"] == 0.99
+    assert out["max"] == 1.0
+    assert percentiles([]) == {"count": 0}
+
+
+# ---------------- device events ----------------
+
+
+def test_instrumented_dispatch_records_fenced_device_span():
+    from goleft_tpu.ops import depth_pipeline as dp
+
+    i32 = np.int32
+    seg = np.zeros(64, np.int32)
+    keep = np.zeros(64, bool)
+    args = (seg, seg, keep, i32(0), i32(0), i32(256), i32(2500),
+            i32(4), i32(0))
+    tracer = obs.get_tracer()
+    obs.set_device_events(True)
+    try:
+        dp.shard_depth_pipeline_cls_packed(*args, length=256,
+                                           window=256)
+        spans = [sp for sp in tracer.snapshot()
+                 if sp.name ==
+                 "device.shard_depth_pipeline_cls_packed"]
+        assert spans, "no device-event span recorded"
+        sp = spans[-1]
+        assert sp.attrs["fenced"] is True
+        assert sp.attrs["platform"] == "cpu"
+        assert "device_kind" in sp.attrs
+        # the vmapped wrapper traces the SAME proxied fn inside jit:
+        # the trace-state guard must keep instrumentation out of the
+        # traced program (this would raise otherwise)
+        from goleft_tpu.commands.depth import _batched_cls_packed
+
+        out = _batched_cls_packed()(
+            seg[None], seg[None], keep[None], i32(0), i32(0),
+            i32(256), i32(2500), i32(4), i32(0),
+            length=256, window=256)
+        assert np.asarray(out[0]).shape[0] == 1
+    finally:
+        obs.set_device_events(False)
+    # off again: a call must not add device spans
+    n0 = sum(1 for sp in tracer.snapshot()
+             if sp.name == "device.shard_depth_pipeline_cls_packed")
+    dp.shard_depth_pipeline_cls_packed(*args, length=256, window=256)
+    n1 = sum(1 for sp in tracer.snapshot()
+             if sp.name == "device.shard_depth_pipeline_cls_packed")
+    assert n1 == n0
+
+
+def test_instrumented_dispatch_forwards_jit_attrs():
+    from goleft_tpu.ops import depth_pipeline as dp
+
+    # bench.py's compile-cache cross-check depends on these resolving
+    assert isinstance(dp.shard_depth_pipeline._cache_size(), int)
+    assert dp.shard_depth_pipeline.__name__ == "shard_depth_pipeline"
+
+
+# ---------------- manifest ----------------
+
+
+def test_manifest_schema_and_load(tmp_path):
+    from goleft_tpu.obs.manifest import write_manifest
+
+    reg = MetricsRegistry()
+    reg.counter("x.total").inc(2)
+    tracer = Tracer()
+    with tracer.trace("run.m", kind="cli"):
+        pass
+    p = str(tmp_path / "run.json")
+    doc = write_manifest(p, tracer=tracer, registry=reg,
+                         argv=["goleft-tpu m"],
+                         extra={"command": "m", "exit_code": 0})
+    for k in REQUIRED_KEYS:
+        assert k in doc
+    loaded = load_manifest(p)
+    assert loaded["metrics"]["counters"]["x.total"] == 2
+    assert loaded["spans"]["run.m"]["calls"] == 1
+    assert loaded["command"] == "m" and loaded["exit_code"] == 0
+    # backend provenance carries the same platform bench.py records
+    assert loaded["backend"].get("platform") == "cpu"
+    assert "device_kind" in loaded["backend"]
+    # a manifest missing required keys must not load
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"schema": "x"}, fh)
+    with pytest.raises(ValueError, match="missing keys"):
+        load_manifest(bad)
+
+
+def test_manifest_provenance_matches_bench():
+    import bench
+
+    doc = build_manifest(tracer=Tracer(), registry=MetricsRegistry())
+    bp = bench._backend_provenance()
+    assert bp["platform"] == doc["backend"]["platform"]
+    assert bp["device_kind"] == doc["backend"]["device_kind"]
+    assert bp["device"] == doc["backend"]["device"]
+
+
+# ---------------- CLI global flags ----------------
+
+
+def test_extract_global_flags_anywhere():
+    from goleft_tpu.cli import _extract_global_flags
+
+    opts, rest = _extract_global_flags(
+        ["--trace-out", "t.json", "depth", "--metrics-out=m.json",
+         "-v", "--prefix", "o", "x.bam"])
+    assert opts["trace_out"] == "t.json"
+    assert opts["metrics_out"] == "m.json"
+    assert opts["verbose"] == 1
+    assert rest == ["depth", "--prefix", "o", "x.bam"]
+    with pytest.raises(ValueError, match="needs a value"):
+        _extract_global_flags(["depth", "--trace-out"])
+    with pytest.raises(ValueError, match="unknown log level"):
+        _extract_global_flags(["--log-level", "loud"])
+
+
+def test_cli_version_dash_v_still_wins(capsys):
+    from goleft_tpu.cli import main as cli_main
+
+    assert cli_main(["-v"]) == 0  # historical: version, not verbosity
+    out = capsys.readouterr().out
+    assert out.strip()  # printed a version string
+
+
+def test_cli_bad_log_level_exits_one(capsys):
+    from goleft_tpu.cli import main as cli_main
+
+    assert cli_main(["--log-level", "loud", "samplename", "x"]) == 1
+    assert "unknown log level" in capsys.readouterr().err
+
+
+def test_configure_logging_idempotent():
+    import logging
+
+    obs.configure_logging("info")
+    obs.configure_logging("debug")
+    root = logging.getLogger("goleft-tpu")
+    assert sum(1 for h in root.handlers
+               if getattr(h, "_goleft_cli", False)) == 1
+    assert root.level == logging.DEBUG
+    assert obs.get_logger("serve").name == "goleft-tpu.serve"
+    obs.configure_logging("warning")  # restore the default
+
+
+# ---------------- CLI end-to-end: depth --trace-out --metrics-out ---
+
+
+def test_depth_cli_writes_trace_and_manifest(tmp_path, monkeypatch):
+    """Acceptance: `goleft-tpu depth --trace-out t.json --metrics-out
+    m.json` produces a valid Chrome-trace-event file and a manifest
+    whose backend provenance matches what bench.py records."""
+    import bench
+
+    from goleft_tpu.cli import main as cli_main
+    from goleft_tpu.obs.smoke import validate_trace
+
+    monkeypatch.setenv("GOLEFT_TPU_PROBE", "0")
+    rng = np.random.default_rng(5)
+    ref_len = 20_000
+    bam = str(tmp_path / "t.bam")
+    write_bam_and_bai(bam, random_reads(rng, 300, 0, ref_len,
+                                        mapq_lo=20),
+                      ref_names=("chr1",), ref_lens=(ref_len,),
+                      header_text="@HD\tVN:1.6\tSO:coordinate\n"
+                                  f"@SQ\tSN:chr1\tLN:{ref_len}\n"
+                                  "@RG\tID:r\tSM:s1\n")
+    with open(tmp_path / "ref.fa.fai", "w") as fh:
+        fh.write(f"chr1\t{ref_len}\t6\t60\t61\n")
+    t_out = str(tmp_path / "t.json")
+    m_out = str(tmp_path / "m.json")
+    rc = cli_main(["depth", "--trace-out", t_out, "--metrics-out",
+                   m_out, "--prefix", str(tmp_path / "out"),
+                   "-r", str(tmp_path / "ref.fa"), bam])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "out.depth.bed"))
+
+    doc = validate_trace(t_out)
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"run.depth", "host-decode", "device-compute",
+            "write-output"} <= names
+    # --trace-out turned device events on: fenced dispatch spans with
+    # backend attrs are in the timeline
+    dev = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+           and e["name"].startswith("device.shard_depth_pipeline")]
+    assert dev and all(e["args"]["platform"] == "cpu" for e in dev)
+
+    man = load_manifest(m_out)
+    assert man["command"] == "depth" and man["exit_code"] == 0
+    assert man["trace_id"] and man["trace_id"].startswith("cli-")
+    assert "host-decode" in man["spans"]
+    assert man["metrics"]["counters"]["depth.shards_total"] >= 1
+    bp = bench._backend_provenance()
+    assert man["backend"]["platform"] == bp["platform"]
+    assert man["backend"]["device_kind"] == bp["device_kind"]
