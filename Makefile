@@ -6,7 +6,7 @@ CXX ?= g++
 SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
-        serve-smoke obs-smoke clean
+        serve-smoke obs-smoke perf-gate clean
 
 native: build/libgoleftio.so
 
@@ -29,11 +29,23 @@ test:
 	python -m pytest tests/ -q
 
 # serve daemon end-to-end: start on an ephemeral port, one depth
-# request through the client, clean SIGTERM drain, exit 0. Pinned to
-# the host platform inside (CI has no accelerator); whole run bounded
-# by the smoke's own 120s deadline.
+# request through the client, validate the observability surface
+# (/metrics SLO block + Prometheus encoding, /debug/flight span
+# trees, a SIGUSR1 flight dump that parses), clean SIGTERM drain,
+# exit 0. Pinned to the host platform inside (CI has no accelerator);
+# whole run bounded by the smoke's own 120s deadline.
 serve-smoke:
 	python -m goleft_tpu.serve.smoke
+
+# the regression gate over the committed bench history: normalize
+# BENCH_r*.json + BENCH_lastgood.json into PERF_LEDGER.jsonl
+# (idempotent append), then fail on any provenance-matched regression.
+# Stale device carryover is flagged (a warning); add --strict to turn
+# the device-evidence gap itself into a failure once the tunnel is
+# expected to be up.
+perf-gate:
+	python -m goleft_tpu perf ingest
+	python -m goleft_tpu perf check
 
 # observability end-to-end: a real depth invocation with --trace-out +
 # --metrics-out on a fabricated fixture, then schema-validate both

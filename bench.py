@@ -1291,6 +1291,33 @@ def _timed(fn, *a, **kw) -> float:
 _PINNED_BASELINE_PATH = "BASELINE_PINNED.json"
 
 
+def _append_perf_ledger(headline: dict | None) -> None:
+    """Auto-append this completed run's entries (BENCH_details.json as
+    just merged) + headline to PERF_LEDGER.jsonl as a ``live-<ts>``
+    round, so every bench run lands in the longitudinal ledger without
+    a separate ingest step. GOLEFT_BENCH_NO_LEDGER=1 disables (CI jobs
+    benchmarking throwaway trees); failure never fails the bench."""
+    import os
+
+    if os.environ.get("GOLEFT_BENCH_NO_LEDGER"):
+        return
+    try:
+        from goleft_tpu.obs import ledger as _ledger
+
+        try:
+            with open("BENCH_details.json") as fh:
+                details = json.load(fh)
+        except (OSError, ValueError):
+            details = {}
+        recs = _ledger.live_run_records(details, headline)
+        _ledger.append_records(_ledger.DEFAULT_LEDGER, recs)
+        print(f"bench: appended {len(recs)} record(s) to "
+              f"{_ledger.DEFAULT_LEDGER}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — ledger is best-effort
+        print(f"bench: perf-ledger append failed: {e!r}",
+              file=sys.stderr)
+
+
 def _pin_baseline_main():
     """``--pin-baseline``: measure the single-core numpy baseline as
     the median of 9 runs on the exact non-quick cohort workload and
@@ -1853,14 +1880,14 @@ def main(argv=None):
                                 "kernel_device_resident"
                                 "_gbases_per_sec"),
                     }
-            if host_headline is not None:
-                print(json.dumps(host_headline))
-            else:
-                print(json.dumps({
+            if host_headline is None:
+                host_headline = {
                     "metric": "cohort_depth_e2e_gbases_per_sec",
                     "value": 0.0, "unit": "Gbases/s", "vs_baseline": 0.0,
                     "error": "device unusable and host fallback failed",
-                }))
+                }
+            print(json.dumps(host_headline))
+            _append_perf_ledger(host_headline)
             return
 
     # device phase — the FULL device portfolio runs before any host
@@ -1903,7 +1930,7 @@ def main(argv=None):
         host_suite(quick, emit=_merge_details)
 
     base_v, base_info = _baseline_block(cohort)
-    print(json.dumps({
+    headline = {
         "metric": "cohort_depth_e2e_gbases_per_sec",
         "value": cohort["gbases_per_sec"],
         "unit": "Gbases/s",
@@ -1915,7 +1942,9 @@ def main(argv=None):
                         "wall_seconds_warm", "stage_seconds")},
             **kern,
         },
-    }))
+    }
+    print(json.dumps(headline))
+    _append_perf_ledger(headline)
 
 
 if __name__ == "__main__":

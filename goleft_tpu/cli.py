@@ -71,6 +71,8 @@ PROGS = {
               _lazy(".commands.bench_cmd"), False),
     "anonymize": ("make shareable header-only bam+bai fixtures",
                   _lazy(".commands.anonymize"), False),
+    "perf": ("perf ledger: ingest bench history, trend report, "
+             "regression gate", _lazy(".commands.perf"), False),
     "cohortdepth": ("depth matrix for many bams in one device pass",
                     _lazy(".commands.cohortdepth"), True),
     "cnv": ("CNV calls straight from bams (cohort depth + EM)",

@@ -1,0 +1,159 @@
+"""perf: the performance ledger & regression gate CLI.
+
+Three verbs over the longitudinal perf evidence (obs/ledger.py +
+obs/sentinel.py):
+
+  perf ingest   normalize BENCH_r*.json / BENCH_lastgood.json (and
+                any --manifest run manifests) into the append-only
+                PERF_LEDGER.jsonl — idempotent; --rebuild re-derives
+                the whole file from the committed artifacts
+  perf report   per-entry sparkline trend table of the newest round
+                vs its provenance-matched history (--json for the
+                machine-readable analysis)
+  perf check    the gate: exit 1 on any regression; --strict also
+                fails when device-provenance claims are backed only by
+                carryover (the ROADMAP's device-evidence gap as a
+                failing check). ``make perf-gate`` wires this into CI.
+
+The sentinel's knobs: --threshold-floor (relative delta below which
+everything is noise) and --mad-k (how many relative MADs of historical
+wobble a delta must exceed) — thresholds are per-series, scaled to how
+noisy each series has historically been.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--ledger", default=None,
+                   help="ledger path (default: <root>/PERF_LEDGER"
+                        ".jsonl)")
+    p.add_argument("--root", default=".",
+                   help="repo root holding the BENCH_* artifacts")
+
+
+def _add_sentinel_knobs(p: argparse.ArgumentParser) -> None:
+    from ..obs import sentinel
+
+    p.add_argument("--threshold-floor", type=float,
+                   default=sentinel.DEFAULT_FLOOR,
+                   help="relative-delta noise floor (default "
+                        f"{sentinel.DEFAULT_FLOOR:g})")
+    p.add_argument("--mad-k", type=float,
+                   default=sentinel.DEFAULT_MAD_K,
+                   help="threshold = max(floor, mad_k * relative MAD "
+                        f"of prior rounds) (default "
+                        f"{sentinel.DEFAULT_MAD_K:g})")
+
+
+def _ledger_path(a) -> str:
+    from ..obs import ledger
+
+    return a.ledger or os.path.join(a.root, ledger.DEFAULT_LEDGER)
+
+
+def _load_records(a) -> list:
+    from ..obs import ledger
+
+    path = _ledger_path(a)
+    if not os.path.exists(path):
+        print(f"goleft-tpu perf: no ledger at {path} — run "
+              "`goleft-tpu perf ingest` first", file=sys.stderr)
+        raise SystemExit(1)
+    return ledger.read_ledger(path)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "goleft-tpu perf",
+        description="performance ledger, trend report and regression "
+                    "gate over the committed bench history")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    pi = sub.add_parser(
+        "ingest", help="normalize bench artifacts into the ledger")
+    _add_common(pi)
+    pi.add_argument("--manifest", action="append", default=[],
+                    metavar="RUN_JSON",
+                    help="also ingest a --metrics-out run manifest "
+                         "(repeatable)")
+    pi.add_argument("--rebuild", action="store_true",
+                    help="re-derive the ledger from scratch instead "
+                         "of appending")
+
+    pr = sub.add_parser(
+        "report", help="sparkline trend table for the newest round")
+    _add_common(pr)
+    _add_sentinel_knobs(pr)
+    pr.add_argument("--json", action="store_true",
+                    help="emit the machine-readable analysis instead "
+                         "of the table")
+    pr.add_argument("--all", action="store_true",
+                    help="include info-only metrics (ratios, "
+                         "counters) in the table")
+
+    pc = sub.add_parser(
+        "check", help="regression gate (exit 1 on regression)")
+    _add_common(pc)
+    _add_sentinel_knobs(pc)
+    pc.add_argument("--strict", action="store_true",
+                    help="also fail when device claims are backed "
+                         "only by carryover data (the device-"
+                         "evidence gap)")
+    pc.add_argument("--json", action="store_true",
+                    help="emit the analysis JSON alongside the "
+                         "verdict")
+
+    a = p.parse_args(argv)
+
+    from ..obs import ledger, sentinel
+
+    if a.verb == "ingest":
+        added, total = ledger.ingest(
+            root=a.root, ledger_path=_ledger_path(a),
+            manifests=a.manifest, rebuild=a.rebuild)
+        print(f"perf ingest: {added} new record(s), {total} total in "
+              f"{_ledger_path(a)}")
+        return 0
+
+    records = _load_records(a)
+    analysis = sentinel.analyze(records, floor=a.threshold_floor,
+                                mad_k=a.mad_k)
+    if a.verb == "report":
+        if a.json:
+            json.dump(analysis, sys.stdout, indent=1)
+            sys.stdout.write("\n")
+        else:
+            print(sentinel.render_report(analysis, show_info=a.all))
+        return 0
+
+    # check
+    code, failures = sentinel.check(analysis, strict=a.strict)
+    if a.json:
+        json.dump({**analysis, "failures": failures,
+                   "exit_code": code}, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    for line in failures:
+        print(f"perf check: {line}", file=sys.stderr)
+    if code == 0:
+        counts = analysis["counts"]
+        summary = ", ".join(f"{counts[s]} {s}"
+                            for s in ("improved", "flat", "new",
+                                      "stale-evidence", "info")
+                            if s in counts) or "no series"
+        print(f"perf check: OK (round "
+              f"{analysis['round']}: {summary})")
+        if analysis["device_evidence_gap"]:
+            print("perf check: WARNING — device claims are backed "
+                  "only by carryover data (use --strict to gate on "
+                  "this)", file=sys.stderr)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

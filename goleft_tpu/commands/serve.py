@@ -52,6 +52,19 @@ def main(argv=None) -> int:
                    help="decode threads per batch")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the startup backend/compile warm pass")
+    p.add_argument("--flight-records", type=int, default=32,
+                   help="flight-recorder ring size (span trees of "
+                        "the most recent completed requests/batches; "
+                        "GET /debug/flight, SIGUSR1 dumps to a file)")
+    p.add_argument("--flight-dir", default=".",
+                   help="directory SIGUSR1 flight dumps are written "
+                        "to (timestamped JSON)")
+    p.add_argument("--slo-p99-target-s", type=float, default=2.0,
+                   help="p99 latency target the /metrics SLO gauges "
+                        "are computed against")
+    p.add_argument("--slo-window-s", type=float, default=300.0,
+                   help="availability/error-rate window for the SLO "
+                        "gauges")
     a = p.parse_args(argv)
 
     from .. import obs
@@ -64,7 +77,10 @@ def main(argv=None) -> int:
                    max_batch=a.max_batch, max_queue=a.max_queue,
                    default_timeout_s=a.timeout_s, cache_dir=a.cache,
                    cache_max_bytes=a.cache_max_bytes,
-                   processes=a.processes, registry=obs.get_registry())
+                   processes=a.processes, registry=obs.get_registry(),
+                   flight_records=a.flight_records,
+                   slo_p99_target_s=a.slo_p99_target_s,
+                   slo_window_s=a.slo_window_s)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)
@@ -76,6 +92,20 @@ def main(argv=None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+
+    def _dump_flight(*_):
+        # SIGUSR1: the post-incident grab — dump the flight ring
+        # without disturbing the daemon (json of already-built trees)
+        try:
+            path = app.flight.dump(a.flight_dir)
+            print(f"goleft-tpu serve: flight recorder dumped to "
+                  f"{path}", file=sys.stderr, flush=True)
+        except OSError as e:
+            print(f"goleft-tpu serve: flight dump failed: {e}",
+                  file=sys.stderr, flush=True)
+
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _dump_flight)
     t = threading.Thread(target=httpd.serve_forever,
                          kwargs={"poll_interval": 0.1},
                          name="goleft-serve-http")

@@ -13,6 +13,11 @@ prefetched cohort, warm serve batch):
   - :mod:`~goleft_tpu.obs.manifest` — the per-run evidence document
   - :mod:`~goleft_tpu.obs.logging` — ``goleft-tpu.*`` logger tree +
     the CLI's ``--log-level`` config
+  - :mod:`~goleft_tpu.obs.ledger` / :mod:`~goleft_tpu.obs.sentinel` —
+    the longitudinal perf ledger (``PERF_LEDGER.jsonl``) and the
+    regression sentinel behind ``goleft-tpu perf``
+  - :mod:`~goleft_tpu.obs.prometheus` — text-exposition rendering of
+    a registry snapshot (the serve daemon's ``/metrics?format=prom``)
 
 Import is jax-free and cheap (the CLI touches this before backend
 bring-up); anything needing jax resolves it lazily per call.

@@ -1,0 +1,447 @@
+"""The performance ledger: bench history as a normalized time series.
+
+The repo's perf evidence has always been write-only snapshots: five
+``BENCH_r*.json`` round artifacts (the driver's captured stdout tail +
+final headline JSON line), a ``BENCH_lastgood.json`` device pin, and —
+since PR 3 — per-run manifests. Nothing relates them, so the
+ROADMAP's top open item (stale round-2 chip numbers riding through
+rounds 3-5 as if they were fresh) could only be caught by a human
+reading tails. This module converts all of it into ONE normalized,
+append-only ``PERF_LEDGER.jsonl``: one record per bench entry per
+round, each carrying
+
+  - the round id (``r03`` / ``lastgood`` / ``live-<ts>`` /
+    ``manifest``) and, for driver rounds, the integer round number
+    the sentinel trends over,
+  - the entry's OWN platform claim and its normalized provenance
+    class (``host`` / ``device`` / ``unknown``) — the per-entry
+    pinning PR 1 introduced is what makes class-matched baselines
+    possible,
+  - a ``stale`` carryover flag: an entry that claims device platform
+    in a round whose probe failed, or that arrived inside a
+    ``device_lastgood`` block, is *evidence about the past*, never a
+    fresh measurement,
+  - the numeric metrics themselves, flattened to dotted keys.
+
+Parsing is deliberately forgiving: round tails are TRUNCATED stdout
+(the first line is usually cut mid-dict), so any line that doesn't
+parse is skipped — what survives is real, what didn't survive was
+never evidence. The sentinel (obs/sentinel.py) consumes the ledger;
+``goleft-tpu perf`` is the CLI over both.
+"""
+
+from __future__ import annotations
+
+import ast
+import datetime
+import json
+import os
+import re
+
+LEDGER_SCHEMA = "goleft-tpu.perf-ledger/1"
+DEFAULT_LEDGER = "PERF_LEDGER.jsonl"
+
+#: tail lines shaped like ``entry_name: {python dict repr}`` — how the
+#: bench's incremental _merge_details echoes each entry as it lands
+_ENTRY_LINE = re.compile(
+    r"^([A-Za-z_][A-Za-z0-9_]*): (\{.*\})\s*$")
+
+_ROUND_FILE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: metric keys that are configuration/identity, not measurements
+_CONFIG_KEYS = frozenset({
+    "samples", "ref_bp", "coverage", "read_len", "window", "iters",
+    "shard_bp", "threads", "chromosomes", "tiles", "windows", "n",
+    "rc", "effective_cores", "genome_gb", "decode_threads_used",
+    "optimal_threads", "timeout_s", "level", "seed",
+    "kernel_shard_bp", "kernel_coverage", "kernel_read_len",
+    "kernel_iters", "payload_mb",
+})
+
+
+def classify_platform(platform) -> str:
+    """Normalize an entry's platform claim to a provenance class.
+
+    ``host``/``cpu``-prefixed claims (including the bench's annotated
+    forms like ``"host (decode+reduce is pure host work)"`` and
+    ``"cpu (host-only mode)"``) are host evidence; a missing or
+    ``unavailable`` claim is ``unknown``; anything else (tpu, gpu,
+    axon, ...) is a device claim.
+    """
+    if not platform or not isinstance(platform, str):
+        return "unknown"
+    p = platform.strip().lower()
+    if p.startswith(("host", "cpu")):
+        return "host"
+    if p.startswith(("unavailable", "unknown", "n/a")):
+        return "unknown"
+    return "device"
+
+
+def numeric_metrics(d: dict, prefix: str = "",
+                    max_depth: int = 3) -> dict:
+    """Flatten a bench entry's numeric leaves to {dotted_key: float},
+    skipping configuration keys, bools, and anything non-numeric."""
+    out: dict[str, float] = {}
+    if max_depth < 0 or not isinstance(d, dict):
+        return out
+    for k, v in d.items():
+        if not isinstance(k, str) or k in _CONFIG_KEYS:
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            out.update(numeric_metrics(v, f"{key}.", max_depth - 1))
+    return out
+
+
+def make_record(*, source: str, round_label: str, entry: str,
+                kind: str, metrics: dict,
+                round_num: int | None = None,
+                platform: str | None = None, stale: bool = False,
+                stale_reason: str | None = None,
+                ts: str | None = None, extra: dict | None = None
+                ) -> dict:
+    rec = {
+        "schema": LEDGER_SCHEMA,
+        "source": source,
+        "round": round_num,
+        "round_label": round_label,
+        "entry": entry,
+        "kind": kind,
+        "platform": platform,
+        "provenance": classify_platform(platform),
+        "stale": bool(stale),
+        "stale_reason": stale_reason,
+        "metrics": {k: round(float(v), 6)
+                    for k, v in sorted(metrics.items())},
+        "ts": ts,
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def _tail_entries(tail: str) -> dict:
+    """{entry_name: dict} for every parseable ``name: {...}`` tail
+    line (python dict reprs — the bench echoes entries via repr)."""
+    out: dict[str, dict] = {}
+    for line in (tail or "").splitlines():
+        m = _ENTRY_LINE.match(line)
+        if not m:
+            continue
+        try:
+            val = ast.literal_eval(m.group(2))
+        except (ValueError, SyntaxError, MemoryError,
+                RecursionError):
+            continue  # truncated / not a literal — not evidence
+        if isinstance(val, dict):
+            out[m.group(1)] = val
+    return out
+
+
+def _probe_failed(tail: str, entries: dict) -> bool:
+    """Did this round run without a usable accelerator? Derived from
+    the bench's own loud markers, not inferred from silence."""
+    if "accelerator unusable" in (tail or ""):
+        return True
+    probe = entries.get("device_probe")
+    if isinstance(probe, dict):
+        attempts = probe.get("attempts")
+        if isinstance(attempts, list) and attempts:
+            return not any(a.get("ok") for a in attempts
+                           if isinstance(a, dict))
+    return False
+
+
+def parse_round_file(path: str) -> list[dict]:
+    """One committed ``BENCH_rNN.json`` driver artifact -> records.
+
+    Produces a record per parseable tail entry plus one for the final
+    headline JSON line (``parsed``). Stale derivation: entries inside
+    a ``device_lastgood`` block are carryover by construction; any
+    other entry whose own platform claims a device in a round whose
+    probe failed cannot have been measured this round.
+    """
+    with open(path) as fh:
+        doc = json.load(fh)
+    source = os.path.basename(path)
+    m = _ROUND_FILE.search(source)
+    round_num = int(m.group(1)) if m else int(doc.get("n", 0)) or None
+    label = f"r{round_num:02d}" if round_num is not None else source
+    tail = doc.get("tail") or ""
+    entries = _tail_entries(tail)
+    failed = _probe_failed(tail, entries)
+    records: list[dict] = []
+
+    for name, val in entries.items():
+        if name == "device_probe":
+            continue  # probe attempts are provenance, not metrics
+        if name == "device_lastgood":
+            prov = val.get("provenance") or {}
+            for sub_name, sub in (val.get("entries") or {}).items():
+                if not isinstance(sub, dict):
+                    continue
+                records.append(make_record(
+                    source=source, round_label=label, entry=sub_name,
+                    kind="carryover", round_num=round_num,
+                    platform=sub.get("platform")
+                    or prov.get("platform"),
+                    stale=True,
+                    stale_reason="device_lastgood carryover: probe "
+                                 "failed this round; values were "
+                                 "measured in an earlier round",
+                    metrics=numeric_metrics(sub), ts=prov.get("ts")))
+            continue
+        plat = val.get("platform")
+        stale = failed and classify_platform(plat) == "device"
+        records.append(make_record(
+            source=source, round_label=label, entry=name,
+            kind="bench", round_num=round_num, platform=plat,
+            stale=stale,
+            stale_reason=("entry claims device platform but this "
+                          "round's probe failed — carryover"
+                          if stale else None),
+            metrics=numeric_metrics(val)))
+
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        records.extend(_headline_records(parsed, source, label,
+                                         round_num, failed))
+    return records
+
+
+def _headline_records(parsed: dict, source: str, label: str,
+                      round_num: int | None,
+                      probe_failed: bool) -> list[dict]:
+    """The driver headline (the bench's final stdout JSON line).
+
+    The cohort e2e headline is host work by definition (decode+reduce
+    never touches the device — bench.py pins exactly that into the
+    cohort entry's platform field); other headline metrics take their
+    platform from their own config block. Kernel numbers embedded in
+    the config ride as their own record so the device series is
+    continuous across rounds where the suite reshuffled.
+    """
+    metric = str(parsed["metric"])
+    config = parsed.get("config") or {}
+    if metric.startswith("cohort_depth_e2e"):
+        plat = "host (decode+reduce is pure host work)"
+    else:
+        plat = config.get("platform")
+    metrics = {"value": parsed.get("value", 0.0)}
+    if isinstance(parsed.get("vs_baseline"), (int, float)):
+        metrics["vs_baseline"] = parsed["vs_baseline"]
+    out = [make_record(
+        source=source, round_label=label, entry=metric,
+        kind="headline", round_num=round_num, platform=plat,
+        stale=probe_failed and classify_platform(plat) == "device",
+        stale_reason=("headline claims device platform but this "
+                      "round's probe failed — carryover"
+                      if probe_failed
+                      and classify_platform(plat) == "device"
+                      else None),
+        metrics=metrics)]
+    kern = {k: v for k, v in config.items()
+            if k.startswith("kernel_") and isinstance(v, (int, float))
+            and not isinstance(v, bool) and k not in _CONFIG_KEYS
+            and not k.endswith(("_shard_bp", "_coverage", "_read_len",
+                                "_iters"))}
+    if kern:
+        kplat = config.get("platform")
+        stale = probe_failed and classify_platform(kplat) == "device"
+        out.append(make_record(
+            source=source, round_label=label, entry="device_kernels",
+            kind="headline", round_num=round_num, platform=kplat,
+            stale=stale,
+            stale_reason=("kernel numbers claim device platform but "
+                          "this round's probe failed — carryover"
+                          if stale else None),
+            metrics=kern))
+    return out
+
+
+def parse_lastgood(path: str) -> list[dict]:
+    """``BENCH_lastgood.json`` -> pin records (round ``lastgood``).
+
+    A pin is by definition evidence about a PAST round (the most
+    recent real device run); it never participates in round-over-round
+    trending, but ingesting it keeps the device claim's backing data
+    inside the ledger where ``perf check --strict`` can see it.
+    """
+    with open(path) as fh:
+        doc = json.load(fh)
+    prov = doc.get("provenance") or {}
+    records = []
+    for name, entry in (doc.get("entries") or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        records.append(make_record(
+            source=os.path.basename(path), round_label="lastgood",
+            entry=name, kind="pin",
+            platform=entry.get("platform") or prov.get("platform"),
+            stale=True,
+            stale_reason="lastgood pin: most recent recorded device "
+                         "numbers, not a fresh measurement",
+            metrics=numeric_metrics(entry), ts=prov.get("ts")))
+    return records
+
+
+def parse_manifest(path: str, round_num: int | None = None) -> list[dict]:
+    """A PR-3 run manifest -> one record (span seconds + counters),
+    carrying the manifest's own backend provenance. Schema-validated
+    via obs.manifest.load_manifest (accepts any 1.x minor)."""
+    from .manifest import load_manifest
+
+    doc = load_manifest(path)
+    backend = doc.get("backend") or {}
+    metrics: dict[str, float] = {}
+    for name, rec in (doc.get("spans") or {}).items():
+        if isinstance(rec, dict) and "seconds" in rec:
+            metrics[f"spans.{name}.seconds"] = rec["seconds"]
+    snap = doc.get("metrics") or {}
+    for name, v in (snap.get("counters") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[f"counters.{name}"] = v
+    for name, v in (snap.get("gauges") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[f"gauges.{name}"] = v
+    if "spans_dropped" in doc:
+        metrics["spans_dropped"] = doc["spans_dropped"]
+    cmd = doc.get("command") or "run"
+    label = (f"r{round_num:02d}" if round_num is not None
+             else "manifest")
+    return [make_record(
+        source=os.path.basename(path), round_label=label,
+        entry=f"manifest.{cmd}", kind="manifest",
+        round_num=round_num, platform=backend.get("platform"),
+        stale="error" in backend,
+        stale_reason=(f"backend unavailable: {backend.get('error')}"
+                      if "error" in backend else None),
+        metrics=metrics, ts=doc.get("ts"))]
+
+
+def live_run_records(details: dict, headline: dict | None,
+                     source: str = "bench.py") -> list[dict]:
+    """Records for a bench run that JUST completed in this process —
+    how ``python bench.py`` auto-appends itself to the ledger. The
+    round label is ``live-<utc ts>``; entries reuse the same per-entry
+    platform pinning the committed artifacts carry."""
+    ts = datetime.datetime.now(datetime.timezone.utc) \
+        .isoformat(timespec="seconds")
+    label = f"live-{ts}"
+    records = []
+    for name, val in (details or {}).items():
+        if not isinstance(val, dict) or name == "device_probe":
+            continue
+        if name == "device_lastgood":
+            prov = val.get("provenance") or {}
+            for sub_name, sub in (val.get("entries") or {}).items():
+                if isinstance(sub, dict):
+                    records.append(make_record(
+                        source=source, round_label=label,
+                        entry=sub_name, kind="carryover",
+                        platform=sub.get("platform")
+                        or prov.get("platform"), stale=True,
+                        stale_reason="device_lastgood carryover",
+                        metrics=numeric_metrics(sub), ts=ts))
+            continue
+        records.append(make_record(
+            source=source, round_label=label, entry=name,
+            kind="live", platform=val.get("platform"),
+            metrics=numeric_metrics(val), ts=ts))
+    if isinstance(headline, dict) and "metric" in headline:
+        for rec in _headline_records(headline, source, label, None,
+                                     probe_failed=False):
+            rec["kind"] = "live"
+            rec["ts"] = ts
+            records.append(rec)
+    return records
+
+
+# ---- ledger file I/O ----
+
+
+def read_ledger(path: str) -> list[dict]:
+    records = []
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{i}: corrupt ledger line: {e}") from None
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{i}: record is not an object")
+            records.append(rec)
+    return records
+
+
+def append_records(path: str, records: list[dict]) -> None:
+    """Append-only write: one sorted-key JSON object per line, atomic
+    against torn lines (single write per record, flushed once)."""
+    if not records:
+        return
+    with open(path, "a") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def record_key(rec: dict) -> tuple:
+    """Identity for dedup: the same entry of the same round from the
+    same source is the same evidence, however often ingested."""
+    return (rec.get("source"), rec.get("round_label"),
+            rec.get("entry"))
+
+
+def discover_sources(root: str = ".") -> dict:
+    """{kind: [paths]} of the committed artifacts under ``root``."""
+    rounds = sorted(
+        os.path.join(root, f) for f in os.listdir(root)
+        if _ROUND_FILE.search(f))
+    lastgood = os.path.join(root, "BENCH_lastgood.json")
+    return {
+        "rounds": rounds,
+        "lastgood": [lastgood] if os.path.exists(lastgood) else [],
+    }
+
+
+def ingest(root: str = ".", ledger_path: str | None = None,
+           manifests: list[str] | tuple = (),
+           rebuild: bool = False) -> tuple[int, int]:
+    """Ingest every discoverable artifact into the ledger.
+
+    Append-only with dedup: records whose (source, round, entry)
+    identity is already in the ledger are skipped, so re-running
+    ``perf ingest`` is idempotent. ``rebuild=True`` starts from an
+    empty file (the committed artifacts are the source of truth; the
+    ledger is a derived view). Returns (records_added, total).
+    """
+    ledger_path = ledger_path or os.path.join(root, DEFAULT_LEDGER)
+    srcs = discover_sources(root)
+    fresh: list[dict] = []
+    for p in srcs["rounds"]:
+        fresh.extend(parse_round_file(p))
+    for p in srcs["lastgood"]:
+        fresh.extend(parse_lastgood(p))
+    for p in manifests:
+        fresh.extend(parse_manifest(p))
+    if rebuild and os.path.exists(ledger_path):
+        os.remove(ledger_path)
+    existing = (read_ledger(ledger_path)
+                if os.path.exists(ledger_path) else [])
+    seen = {record_key(r) for r in existing}
+    new = []
+    for rec in fresh:
+        k = record_key(rec)
+        if k not in seen:
+            seen.add(k)
+            new.append(rec)
+    append_records(ledger_path, new)
+    return len(new), len(existing) + len(new)

@@ -26,7 +26,28 @@ from .tracing import Tracer, get_tracer
 REQUIRED_KEYS = ("schema", "ts", "argv", "env", "backend", "spans",
                  "metrics", "trace_id")
 
-SCHEMA = "goleft-tpu.run-manifest/1"
+#: current writer version. Minor bumps (1.x) ADD fields and must stay
+#: readable by every 1.* consumer (the perf ledger ingests manifests
+#: from many rounds); a major bump means the REQUIRED_KEYS contract
+#: itself changed and old readers must refuse loudly.
+SCHEMA_PREFIX = "goleft-tpu.run-manifest/"
+SCHEMA_MAJOR = 1
+SCHEMA = f"{SCHEMA_PREFIX}1.1"
+
+
+def parse_schema_version(schema: str) -> tuple[int, int]:
+    """``goleft-tpu.run-manifest/1.2`` -> (1, 2); a bare ``/1`` means
+    (1, 0). Raises ValueError on anything else."""
+    if not isinstance(schema, str) \
+            or not schema.startswith(SCHEMA_PREFIX):
+        raise ValueError(f"not a run-manifest schema id: {schema!r}")
+    ver = schema[len(SCHEMA_PREFIX):]
+    major, _, minor = ver.partition(".")
+    try:
+        return int(major), int(minor) if minor else 0
+    except ValueError:
+        raise ValueError(
+            f"unparseable run-manifest version: {schema!r}") from None
 
 
 def build_manifest(tracer: Tracer | None = None,
@@ -44,7 +65,11 @@ def build_manifest(tracer: Tracer | None = None,
         "env": env_provenance(),
         "backend": backend_provenance(),
         "spans": tracer.summary(trace_id=trace_id),
+        # the span summary is only as complete as the ring: the drop
+        # count (and a plain truncation flag, added in 1.1) ride next
+        # to it so a partial summary is self-describing
         "spans_dropped": tracer.spans_dropped,
+        "spans_truncated": tracer.spans_dropped > 0,
         "metrics": registry.snapshot(),
         "trace_id": trace_id,
     }
@@ -64,14 +89,27 @@ def write_manifest(path: str, **kw) -> dict:
 
 
 def load_manifest(path: str) -> dict:
-    """Parse + validate a manifest (the bench's ingestion entry): the
-    REQUIRED_KEYS must be present and the backend block must carry
-    either provenance fields or an explicit error."""
+    """Parse + validate a manifest (the bench's and the perf ledger's
+    ingestion entry): the REQUIRED_KEYS must be present and the
+    backend block must carry either provenance fields or an explicit
+    error.
+
+    Schema policy: any ``goleft-tpu.run-manifest/1.x`` revision loads
+    (minor revisions only add fields — ledger ingestion must survive
+    manifests written by future rounds); a different major is rejected
+    with a clear error instead of being half-parsed.
+    """
     with open(path) as fh:
         doc = json.load(fh)
     missing = [k for k in REQUIRED_KEYS if k not in doc]
     if missing:
         raise ValueError(f"manifest {path}: missing keys {missing}")
+    major, _minor = parse_schema_version(doc["schema"])
+    if major != SCHEMA_MAJOR:
+        raise ValueError(
+            f"manifest {path}: unsupported schema major version "
+            f"{major} ({doc['schema']!r}); this reader supports "
+            f"{SCHEMA_MAJOR}.x — upgrade goleft-tpu to read it")
     backend = doc["backend"]
     if "error" not in backend and "platform" not in backend:
         raise ValueError(

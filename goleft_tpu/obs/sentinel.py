@@ -1,0 +1,269 @@
+"""The regression sentinel: noise-aware trend gating over the ledger.
+
+Consumes ``PERF_LEDGER.jsonl`` (obs/ledger.py) and answers, per
+(entry, metric) series, the question no snapshot can: *is the newest
+round's number better, worse, noise, or not even evidence?*
+
+Classification contract (the perf ``check`` gate and the table-driven
+tests pin these):
+
+  - ``stale-evidence`` — the newest record is a carryover/pin (its
+    ``stale`` flag is set, e.g. a ``device_lastgood`` block in a
+    probe-failed round). It is flagged, never compared: stale numbers
+    can neither regress nor improve, they are facts about an earlier
+    round. This includes the host-vs-device mismatch case: a device
+    claim has NO fresh measurement behind it this round.
+  - ``regressed`` / ``improved`` — the relative delta against the
+    baseline exceeds the noise threshold in the metric's bad / good
+    direction.
+  - ``flat`` — within the threshold.
+  - ``new`` — no provenance-compatible prior rounds to compare against
+    (including a fresh device number after host-only rounds: device
+    compares only against device).
+  - ``info`` — the metric has no regression semantics (``vs_baseline``
+    ratios whose denominator is re-measured per round, config echoes,
+    the numpy baseline itself).
+
+Baseline = median of the provenance-matched, non-stale prior rounds;
+noise threshold = max(relative floor, ``mad_k`` × relative MAD of
+those priors) — so a series that historically wobbles ±30% needs more
+than 30%-ish movement to alarm, while a stable series trips at the
+floor. Provenance matching: device records compare only against
+device records; host records against host (records with no platform
+claim are treated as host-side — every unpinned bench entry predates
+per-entry pinning and ran on the host suite).
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+
+#: default relative-delta floor below which movement is noise
+DEFAULT_FLOOR = 0.20
+#: how many relative MADs of historical wobble the delta must exceed
+DEFAULT_MAD_K = 3.0
+
+#: substrings deciding metric direction; first match wins, checked
+#: info -> lower -> higher so e.g. ``numpy_kernel_gbases_per_sec``
+#: stays informational even though it looks like a throughput
+_INFO_PAT = ("vs_baseline", "numpy_", "baseline", "ratio",
+             "spans_dropped", "calls", "count", "counters.",
+             "gauges.", "overhead", "threaded_over_serial")
+_LOWER_PAT = ("seconds", "latency", "_ms", "wall")
+_HIGHER_PAT = ("per_sec", "per_chip", "throughput", "speedup",
+               "samples_per_sec", "efficiency", "hit_rate",
+               "req_per_s", "gbases", "mb_per_s", "per_second")
+
+
+def metric_direction(entry: str, metric: str) -> str | None:
+    """'higher' | 'lower' | None (no regression semantics). A bare
+    ``value`` metric takes its direction from its entry name (the
+    headline records)."""
+    name = f"{entry}.{metric}" if metric == "value" else metric
+    low = name.lower()
+    if any(p in low for p in _INFO_PAT):
+        return None
+    if any(p in low for p in _LOWER_PAT):
+        return "lower"
+    if any(p in low for p in _HIGHER_PAT):
+        return "higher"
+    return None
+
+
+def provenance_compatible(current: str, prior: str) -> bool:
+    """Device evidence only ever compares against device evidence;
+    host (and legacy unpinned = host-suite) records compare among
+    themselves. The asymmetric case this exists for: a device claim
+    must never be judged against a host baseline (or vice versa) —
+    that comparison produced three rounds of phantom 'regressions'
+    and 'speedups' before per-entry pinning."""
+    if current == "device" or prior == "device":
+        return current == prior == "device"
+    return True  # host/unknown pool together (host-suite reality)
+
+
+def _series(records: list[dict]) -> dict:
+    """{(entry, metric): [(round, value, provenance, stale)]} over the
+    numeric-round records, round-ordered."""
+    out: dict[tuple, list] = {}
+    for rec in records:
+        rnd = rec.get("round")
+        if not isinstance(rnd, int):
+            continue  # pins / unround manifests trend nowhere
+        for metric, value in (rec.get("metrics") or {}).items():
+            out.setdefault((rec["entry"], metric), []).append(
+                (rnd, float(value), rec.get("provenance", "unknown"),
+                 bool(rec.get("stale"))))
+    for vals in out.values():
+        vals.sort(key=lambda t: t[0])
+    return out
+
+
+def classify_series(points: list, entry: str, metric: str,
+                    floor: float = DEFAULT_FLOOR,
+                    mad_k: float = DEFAULT_MAD_K) -> dict:
+    """Classify the NEWEST point of one (entry, metric) series against
+    its provenance-matched history. ``points`` is the round-ordered
+    [(round, value, provenance, stale)] list."""
+    rnd, value, prov, stale = points[-1]
+    history = [v for r, v, p, s in points[:-1]
+               if r < rnd and not s
+               and provenance_compatible(prov, p)]
+    out = {
+        "entry": entry, "metric": metric, "round": rnd,
+        "value": value, "provenance": prov,
+        "history": [v for r, v, _, _ in points[:-1] if r < rnd],
+        "baseline": None, "delta": None, "threshold": None,
+        "direction": metric_direction(entry, metric),
+    }
+    if stale:
+        out["status"] = "stale-evidence"
+        return out
+    if out["direction"] is None:
+        out["status"] = "info"
+        return out
+    if not history:
+        out["status"] = "new"
+        return out
+    baseline = statistics.median(history)
+    out["baseline"] = baseline
+    if baseline == 0:
+        out["status"] = "new"  # nothing meaningful to scale against
+        return out
+    rel_mad = (statistics.median(
+        [abs(v - baseline) for v in history]) / abs(baseline)
+        if len(history) > 1 else 0.0)
+    threshold = max(floor, mad_k * rel_mad)
+    delta = (value - baseline) / abs(baseline)
+    out["delta"] = round(delta, 4)
+    out["threshold"] = round(threshold, 4)
+    worse = -delta if out["direction"] == "higher" else delta
+    if worse > threshold:
+        out["status"] = "regressed"
+    elif -worse > threshold:
+        out["status"] = "improved"
+    else:
+        out["status"] = "flat"
+    return out
+
+
+def analyze(records: list[dict], floor: float = DEFAULT_FLOOR,
+            mad_k: float = DEFAULT_MAD_K) -> dict:
+    """Full sentinel pass over ledger records.
+
+    Returns {round, results: [classification...], counts,
+    device_evidence_gap}: ``results`` classifies every (entry, metric)
+    present in the NEWEST numeric round; ``device_evidence_gap`` is
+    True when that round's device-provenance claims are backed ONLY by
+    carryover data (every device record stale) — the ROADMAP gap as a
+    machine-readable bit.
+    """
+    series = _series(records)
+    rounds = {pt[0] for pts in series.values() for pt in pts}
+    if not rounds:
+        return {"round": None, "results": [], "counts": {},
+                "device_evidence_gap": False}
+    newest = max(rounds)
+    results = []
+    for (entry, metric), pts in sorted(series.items()):
+        if pts[-1][0] != newest:
+            continue  # entry didn't run in the newest round
+        results.append(classify_series(pts, entry, metric,
+                                       floor=floor, mad_k=mad_k))
+    counts: dict[str, int] = {}
+    for r in results:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    device_pts = [r for r in results if r["provenance"] == "device"]
+    gap = bool(device_pts) and all(
+        r["status"] == "stale-evidence" for r in device_pts)
+    return {"round": newest, "results": results, "counts": counts,
+            "device_evidence_gap": gap}
+
+
+# ---- rendering ----
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float]) -> str:
+    """Unicode mini-trend of a series (empty string for <1 point)."""
+    vals = [v for v in values if isinstance(v, (int, float))
+            and math.isfinite(v)]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi == lo:
+        return _SPARK[3] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * (len(_SPARK) - 1)))]
+        for v in vals)
+
+
+_STATUS_ORDER = ("regressed", "stale-evidence", "new", "improved",
+                 "flat", "info")
+
+
+def render_report(analysis: dict, show_info: bool = False) -> str:
+    """The ``perf report`` table: per-entry sparkline trend rows,
+    worst news first."""
+    results = [r for r in analysis["results"]
+               if show_info or r["status"] != "info"]
+    if not results:
+        return "perf: ledger has no classifiable series"
+    results.sort(key=lambda r: (_STATUS_ORDER.index(r["status"]),
+                                r["entry"], r["metric"]))
+    name_w = max(len(f"{r['entry']}.{r['metric']}")
+                 for r in results)
+    name_w = min(max(name_w, 20), 58)
+    lines = [f"round r{analysis['round']:02d} vs provenance-matched "
+             "history (median baseline, MAD-scaled threshold)", ""]
+    hdr = (f"{'entry.metric':<{name_w}} {'trend':<8} "
+           f"{'latest':>10} {'baseline':>10} {'delta':>8} "
+           f"{'thresh':>7}  status")
+    lines += [hdr, "-" * len(hdr)]
+    for r in results:
+        name = f"{r['entry']}.{r['metric']}"
+        if len(name) > name_w:
+            name = name[:name_w - 1] + "…"
+        spark = sparkline(r["history"] + [r["value"]])
+        delta = (f"{r['delta']:+.1%}" if r["delta"] is not None
+                 else "-")
+        thresh = (f"{r['threshold']:.0%}"
+                  if r["threshold"] is not None else "-")
+        base = (f"{r['baseline']:.4g}"
+                if r["baseline"] is not None else "-")
+        lines.append(
+            f"{name:<{name_w}} {spark:<8} {r['value']:>10.4g} "
+            f"{base:>10} {delta:>8} {thresh:>7}  {r['status']}")
+    counts = analysis["counts"]
+    lines += ["", "summary: " + ", ".join(
+        f"{counts[s]} {s}" for s in _STATUS_ORDER if s in counts)]
+    if analysis["device_evidence_gap"]:
+        lines.append(
+            "device-evidence gap: every device-provenance claim in "
+            "this round is carryover (stale) — no fresh on-chip "
+            "measurement backs it (run bench.py on the chip host; "
+            "see ROADMAP)")
+    return "\n".join(lines)
+
+
+def check(analysis: dict, strict: bool = False
+          ) -> tuple[int, list[str]]:
+    """The gate: (exit_code, failure_lines). Nonzero on any
+    regression; with ``strict`` also on a device-evidence gap (device
+    claims backed only by carryover)."""
+    failures = []
+    for r in analysis["results"]:
+        if r["status"] == "regressed":
+            failures.append(
+                f"REGRESSED {r['entry']}.{r['metric']}: "
+                f"{r['value']:.4g} vs baseline {r['baseline']:.4g} "
+                f"({r['delta']:+.1%}, threshold "
+                f"{r['threshold']:.0%}, {r['provenance']})")
+    if strict and analysis["device_evidence_gap"]:
+        failures.append(
+            "STALE-EVIDENCE device claims are backed only by "
+            "carryover data (no fresh device measurement this round)")
+    return (1 if failures else 0), failures

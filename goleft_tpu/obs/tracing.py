@@ -97,6 +97,10 @@ class Tracer:
         self._ids = itertools.count(1)
         self._trace_ids = itertools.count(1)
         self._ctx = _Context()
+        # completed-span listeners (the serve flight recorder): a plain
+        # tuple read without the lock — empty for every process that
+        # never registers one, so the hot path pays one truth test
+        self._listeners: tuple = ()
         # --trace-out / GOLEFT_TPU_DEVICE_EVENTS=1 turn on per-dispatch
         # device fencing (obs.dispatch): off by default so the async
         # dispatch pipelines keep their overlap when nobody is looking
@@ -153,6 +157,27 @@ class Tracer:
                 if len(self._spans) == self._spans.maxlen:
                     self.spans_dropped += 1
                 self._spans.append(sp)
+            for cb in self._listeners:
+                try:
+                    cb(sp)
+                except Exception:  # noqa: BLE001 — a broken listener
+                    pass           # must never fail the traced work
+
+    # ---- completed-span listeners ----
+
+    def add_listener(self, cb) -> None:
+        """Register ``cb(span)`` to run after every span completes
+        (outside the ring lock, on the recording thread)."""
+        with self._lock:
+            if cb not in self._listeners:
+                self._listeners = self._listeners + (cb,)
+
+    def remove_listener(self, cb) -> None:
+        # equality, not identity: a bound method is a fresh object at
+        # every attribute access, but compares equal to itself
+        with self._lock:
+            self._listeners = tuple(
+                c for c in self._listeners if c != cb)
 
     # ---- cross-thread propagation ----
 
@@ -255,6 +280,14 @@ class Tracer:
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": nm or f"thread-{tid}"},
         } for tid, nm in sorted(threads.items())]
+        # truncation is part of the evidence: a metadata event carries
+        # the ring's drop count INSIDE traceEvents (Perfetto surfaces
+        # event args; otherData is not reachable from the UI), so a
+        # short trace says it is short instead of looking complete
+        meta.append({
+            "name": "spans_dropped", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"spans_dropped": self.spans_dropped},
+        })
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",

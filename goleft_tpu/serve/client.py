@@ -52,6 +52,20 @@ class ServeClient:
     def metrics(self) -> dict:
         return self._request("/metrics")
 
+    def metrics_prometheus(self) -> str:
+        """The same metrics as Prometheus text exposition (0.0.4)."""
+        req = urllib.request.Request(
+            self.base_url + "/metrics?format=prom",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return r.read().decode()
+
+    def flight(self, n: int | None = None) -> dict:
+        """The flight recorder ring: span trees of the most recent
+        completed requests/batches, newest first."""
+        path = "/debug/flight" + (f"?n={n}" if n is not None else "")
+        return self._request(path)
+
     # ---- workloads ----
 
     def depth(self, bam: str, **params) -> dict:

@@ -19,7 +19,9 @@ forever), so a long-lived daemon's per-request state stays bounded.
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 
 from ..obs.metrics import MetricsRegistry
 from ..utils.profiling import StageTimer
@@ -27,19 +29,78 @@ from ..utils.profiling import StageTimer
 _PREFIX = "serve."
 _BATCH = "serve.batch_size."
 _LATENCY = "serve.latency_s."
+_SLO = "serve.slo."
 
 
 class ServeMetrics:
     def __init__(self, max_latencies: int = 4096,
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 outcome_window: int = 4096):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self._max_latencies = max_latencies
         self.timer = StageTimer()
         self.started = time.time()
+        # (monotonic ts, was_error) per response — the availability
+        # window's raw material (bounded; a counter can't answer
+        # "over the last five minutes")
+        self._outcomes: deque = deque(maxlen=outcome_window)
+        self._outcomes_lock = threading.Lock()
 
     def inc(self, name: str, n: int = 1) -> None:
         self.registry.counter(_PREFIX + name).inc(n)
+
+    def record_response(self, code: int) -> None:
+        """Every HTTP response: the per-code counter (as always) plus
+        the timestamped outcome the SLO window is computed from. 5xx
+        is an error burning the availability budget; 4xx is the
+        client's problem and 2xx/3xx are successes."""
+        self.inc(f"responses_total.{code}")
+        with self._outcomes_lock:
+            self._outcomes.append((time.monotonic(), code >= 500))
+
+    def slo_snapshot(self, p99_target_s: float = 2.0,
+                     window_s: float = 300.0) -> dict:
+        """Compute the SLO gauges and publish them into the registry
+        (``serve.slo.*`` — visible to /metrics in both encodings and
+        to any --metrics-out manifest snapshot of this process).
+
+        Pull-based: computed at scrape time from state the serve path
+        already records, so idle daemons pay nothing.
+
+          - ``p99_latency_ratio.<endpoint>``: windowed p99 / target
+            (>1 = violating)
+          - ``error_rate``: 5xx fraction of responses in the window
+          - ``availability``: 1 - error_rate (1.0 while idle: no
+            traffic is not an outage)
+        """
+        now = time.monotonic()
+        with self._outcomes_lock:
+            recent = [err for ts, err in self._outcomes
+                      if now - ts <= window_s]
+        total = len(recent)
+        errors = sum(recent)
+        error_rate = (errors / total) if total else 0.0
+        availability = 1.0 - error_rate
+        ratios = {}
+        for ep, summ in self.registry.histograms(_LATENCY).items():
+            p99 = summ.get("p99")
+            if p99 is not None and p99_target_s > 0:
+                ratios[ep] = round(p99 / p99_target_s, 4)
+        g = self.registry.gauge
+        g(_SLO + "error_rate").set(round(error_rate, 6))
+        g(_SLO + "availability").set(round(availability, 6))
+        g(_SLO + "window_requests").set(total)
+        for ep, r in ratios.items():
+            g(f"{_SLO}p99_latency_ratio.{ep}").set(r)
+        return {
+            "p99_target_s": p99_target_s,
+            "window_s": window_s,
+            "window_requests": total,
+            "error_rate": round(error_rate, 6),
+            "availability": round(availability, 6),
+            "p99_latency_ratio": ratios,
+        }
 
     def observe_batch(self, size: int) -> None:
         self.registry.counter(_PREFIX + "batches_total").inc()
@@ -52,7 +113,8 @@ class ServeMetrics:
                                 self._max_latencies).observe(seconds)
 
     def snapshot(self, queue_depth: int | None = None,
-                 cache_stats: dict | None = None) -> dict:
+                 cache_stats: dict | None = None,
+                 slo: dict | None = None) -> dict:
         counters = {
             n: v for n, v in self.registry.counters(_PREFIX).items()
             if not n.startswith("batch_size.")
@@ -75,4 +137,6 @@ class ServeMetrics:
             out["queue_depth"] = queue_depth
         if cache_stats is not None:
             out["cache"] = cache_stats
+        if slo is not None:
+            out["slo"] = slo
         return out

@@ -15,7 +15,14 @@ passes (serve/executors.py). Layered on top:
     reported) + draining flag
   - /metrics: request/response counters, queue depth, the batch-size
     histogram (the coalescing evidence), per-endpoint latency
-    percentiles, stage wall-clocks and cache hit rates
+    percentiles, stage wall-clocks, cache hit rates and the SLO block
+    (p99-vs-target ratios, windowed error rate / availability). The
+    body is JSON by default; ``?format=prom`` or ``Accept:
+    text/plain`` returns the SAME registry snapshot as Prometheus
+    text exposition (0.0.4) — no sidecar exporter
+  - /debug/flight: the flight recorder's ring — span trees of the
+    most recent completed requests and batches (serve/flight.py);
+    SIGUSR1 (commands/serve.py) dumps the same ring to a file
   - graceful drain: SIGTERM stops the accept loop, in-flight handler
     threads finish through the batcher, exit 0
 
@@ -25,7 +32,7 @@ Routes:
   POST /v1/indexcov     {bams: [...], fai, chrom?, excludepatt?}
   POST /v1/cohortdepth  {bams: [...], reference|fai, window?, mapq?,
                          chrom?, bed?, engine?}
-  GET  /healthz         GET /metrics
+  GET  /healthz         GET /metrics        GET /debug/flight
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -41,6 +49,7 @@ from .batcher import DeadlineExceeded, MicroBatcher, Overloaded
 from .executors import (
     BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
 )
+from .flight import FlightRecorder
 from .metrics import ServeMetrics
 
 from ..obs.logging import get_logger
@@ -58,12 +67,24 @@ class ServeApp:
                  default_timeout_s: float = 120.0,
                  cache_dir: str | None = None,
                  cache_max_bytes: int | None = 256 * 1024 * 1024,
-                 processes: int = 4, registry=None):
+                 processes: int = 4, registry=None,
+                 flight_records: int = 32,
+                 slo_p99_target_s: float = 2.0,
+                 slo_window_s: float = 300.0):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
         self.metrics = ServeMetrics(registry=registry)
         self.default_timeout_s = default_timeout_s
+        self.slo_p99_target_s = slo_p99_target_s
+        self.slo_window_s = slo_window_s
+        # flight recorder: listens on the PROCESS tracer (the serve
+        # request/batch traces record there), detached in close()
+        from .. import obs
+
+        self.flight = FlightRecorder(max_records=flight_records)
+        self._tracer = obs.get_tracer()
+        self._tracer.add_listener(self.flight.on_span)
         self.executors = {
             ex.kind: ex for ex in (
                 DepthExecutor(processes, self.metrics),
@@ -164,7 +185,31 @@ class ServeApp:
         return self.metrics.snapshot(
             queue_depth=self.batcher.queue_depth(),
             cache_stats=self.cache.stats() if self.cache else None,
+            slo=self.metrics.slo_snapshot(
+                p99_target_s=self.slo_p99_target_s,
+                window_s=self.slo_window_s),
         )
+
+    def metrics_prometheus(self) -> str:
+        """The same metrics state as Prometheus text exposition:
+        registry snapshot (SLO gauges refreshed first) plus the two
+        live values the JSON body carries outside the registry."""
+        from ..obs import prometheus
+
+        self.metrics.slo_snapshot(
+            p99_target_s=self.slo_p99_target_s,
+            window_s=self.slo_window_s)
+        snap = self.metrics.registry.snapshot()
+        snap["gauges"]["serve.uptime_s"] = round(
+            time.time() - self.metrics.started, 1)
+        snap["gauges"]["serve.queue_depth"] = \
+            self.batcher.queue_depth()
+        if self.cache:
+            for k, v in self.cache.stats().items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    snap["gauges"][f"serve.cache.{k}"] = v
+        return prometheus.render(snap)
 
     def warmup(self) -> float:
         """Bring the backend up and compile a minimal depth program so
@@ -187,6 +232,7 @@ class ServeApp:
     def close(self, drain: bool = True) -> None:
         self.draining = True
         self.batcher.close(drain=drain)
+        self._tracer.remove_listener(self.flight.on_span)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -197,9 +243,13 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("%s " + fmt, self.address_string(), *args)
 
     def _respond(self, code: int, body: dict) -> None:
-        data = json.dumps(body).encode()
+        self._respond_raw(code, json.dumps(body).encode(),
+                          "application/json")
+
+    def _respond_raw(self, code: int, data: bytes,
+                     content_type: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         # one request per connection: a lingering keep-alive socket
         # would pin its handler thread and stall the drain join
@@ -207,18 +257,44 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
         self.close_connection = True
-        self.app.metrics.inc(f"responses_total.{code}")
+        self.app.metrics.record_response(code)
 
     @property
     def app(self) -> ServeApp:
         return self.server.app
 
+    def _wants_prometheus(self, query: dict) -> bool:
+        """``?format=prom`` wins; otherwise Accept negotiation — a
+        client asking for text/plain (and not json) is a Prometheus
+        scraper. The JSON body stays the default (and byte-stable)."""
+        fmt = query.get("format", [""])[0]
+        if fmt:
+            return fmt in ("prom", "prometheus")
+        accept = self.headers.get("Accept", "")
+        return "text/plain" in accept and "json" not in accept
+
     def do_GET(self):  # noqa: N802 — http.server contract
-        if self.path == "/healthz":
+        u = urlparse(self.path)
+        if u.path == "/healthz":
             code, body = self.app.healthz()
             self._respond(code, body)
-        elif self.path == "/metrics":
-            self._respond(200, self.app.metrics_snapshot())
+        elif u.path == "/metrics":
+            if self._wants_prometheus(parse_qs(u.query)):
+                from ..obs.prometheus import CONTENT_TYPE
+
+                self._respond_raw(
+                    200, self.app.metrics_prometheus().encode(),
+                    CONTENT_TYPE)
+            else:
+                self._respond(200, self.app.metrics_snapshot())
+        elif u.path == "/debug/flight":
+            q = parse_qs(u.query)
+            try:
+                n = int(q["n"][0]) if "n" in q else None
+            except ValueError:
+                self._respond(400, {"error": "n must be an integer"})
+                return
+            self._respond(200, self.app.flight.to_dict(n))
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 

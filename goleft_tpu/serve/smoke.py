@@ -2,8 +2,11 @@
 
 Spawns a REAL ``goleft-tpu serve`` subprocess on an ephemeral port
 (scraping the printed listen line), posts one depth request through
-the client, verifies the response carries output, sends SIGTERM, and
-asserts a clean drain (exit 0). Run directly::
+the client, verifies the response carries output, checks the
+observability surface (the /metrics SLO block + Prometheus encoding,
+the flight recorder at /debug/flight, a SIGUSR1 flight dump that
+round-trips through ``json.load``), sends SIGTERM, and asserts a
+clean drain (exit 0). Run directly::
 
     python -m goleft_tpu.serve.smoke
 
@@ -60,9 +63,12 @@ def run_smoke(timeout_s: float = 120.0, verbose: bool = True) -> int:
     deadline = time.monotonic() + timeout_s
     with tempfile.TemporaryDirectory(prefix="goleft_smoke_") as d:
         bam, fai = _make_fixture(d)
+        flight_dir = os.path.join(d, "flight")
+        os.makedirs(flight_dir)
         child = subprocess.Popen(
             [sys.executable, "-m", "goleft_tpu", "serve", "--port",
-             "0", "--cache", os.path.join(d, "cache")],
+             "0", "--cache", os.path.join(d, "cache"),
+             "--flight-dir", flight_dir],
             stdout=subprocess.PIPE, text=True, env=env,
         )
         try:
@@ -83,6 +89,47 @@ def run_smoke(timeout_s: float = 120.0, verbose: bool = True) -> int:
                 print("serve-smoke: depth ok "
                       f"({r['shards']} shard(s)); batches="
                       f"{m['counters'].get('batches_total')}")
+            if "slo" not in m or "availability" not in m["slo"]:
+                raise RuntimeError(f"/metrics missing SLO block: "
+                                   f"{sorted(m)}")
+            prom = client.metrics_prometheus()
+            for needle in ("# TYPE serve_requests_total_depth "
+                           "counter",
+                           "# TYPE serve_slo_availability gauge"):
+                if needle not in prom:
+                    raise RuntimeError(
+                        f"prometheus body missing {needle!r}")
+            fl = client.flight()
+            roots = [rec["name"] for rec in fl["records"]]
+            if "request.depth" not in roots:
+                raise RuntimeError(
+                    f"/debug/flight has no request.depth tree "
+                    f"(roots: {roots})")
+            if verbose:
+                print(f"serve-smoke: observability ok (slo block, "
+                      f"prometheus body, {fl['count']} flight "
+                      "record(s))")
+            # SIGUSR1 → a timestamped dump file that parses
+            child.send_signal(signal.SIGUSR1)
+            dump = None
+            for _ in range(100):
+                found = sorted(os.listdir(flight_dir))
+                if found:
+                    dump = os.path.join(flight_dir, found[-1])
+                    break
+                time.sleep(0.1)
+            if dump is None:
+                raise RuntimeError("SIGUSR1 produced no flight dump")
+            import json
+
+            with open(dump) as fh:
+                doc = json.load(fh)
+            if not doc.get("records"):
+                raise RuntimeError(f"flight dump {dump} is empty")
+            if verbose:
+                print(f"serve-smoke: SIGUSR1 dump ok "
+                      f"({os.path.basename(dump)}, "
+                      f"{doc['count']} record(s))")
             child.send_signal(signal.SIGTERM)
             rc = child.wait(timeout=max(5.0,
                                         deadline - time.monotonic()))

@@ -1,0 +1,254 @@
+"""Serve flight recorder, SLO gauges, Prometheus /metrics encoding.
+
+The flight recorder is a tracer listener: these tests drive it with
+real spans on the process tracer (the serve request/batch kinds it
+watches), then through the HTTP surface (/debug/flight, /metrics
+content negotiation) without touching jax — ServeApp.handle records a
+request trace even for an unknown endpoint, which is exactly what a
+cheap integration test wants.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from goleft_tpu import obs
+from goleft_tpu.serve.flight import FlightRecorder
+from goleft_tpu.serve.metrics import ServeMetrics
+
+
+def _serve_trace(tracer, name="request.depth", kind="serve",
+                 children=("cache", "batcher")):
+    with tracer.trace(name, kind=kind, status=200):
+        for c in children:
+            with tracer.span(c, category="stage"):
+                pass
+
+
+# ---------------- recorder unit semantics ----------------
+
+
+def test_flight_records_span_tree_newest_first():
+    tracer = obs.get_tracer()
+    fr = FlightRecorder(max_records=4)
+    tracer.add_listener(fr.on_span)
+    try:
+        _serve_trace(tracer, "request.depth")
+        _serve_trace(tracer, "batch.depth", kind="serve-batch",
+                     children=("decode", "compute", "format"))
+    finally:
+        tracer.remove_listener(fr.on_span)
+    recs = fr.snapshot()
+    assert [r["name"] for r in recs] == ["batch.depth",
+                                        "request.depth"]
+    batch = recs[0]
+    assert [c["name"] for c in batch["children"]] == \
+        ["decode", "compute", "format"]
+    assert batch["span_count"] == 4
+    assert batch["trace_id"].startswith("serve-batch-")
+    assert batch["attrs"]["status"] == 200
+    assert all(c["duration_ms"] >= 0 for c in batch["children"])
+
+
+def test_flight_ignores_cli_traces_and_bounds_ring():
+    tracer = obs.get_tracer()
+    fr = FlightRecorder(max_records=3)
+    tracer.add_listener(fr.on_span)
+    try:
+        _serve_trace(tracer, "run.depth", kind="cli")  # not watched
+        for i in range(5):
+            _serve_trace(tracer, f"request.r{i}")
+    finally:
+        tracer.remove_listener(fr.on_span)
+    recs = fr.snapshot()
+    assert len(recs) == 3
+    assert fr.records_dropped == 2
+    assert [r["name"] for r in recs] == ["request.r4", "request.r3",
+                                        "request.r2"]
+    assert not any(r["name"] == "run.depth" for r in recs)
+
+
+def test_flight_per_trace_span_overflow_is_counted():
+    tracer = obs.get_tracer()
+    fr = FlightRecorder(max_records=2, max_spans_per_trace=3)
+    tracer.add_listener(fr.on_span)
+    try:
+        _serve_trace(tracer, "request.big",
+                     children=[f"s{i}" for i in range(10)])
+    finally:
+        tracer.remove_listener(fr.on_span)
+    (rec,) = fr.snapshot()
+    # 11 spans total, 3 buffered + the root always kept
+    assert rec["spans_dropped"] == 7
+    assert rec["span_count"] == 4
+    assert rec["name"] == "request.big"  # root survived overflow
+
+
+def test_flight_dump_round_trips(tmp_path):
+    tracer = obs.get_tracer()
+    fr = FlightRecorder()
+    tracer.add_listener(fr.on_span)
+    try:
+        _serve_trace(tracer)
+    finally:
+        tracer.remove_listener(fr.on_span)
+    p = fr.dump(str(tmp_path))
+    with open(p) as fh:
+        doc = json.load(fh)
+    assert doc["count"] == 1
+    assert doc["records"][0]["name"] == "request.depth"
+    assert doc["records"][0]["ts"]  # epoch-mapped ISO timestamp
+
+
+# ---------------- SLO gauges ----------------
+
+
+def test_slo_gauges_from_outcomes_and_latencies():
+    m = ServeMetrics()
+    for _ in range(8):
+        m.record_response(200)
+    m.record_response(500)
+    m.record_response(503)
+    m.observe_latency("depth", 0.5)
+    m.observe_latency("depth", 1.0)
+    slo = m.slo_snapshot(p99_target_s=2.0, window_s=300.0)
+    assert slo["window_requests"] == 10
+    assert slo["error_rate"] == pytest.approx(0.2)
+    assert slo["availability"] == pytest.approx(0.8)
+    assert slo["p99_latency_ratio"]["depth"] == pytest.approx(0.5)
+    # published into the registry as gauges (manifest/prom visible)
+    g = m.registry.snapshot()["gauges"]
+    assert g["serve.slo.availability"] == pytest.approx(0.8)
+    assert g["serve.slo.p99_latency_ratio.depth"] == \
+        pytest.approx(0.5)
+    # counters kept their historical names
+    c = m.registry.snapshot()["counters"]
+    assert c["serve.responses_total.200"] == 8
+    assert c["serve.responses_total.500"] == 1
+
+
+def test_slo_idle_daemon_is_available_not_undefined():
+    m = ServeMetrics()
+    slo = m.slo_snapshot()
+    assert slo["availability"] == 1.0 and slo["error_rate"] == 0.0
+    assert slo["window_requests"] == 0
+
+
+def test_snapshot_without_slo_is_unchanged_byte_stability():
+    """The satellite contract: the JSON /metrics body only grows the
+    slo block when the caller passes one — a plain ServeMetrics
+    snapshot stays byte-stable with the PR-3 shape."""
+    m = ServeMetrics()
+    m.inc("requests_total.depth")
+    assert "slo" not in m.snapshot(queue_depth=0)
+    assert "slo" in m.snapshot(queue_depth=0,
+                               slo=m.slo_snapshot())
+
+
+# ---------------- HTTP surface (no jax: unknown endpoint 404s) ------
+
+
+@pytest.fixture
+def light_server():
+    from goleft_tpu.serve.server import ServeApp, ServerThread
+
+    app = ServeApp(batch_window_s=0.0, max_batch=1)
+    with ServerThread(app) as url:
+        yield app, url
+
+
+def _get(url, accept=None):
+    req = urllib.request.Request(
+        url, headers={"Accept": accept} if accept else {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def test_debug_flight_endpoint_returns_recent_requests(light_server):
+    app, url = light_server
+    # 404s still open request traces — cheap flight records
+    for _ in range(3):
+        code, _ = app.handle("nope", {})
+        assert code == 404
+    status, _, body = _get(url + "/debug/flight")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["count"] >= 3
+    assert doc["records"][0]["name"] == "request.nope"
+    assert doc["records"][0]["attrs"]["status"] == 404
+    status, _, body = _get(url + "/debug/flight?n=2")
+    assert json.loads(body)["count"] == 2
+    status, _, body = _get(url + "/debug/flight?n=x")
+    assert status == 400
+
+
+def test_metrics_content_negotiation(light_server):
+    app, url = light_server
+    app.handle("nope", {})
+    # default: JSON, with the slo block present
+    status, hdrs, body = _get(url + "/metrics")
+    assert status == 200
+    assert hdrs["Content-Type"] == "application/json"
+    doc = json.loads(body)
+    assert "slo" in doc and "availability" in doc["slo"]
+    # ?format=prom → text exposition with TYPE/HELP lines
+    status, hdrs, body = _get(url + "/metrics?format=prom")
+    assert status == 200
+    assert hdrs["Content-Type"].startswith(
+        "text/plain; version=0.0.4")
+    # the JSON scrape above was counted: the counter families render
+    assert "# TYPE serve_responses_total_200 counter" in body
+    assert "# TYPE serve_slo_availability gauge" in body
+    assert "serve_queue_depth" in body
+    # Accept negotiation reaches the same encoding
+    status, hdrs, body = _get(url + "/metrics", accept="text/plain")
+    assert hdrs["Content-Type"].startswith("text/plain")
+    # a json Accept keeps JSON
+    status, hdrs, _ = _get(url + "/metrics",
+                           accept="application/json")
+    assert hdrs["Content-Type"] == "application/json"
+
+
+def test_flight_listener_detaches_on_close():
+    from goleft_tpu.serve.server import ServeApp
+
+    tracer = obs.get_tracer()
+    app = ServeApp(batch_window_s=0.0, max_batch=1)
+    app.handle("nope", {})
+    n = len(app.flight.snapshot())
+    assert n >= 1
+    app.close()
+    with tracer.trace("request.after", kind="serve"):
+        pass
+    assert len(app.flight.snapshot()) == n  # no longer listening
+
+
+def test_prometheus_render_is_deterministic_and_sanitized():
+    from goleft_tpu.obs import prometheus
+    from goleft_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("serve.requests_total.depth").inc(2)
+    reg.gauge("prefetch.queue_depth").set(3)
+    h = reg.histogram("serve.latency_s.depth")
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    text = prometheus.render(reg.snapshot())
+    assert text == prometheus.render(reg.snapshot())  # deterministic
+    assert "# HELP serve_requests_total_depth" in text
+    assert "# TYPE serve_requests_total_depth counter" in text
+    assert "serve_requests_total_depth 2" in text
+    assert "prefetch_queue_depth 3" in text
+    assert 'serve_latency_s_depth{quantile="0.5"} 0.2' in text
+    assert "serve_latency_s_depth_count 3" in text
+    assert "serve_latency_s_depth_sum" in text
+    # every emitted name is legal prometheus grammar
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert prometheus._NAME_OK.match(name), name

@@ -1,0 +1,358 @@
+"""Perf ledger + regression sentinel (goleft_tpu.obs.ledger/sentinel).
+
+Pins the PR-4 contracts: ingestion of the committed BENCH_r*.json
+round artifacts (truncated tails and all), per-entry stale/carryover
+derivation, the sentinel's classification table
+(improved/flat/regressed/stale-evidence/new/info — including the
+host-vs-device provenance mismatch), the device-evidence gap bit, and
+the ``perf check`` gate end to end: the committed history passes,
+a synthetically injected 2x slowdown fails, ``--strict`` fails on the
+carryover-only device claims. Plus the manifest 1.x forward-compat
+satellite the ledger's manifest ingestion depends on.
+"""
+
+import json
+import os
+
+import pytest
+
+from goleft_tpu.obs import ledger, sentinel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _committed_records():
+    recs = []
+    srcs = ledger.discover_sources(REPO)
+    for p in srcs["rounds"]:
+        recs.extend(ledger.parse_round_file(p))
+    for p in srcs["lastgood"]:
+        recs.extend(ledger.parse_lastgood(p))
+    return recs
+
+
+# ---------------- ledger ingestion of the committed history ----------
+
+
+def test_classify_platform():
+    assert ledger.classify_platform("tpu") == "device"
+    assert ledger.classify_platform("TPU v5 lite0") == "device"
+    assert ledger.classify_platform(
+        "host (decode+reduce is pure host work)") == "host"
+    assert ledger.classify_platform("cpu (host-only mode)") == "host"
+    assert ledger.classify_platform(None) == "unknown"
+    assert ledger.classify_platform("unavailable") == "unknown"
+
+
+def test_committed_rounds_parse_one_record_per_entry():
+    recs = _committed_records()
+    by_round = {}
+    for r in recs:
+        by_round.setdefault(r["round_label"], []).append(r)
+    # every committed round artifact yields records, truncation
+    # notwithstanding
+    for label in ("r01", "r02", "r03", "r04", "r05", "lastgood"):
+        assert by_round.get(label), f"no records from {label}"
+    # the headline series is continuous across rounds 2-5 and pinned
+    # host (cohort e2e is host work by construction)
+    heads = [r for r in recs
+             if r["entry"] == "cohort_depth_e2e_gbases_per_sec"]
+    assert [h["round"] for h in heads] == [2, 3, 4, 5]
+    assert all(h["provenance"] == "host" for h in heads)
+    assert all(not h["stale"] for h in heads)
+
+
+def test_committed_carryover_entries_are_stale_device():
+    """The round-5 device_lastgood block and the lastgood pin are the
+    device-claiming carryover entries in the committed artifacts —
+    both must be flagged stale, with device provenance."""
+    recs = _committed_records()
+    r05_kern = [r for r in recs if r["round_label"] == "r05"
+                and r["entry"] == "device_kernels"]
+    assert len(r05_kern) == 1
+    assert r05_kern[0]["stale"] and r05_kern[0]["kind"] == "carryover"
+    assert r05_kern[0]["provenance"] == "device"
+    pin = [r for r in recs if r["round_label"] == "lastgood"]
+    assert pin and all(p["stale"] and p["provenance"] == "device"
+                       for p in pin)
+    # round 2's kernel numbers were fresh (probe succeeded): same
+    # entry, NOT stale — the stale bit is per-round, not per-entry
+    r02_kern = [r for r in recs if r["round_label"] == "r02"
+                and r["entry"] == "device_kernels"]
+    assert len(r02_kern) == 1 and not r02_kern[0]["stale"]
+
+
+def test_ledger_ingest_is_idempotent_append_only(tmp_path):
+    lp = str(tmp_path / "ledger.jsonl")
+    added1, total1 = ledger.ingest(root=REPO, ledger_path=lp)
+    assert added1 == total1 > 0
+    added2, total2 = ledger.ingest(root=REPO, ledger_path=lp)
+    assert added2 == 0 and total2 == total1
+    recs = ledger.read_ledger(lp)
+    assert len(recs) == total1
+    assert all(r["schema"] == ledger.LEDGER_SCHEMA for r in recs)
+
+
+def test_corrupt_ledger_line_raises_with_location(tmp_path):
+    lp = tmp_path / "bad.jsonl"
+    lp.write_text('{"entry": "a"}\nnot json\n')
+    with pytest.raises(ValueError, match="bad.jsonl:2"):
+        ledger.read_ledger(str(lp))
+
+
+# ---------------- sentinel classification: table-driven ----------
+
+
+def _rec(round_num, entry, metrics, platform="host", stale=False):
+    return ledger.make_record(
+        source="t", round_label=f"r{round_num:02d}", entry=entry,
+        kind="bench", metrics=metrics, round_num=round_num,
+        platform=platform, stale=stale)
+
+
+@pytest.mark.parametrize("case", [
+    # (name, history values, latest value, platform spec, expect)
+    ("improved_throughput",
+     [1.0, 1.05, 0.95], 2.0, None, "improved"),
+    ("flat_within_floor",
+     [1.0, 1.05, 0.95], 1.1, None, "flat"),
+    ("regressed_throughput",
+     [1.0, 1.05, 0.95], 0.4, None, "regressed"),
+    ("new_no_history", [], 1.0, None, "new"),
+])
+def test_sentinel_throughput_classification(case):
+    name, history, latest, _plat, want = case
+    recs = [_rec(i + 1, "e", {"x_gbases_per_sec": v})
+            for i, v in enumerate(history)]
+    recs.append(_rec(len(history) + 1, "e",
+                     {"x_gbases_per_sec": latest}))
+    a = sentinel.analyze(recs)
+    (res,) = a["results"]
+    assert res["status"] == want, res
+
+
+def test_sentinel_lower_is_better_direction():
+    recs = [_rec(1, "e", {"wall_seconds_warm": 1.0}),
+            _rec(2, "e", {"wall_seconds_warm": 1.02}),
+            _rec(3, "e", {"wall_seconds_warm": 2.5})]
+    a = sentinel.analyze(recs)
+    (res,) = a["results"]
+    assert res["direction"] == "lower"
+    assert res["status"] == "regressed"
+    # and the same movement downward is an improvement
+    recs[-1]["metrics"]["wall_seconds_warm"] = 0.4
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["status"] == "improved"
+
+
+def test_sentinel_stale_evidence_beats_comparison():
+    """A stale (carryover) record is never classified against the
+    baseline — even when its value would look like a regression."""
+    recs = [_rec(1, "k", {"r_gbases_per_sec": 50.0},
+                 platform="tpu"),
+            _rec(2, "k", {"r_gbases_per_sec": 10.0},
+                 platform="tpu", stale=True)]
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["status"] == "stale-evidence"
+
+
+def test_sentinel_host_device_mismatch_is_not_compared():
+    """Provenance mismatch: a fresh device number after host-only
+    history must NOT be judged against the host baseline (it gets
+    'new'), and a host number never uses device history."""
+    recs = [_rec(1, "e", {"x_gbases_per_sec": 0.5}, platform="host"),
+            _rec(2, "e", {"x_gbases_per_sec": 0.55},
+                 platform="host"),
+            _rec(3, "e", {"x_gbases_per_sec": 50.0},
+                 platform="tpu")]
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["status"] == "new"          # not "improved" vs host
+    # reverse: host latest, device history only
+    recs = [_rec(1, "e", {"x_gbases_per_sec": 50.0},
+                 platform="tpu"),
+            _rec(2, "e", {"x_gbases_per_sec": 0.5},
+                 platform="host")]
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["status"] == "new"          # not a 100x "regression"
+
+
+def test_sentinel_info_metrics_never_gate():
+    recs = [_rec(1, "e", {"vs_baseline": 100.0}),
+            _rec(2, "e", {"vs_baseline": 2.0})]
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["status"] == "info"
+    assert sentinel.check(sentinel.analyze(recs))[0] == 0
+
+
+def test_sentinel_noise_aware_threshold_scales_with_history():
+    """A historically noisy series needs a bigger delta to alarm than
+    the floor: ±40% wobble must not flag a 30% dip."""
+    recs = [_rec(i + 1, "e", {"x_gbases_per_sec": v})
+            for i, v in enumerate([1.0, 1.8, 0.6, 1.4])]
+    recs.append(_rec(5, "e", {"x_gbases_per_sec": 0.84}))  # -30%
+    (res,) = sentinel.analyze(recs)["results"]
+    assert res["threshold"] > sentinel.DEFAULT_FLOOR
+    assert res["status"] == "flat"
+
+
+def test_device_evidence_gap_bit():
+    recs = [_rec(1, "k", {"r_gbases_per_sec": 50.0}, platform="tpu"),
+            _rec(2, "k", {"r_gbases_per_sec": 50.0}, platform="tpu",
+                 stale=True),
+            _rec(2, "h", {"h_gbases_per_sec": 0.5},
+                 platform="host")]
+    a = sentinel.analyze(recs)
+    assert a["device_evidence_gap"] is True
+    code, fails = sentinel.check(a)
+    assert code == 0                      # default: warn, don't fail
+    code, fails = sentinel.check(a, strict=True)
+    assert code == 1 and any("carryover" in f for f in fails)
+    # a fresh device record closes the gap
+    recs.append(_rec(2, "k2", {"r2_gbases_per_sec": 51.0},
+                     platform="tpu"))
+    assert sentinel.analyze(recs)["device_evidence_gap"] is False
+
+
+# ---------------- perf check e2e: committed history + injection -----
+
+
+def test_perf_check_passes_committed_history_and_flags_carryover(
+        tmp_path, capsys):
+    from goleft_tpu.commands.perf import main as perf_main
+
+    lp = str(tmp_path / "ledger.jsonl")
+    assert perf_main(["ingest", "--root", REPO, "--ledger", lp]) == 0
+    assert perf_main(["check", "--root", REPO, "--ledger", lp]) == 0
+    out = capsys.readouterr()
+    assert "OK" in out.out
+    assert "carryover" in out.err         # the gap warning is loud
+    # the carryover entries classify as stale-evidence, not regressed
+    a = sentinel.analyze(ledger.read_ledger(lp))
+    kern = [r for r in a["results"] if r["entry"] == "device_kernels"]
+    assert kern and all(r["status"] == "stale-evidence" for r in kern)
+    assert not any(r["status"] == "regressed" for r in a["results"])
+    # strict mode turns the device-evidence gap into a failure
+    assert perf_main(["check", "--root", REPO, "--ledger", lp,
+                      "--strict"]) == 1
+
+
+def test_perf_check_fails_on_injected_2x_regression(tmp_path,
+                                                    capsys):
+    """Acceptance: halve every fresh metric of the newest round in a
+    tmp ledger copy -> perf check exits nonzero naming the regression
+    (while the untouched committed history passes — previous test)."""
+    from goleft_tpu.commands.perf import main as perf_main
+
+    lp = str(tmp_path / "ledger.jsonl")
+    perf_main(["ingest", "--root", REPO, "--ledger", lp])
+    recs = ledger.read_ledger(lp)
+    newest = max(r["round"] for r in recs
+                 if isinstance(r["round"], int))
+    for r in recs:
+        if r["round"] == newest and not r["stale"]:
+            r["metrics"] = {k: v / 2 for k, v in r["metrics"].items()}
+    os.remove(lp)
+    ledger.append_records(lp, recs)
+    assert perf_main(["check", "--root", REPO, "--ledger", lp]) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSED" in err
+    assert "cohort_depth_e2e_gbases_per_sec" in err
+
+
+def test_perf_report_renders_sparkline_table(tmp_path, capsys):
+    from goleft_tpu.commands.perf import main as perf_main
+
+    lp = str(tmp_path / "ledger.jsonl")
+    perf_main(["ingest", "--root", REPO, "--ledger", lp])
+    assert perf_main(["report", "--root", REPO, "--ledger", lp]) == 0
+    out = capsys.readouterr().out
+    assert "stale-evidence" in out
+    assert any(ch in out for ch in sentinel._SPARK)
+    capsys.readouterr()
+    assert perf_main(["report", "--root", REPO, "--ledger", lp,
+                      "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["round"] == 5 and doc["results"]
+
+
+# ---------------- manifest ingestion + 1.x forward-compat ----------
+
+
+def _write_manifest(tmp_path, schema=None):
+    from goleft_tpu.obs.manifest import build_manifest
+    from goleft_tpu.obs.metrics import MetricsRegistry
+    from goleft_tpu.obs.tracing import Tracer
+
+    reg = MetricsRegistry()
+    reg.counter("xla.compiles_total").inc(3)
+    tracer = Tracer()
+    with tracer.trace("run.depth", kind="cli"):
+        pass
+    doc = build_manifest(tracer=tracer, registry=reg,
+                         argv=["goleft-tpu depth"],
+                         extra={"command": "depth"})
+    if schema is not None:
+        doc["schema"] = schema
+    p = str(tmp_path / "run.json")
+    with open(p, "w") as fh:
+        json.dump(doc, fh)
+    return p
+
+
+def test_manifest_minor_revisions_load_majors_rejected(tmp_path):
+    from goleft_tpu.obs.manifest import load_manifest
+
+    # current writer version and a FUTURE minor both load
+    assert load_manifest(_write_manifest(tmp_path))
+    assert load_manifest(_write_manifest(
+        tmp_path, schema="goleft-tpu.run-manifest/1.9"))
+    assert load_manifest(_write_manifest(
+        tmp_path, schema="goleft-tpu.run-manifest/1"))
+    with pytest.raises(ValueError, match="major version 2"):
+        load_manifest(_write_manifest(
+            tmp_path, schema="goleft-tpu.run-manifest/2.0"))
+    with pytest.raises(ValueError, match="not a run-manifest"):
+        load_manifest(_write_manifest(tmp_path, schema="bogus/1"))
+
+
+def test_manifest_ingests_into_ledger(tmp_path):
+    p = _write_manifest(tmp_path)
+    (rec,) = ledger.parse_manifest(p)
+    assert rec["entry"] == "manifest.depth"
+    assert rec["metrics"]["counters.xla.compiles_total"] == 3
+    assert "spans.run.depth.seconds" in rec["metrics"]
+    assert rec["provenance"] in ("host", "device")  # live backend
+    # and through the CLI: --manifest attaches it to the ledger
+    from goleft_tpu.commands.perf import main as perf_main
+
+    lp = str(tmp_path / "ledger.jsonl")
+    assert perf_main(["ingest", "--root", REPO, "--ledger", lp,
+                      "--manifest", p]) == 0
+    assert any(r["kind"] == "manifest"
+               for r in ledger.read_ledger(lp))
+
+
+def test_bench_live_run_records_shape():
+    """bench.py's auto-append path: details+headline -> live records
+    with per-entry platform pinning intact."""
+    details = {
+        "cohort_e2e": {"gbases_per_sec": 0.5,
+                       "platform": "host (pure host work)"},
+        "device_lastgood": {
+            "stale": True,
+            "provenance": {"platform": "tpu", "ts": None},
+            "entries": {"device_kernels": {
+                "platform": "tpu",
+                "kernel_device_resident_gbases_per_sec": 51.7}}},
+        "device_probe": {"attempts": [{"ok": False}]},
+    }
+    headline = {"metric": "cohort_depth_e2e_gbases_per_sec",
+                "value": 0.5, "vs_baseline": 18.0}
+    recs = ledger.live_run_records(details, headline)
+    by_entry = {r["entry"]: r for r in recs}
+    assert "device_probe" not in by_entry
+    assert by_entry["cohort_e2e"]["provenance"] == "host"
+    assert by_entry["device_kernels"]["stale"] is True
+    head = by_entry["cohort_depth_e2e_gbases_per_sec"]
+    assert head["kind"] == "live" and head["metrics"]["value"] == 0.5
+    assert all(r["round_label"].startswith("live-") for r in recs)
