@@ -6,7 +6,7 @@ CXX ?= g++
 SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
-        serve-smoke obs-smoke perf-gate clean
+        serve-smoke obs-smoke chaos-smoke perf-gate clean
 
 native: build/libgoleftio.so
 
@@ -53,6 +53,17 @@ perf-gate:
 # with required provenance keys). Host-pinned like serve-smoke.
 obs-smoke:
 	python -m goleft_tpu.obs.smoke
+
+# resilience end-to-end: a cohortdepth subprocess is SIGKILLed
+# mid-flight by a deterministic injected fault, resumed via
+# --checkpoint-dir/--resume to byte-identical output (journal replay
+# proven through the run manifest's checkpoint counters), a
+# permanently-corrupt sample is quarantined (exit 3, partial cohort
+# byte-identical to a run without it), and the happy-path
+# checkpointing overhead is held to the <=5% budget. Host-pinned like
+# the other smokes.
+chaos-smoke:
+	python -m goleft_tpu.resilience.smoke
 
 # run the io test files with the AddressSanitized library preloaded.
 # Tests that execute XLA are excluded: ASan's allocator interposition is

@@ -1457,7 +1457,22 @@ def host_suite(quick: bool, emit=None) -> dict:
         _put("serve_throughput", _serve_throughput_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("serve_throughput", {"error": repr(e)})
+    try:
+        _put("cohort_resume_overhead", _resume_overhead_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("cohort_resume_overhead", {"error": repr(e)})
     return out
+
+
+def _resume_overhead_entry(quick: bool) -> dict:
+    """Checkpointing's happy-path cost (resilience subsystem): the
+    full run_cohortdepth path plain vs --checkpoint-dir vs --resume
+    replay on a synthetic multi-region cohort. The ledger tracks
+    ``overhead_frac`` round over round; ``make chaos-smoke`` enforces
+    the <=5% budget."""
+    from goleft_tpu.resilience.overhead import measure_resume_overhead
+
+    return measure_resume_overhead(quick=quick)
 
 
 def _serve_throughput_entry(quick: bool) -> dict:

@@ -17,6 +17,10 @@ Global observability flags — valid before OR after the subcommand name
   -v / -vv            shorthand for --log-level info / debug
                       (``goleft-tpu -v`` as the sole argument still
                       prints the version, as it always has)
+  --inject-faults S   install a deterministic fault schedule
+                      (resilience/faults.py grammar; also settable
+                      via GOLEFT_TPU_FAULTS) — chaos testing for any
+                      command
 
 Every invocation runs under a run-scoped trace: the ``run.<cmd>`` root
 span parents the pipeline stages, whichever threads record them.
@@ -83,7 +87,8 @@ PROGS = {
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",
                 "--metrics-out": "metrics_out",
-                "--log-level": "log_level"}
+                "--log-level": "log_level",
+                "--inject-faults": "inject_faults"}
 
 
 def _extract_global_flags(argv: list[str]):
@@ -95,7 +100,7 @@ def _extract_global_flags(argv: list[str]):
     == version case before calling this.
     """
     opts = {"trace_out": None, "metrics_out": None, "log_level": None,
-            "verbose": 0}
+            "inject_faults": None, "verbose": 0}
     rest: list[str] = []
     i = 0
     while i < len(argv):
@@ -127,6 +132,10 @@ def _extract_global_flags(argv: list[str]):
         from .obs.logging import parse_level
 
         parse_level(opts["log_level"])  # fail fast on a bad level
+    if opts["inject_faults"] is not None:
+        from .resilience.faults import parse_faults
+
+        parse_faults(opts["inject_faults"])  # fail fast on a bad spec
     return opts, rest
 
 
@@ -145,6 +154,8 @@ def usage() -> str:
         "+ metrics)",
         "  --log-level LEVEL   debug|info|warning|error",
         "  -v / -vv            info / debug logging",
+        "  --inject-faults S   deterministic fault schedule "
+        "(docs/resilience.md; e.g. shard:after=3:kill)",
     ]
     return "\n".join(lines)
 
@@ -227,6 +238,10 @@ def main(argv: list[str] | None = None) -> int:
         "debug" if gopts["verbose"] >= 2
         else "info" if gopts["verbose"] else "warning")
     obs.configure_logging(level)
+    if gopts["inject_faults"]:
+        from .resilience import faults
+
+        faults.install(gopts["inject_faults"])
     if gopts["trace_out"]:
         # a trace artifact without honest per-dispatch device time is
         # half an artifact: --trace-out implies device-event fencing

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import sys
 
 import jax
@@ -83,6 +84,9 @@ def cohort_matrix_blocks(
     bed: str | None = None,
     prefetch_depth: int = 0,
     stage_timer=None,
+    checkpoint=None,
+    quarantine=None,
+    policy=None,
 ):
     """(sample_names, total_windows, block generator) for the cohort
     depth matrix. ``bed`` restricts to the file's regions (the cohort
@@ -117,6 +121,25 @@ def cohort_matrix_blocks(
     being computed, with per-stage decode/stage/transfer/compute spans
     recorded into ``stage_timer`` (a utils.profiling.StageTimer).
     ``0`` is today's serial path; both produce identical matrices.
+
+    Resilience (goleft_tpu/resilience/, all optional):
+      - ``checkpoint`` (CheckpointStore): each region's per-sample
+        int64 window-sum columns are committed atomically after the
+        region computes, keyed by (file_key(bam), window, mapq,
+        region) — a stale input invalidates only its own shards. A
+        region whose every sample column is already committed is
+        *resumed*: no decode, no compute, the block re-emits from the
+        store byte-identically (counted in
+        ``checkpoint.shards_resumed_total``). Works identically under
+        every engine/prefetch variant because the skip happens at the
+        region list.
+      - ``quarantine`` + ``policy`` (Quarantine, RetryPolicy): each
+        per-sample decode/reduce runs under the policy; a sample
+        failing at OPEN (corrupt file/index) is quarantined before any
+        output and its column disappears from the matrix, a sample
+        failing permanently mid-run is quarantined and zero-fills its
+        remaining shards. Without a quarantine, failures raise as
+        before.
     """
     import concurrent.futures as cf
     import os
@@ -144,6 +167,7 @@ def cohort_matrix_blocks(
     handles = []
     bais = []
     names = []
+    bam_paths = []
 
     def load(b):
         # lazy mmap-backed handles: residency scales with the shard
@@ -155,11 +179,40 @@ def cohort_matrix_blocks(
             b[:-4] + ".bai"
         return h, read_bai(bai_p), get_short_name(b)
 
+    def _fallback_name(b):
+        base = b.rsplit("/", 1)[-1]
+        return base.rsplit(".", 1)[0]
+
     with cf.ThreadPoolExecutor(max_workers=processes) as ex:
-        for h, bai, nm in ex.map(load, bams):
-            handles.append(h)
-            bais.append(bai)
-            names.append(nm)
+        if quarantine is None:
+            for b, (h, bai, nm) in zip(bams, ex.map(load, bams)):
+                handles.append(h)
+                bais.append(bai)
+                names.append(nm)
+                bam_paths.append(b)
+        else:
+            # open-phase quarantine: a sample whose file/index cannot
+            # even be opened is dropped BEFORE any output — the run
+            # proceeds exactly as if it had not been given that BAM
+            futs = [ex.submit(load, b) for b in bams]
+            for b, f in zip(bams, futs):
+                try:
+                    h, bai, nm = f.result()
+                except (Exception, SystemExit) as e:  # noqa: BLE001
+                    quarantine.add(("open", b), _fallback_name(b), b,
+                                   e, classification="permanent",
+                                   phase="open")
+                    continue
+                handles.append(h)
+                bais.append(bai)
+                names.append(nm)
+                bam_paths.append(b)
+            if not handles:
+                raise SystemExit(
+                    "cohortdepth: every input failed to open — "
+                    + "; ".join(
+                        f"{e['source']}: {e['error']}"
+                        for e in quarantine.summary()["quarantined"]))
     max_span = max(e - (s // window) * window for _, s, e in regions)
     length = (max_span + window - 1) // window * window
     cap = np.int32(DEPTH_CAP_EXTRA)
@@ -202,48 +255,82 @@ def cohort_matrix_blocks(
             sharding = NamedSharding(mesh, P("data", None))
             S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 
+    def _guard_sample(i, key, thunk, fallback):
+        """Per-sample resilience boundary: retry under the policy,
+        quarantine on exhaustion (zero-filling via ``fallback``),
+        transparent when the resilience layer is off."""
+        if quarantine is not None and i in quarantine:
+            return fallback()
+        if policy is None:
+            return thunk()
+        from ..resilience.policy import RetriesExhausted
+
+        try:
+            val, _ = policy.call(key, thunk)
+            return val
+        except RetriesExhausted as rx:
+            if quarantine is None:
+                raise rx.cause from rx
+            quarantine.add(i, names[i], bam_paths[i], rx.cause,
+                           rx.attempts, rx.classification)
+            return fallback()
+
     def decode(args):
         """(seg_start, seg_end) already filtered/clipped for the device
         segment path — the ONE shared decode helper depth/multidepth
         use (BamFile streams through the C walk; CRAM falls back to
         columns + the shared filter/clip)."""
-        h, bai, tid, s, e = args
-        return _decode_shard_segments(h, bai, tid, s, e, mapq)
+        i, h, bai, tid, s, e = args
+        empty = np.zeros(0, np.int32)
+        return _guard_sample(
+            i, (names[i], s, e),
+            lambda: _decode_shard_segments(h, bai, tid, s, e, mapq),
+            lambda: (empty, empty))
 
     def submit_decodes(ex, c, s, e):
         return [
-            ex.submit(decode, (h, b, tm.get(c, -1), s, e))
-            for h, b, tm in zip(handles, bais, tid_maps)
+            ex.submit(decode, (i, h, b, tm.get(c, -1), s, e))
+            for i, (h, b, tm) in enumerate(zip(handles, bais,
+                                               tid_maps))
         ]
 
     # hybrid engine: fused C++ decode+reduce per (sample, region); one
     # thread-local delta scratch per worker
     _tl = threading.local()
 
-    def reduce_task(h, bai, tid, s, e, w0, length_r):
+    def reduce_task(i, h, bai, tid, s, e, w0, length_r):
         n_win_r = length_r // window
-        if tid < 0:
+
+        def fallback():
             return np.zeros(n_win_r, np.int64)
-        if bai is None:  # CRAM handle: .crai-driven access inside
-            return h.window_reduce(tid, s, e, w0, length_r, window,
-                                   int(cap), mapq, 0x704)
-        voff = query_voffset(bai, tid, s)
-        if voff is None:
-            return np.zeros(n_win_r, np.int64)
-        # no scratch passed: the lean streaming path needs none, and the
-        # rare dense fallback (pileups past depth_cap) allocates its own
-        return h.window_reduce(
-            tid, s, e, w0, length_r, window, int(cap), mapq, 0x704,
-            voffset=voff,
-        )
+
+        def body():
+            if tid < 0:
+                return fallback()
+            if bai is None:  # CRAM handle: .crai-driven access inside
+                return h.window_reduce(tid, s, e, w0, length_r, window,
+                                       int(cap), mapq, 0x704)
+            voff = query_voffset(bai, tid, s)
+            if voff is None:
+                return fallback()
+            # no scratch passed: the lean streaming path needs none,
+            # and the rare dense fallback (pileups past depth_cap)
+            # allocates its own
+            return h.window_reduce(
+                tid, s, e, w0, length_r, window, int(cap), mapq,
+                0x704, voffset=voff,
+            )
+
+        return _guard_sample(i, (names[i], s, e), body, fallback)
 
     def submit_reduces(ex, c, s, e):
         w0 = s // window * window
         length_r = ((e - w0) + window - 1) // window * window
         return [
-            ex.submit(reduce_task, h, b, tm.get(c, -1), s, e, w0,
+            ex.submit(reduce_task, i, h, b, tm.get(c, -1), s, e, w0,
                       length_r)
-            for h, b, tm in zip(handles, bais, tid_maps)
+            for i, (h, b, tm) in enumerate(zip(handles, bais,
+                                               tid_maps))
         ]
 
     def emit_block(c, s, e, sums):
@@ -255,25 +342,48 @@ def cohort_matrix_blocks(
         vals = (0.5 + means).astype(np.int64)
         return c, starts, ends, vals
 
+    # ---- checkpoint keying: content identity per (sample, region).
+    # A region whose every sample column is committed is skipped
+    # entirely (no decode, no compute) — regardless of engine or
+    # prefetch variant, because the skip removes it from the region
+    # list the generators see.
+    resumed: set = set()
+    region_keys = None
+    if checkpoint is not None:
+        from ..parallel.scheduler import file_key
+
+        fkeys = [file_key(b) for b in bam_paths]
+
+        def region_keys(r):  # noqa: F811 — the real binding
+            return [("cohortdepth", fk, window, mapq, tuple(r))
+                    for fk in fkeys]
+
+        for r in regions:
+            if all(checkpoint.has(k) for k in region_keys(r)):
+                resumed.add(tuple(r))
+    compute_regions = [r for r in regions if tuple(r) not in resumed]
+
     def blocks_hybrid():
         if processes <= 1 or effective_cores() <= 1:
             # single core: thread churn only costs (the native calls
             # release the GIL but there is no second core to take them)
-            for c, s, e in regions:
+            for c, s, e in compute_regions:
                 w0 = s // window * window
                 length_r = ((e - w0) + window - 1) // window * window
                 sums = np.stack([
-                    reduce_task(h, b, tm.get(c, -1), s, e, w0, length_r)
-                    for h, b, tm in zip(handles, bais, tid_maps)
+                    reduce_task(i, h, b, tm.get(c, -1), s, e, w0,
+                                length_r)
+                    for i, (h, b, tm) in enumerate(zip(handles, bais,
+                                                       tid_maps))
                 ])
                 yield emit_block(c, s, e, sums)
             return
         with cf.ThreadPoolExecutor(max_workers=processes) as ex:
-            pending = submit_reduces(ex, *regions[0])
-            for ri, (c, s, e) in enumerate(regions):
+            pending = submit_reduces(ex, *compute_regions[0])
+            for ri, (c, s, e) in enumerate(compute_regions):
                 sums = np.stack([f.result() for f in pending])
-                if ri + 1 < len(regions):
-                    pending = submit_reduces(ex, *regions[ri + 1])
+                if ri + 1 < len(compute_regions):
+                    pending = submit_reduces(ex, *compute_regions[ri + 1])
                 yield emit_block(c, s, e, sums)
 
     def pack_segblock(segs):
@@ -305,11 +415,11 @@ def cohort_matrix_blocks(
         with cf.ThreadPoolExecutor(max_workers=processes) as ex:
             # double-buffer: while the device chews shard k, threads
             # decode shard k+1 (native decode releases the GIL)
-            pending = submit_decodes(ex, *regions[0])
-            for ri, (c, s, e) in enumerate(regions):
+            pending = submit_decodes(ex, *compute_regions[0])
+            for ri, (c, s, e) in enumerate(compute_regions):
                 segs = [f.result() for f in pending]
-                if ri + 1 < len(regions):
-                    pending = submit_decodes(ex, *regions[ri + 1])
+                if ri + 1 < len(compute_regions):
+                    pending = submit_decodes(ex, *compute_regions[ri + 1])
                 args = pack_segblock(segs)
                 if sharding is not None:
                     args = tuple(jax.device_put(a, sharding) for a in args)
@@ -327,8 +437,9 @@ def cohort_matrix_blocks(
     def produce_device(region):
         c, s, e = region
         with timer.stage("decode"):
-            segs = [decode((h, b2, tm.get(c, -1), s, e))
-                    for h, b2, tm in zip(handles, bais, tid_maps)]
+            segs = [decode((i, h, b2, tm.get(c, -1), s, e))
+                    for i, (h, b2, tm) in enumerate(zip(handles, bais,
+                                                        tid_maps))]
         with timer.stage("stage"):
             return pack_segblock(segs)
 
@@ -343,7 +454,7 @@ def cohort_matrix_blocks(
     def blocks_prefetched():
         from ..parallel.prefetch import ChunkPrefetcher
 
-        with ChunkPrefetcher(regions, produce_device,
+        with ChunkPrefetcher(compute_regions, produce_device,
                              depth=prefetch_depth,
                              transfer=transfer_device,
                              processes=processes) as pf:
@@ -358,14 +469,16 @@ def cohort_matrix_blocks(
         length_r = ((e - w0) + window - 1) // window * window
         with timer.stage("decode"):
             return np.stack([
-                reduce_task(h, b2, tm.get(c, -1), s, e, w0, length_r)
-                for h, b2, tm in zip(handles, bais, tid_maps)
+                reduce_task(i, h, b2, tm.get(c, -1), s, e, w0,
+                            length_r)
+                for i, (h, b2, tm) in enumerate(zip(handles, bais,
+                                                    tid_maps))
             ])
 
     def blocks_hybrid_prefetched():
         from ..parallel.prefetch import ChunkPrefetcher
 
-        with ChunkPrefetcher(regions, produce_hybrid,
+        with ChunkPrefetcher(compute_regions, produce_hybrid,
                              depth=prefetch_depth,
                              processes=processes) as pf:
             for ch in pf:
@@ -382,6 +495,36 @@ def cohort_matrix_blocks(
                else blocks_prefetched())
     else:
         gen = blocks_hybrid() if engine == "hybrid" else blocks()
+
+    from ..resilience import faults as _faults
+
+    def _with_resilience(inner):
+        """Interleave resumed blocks (from the checkpoint store, in
+        region order) with freshly computed ones, committing each
+        computed region's per-sample columns in one journal commit.
+        The 'shard' fault site fires per computed region — exactly
+        between journal commits, which is what the chaos smoke's
+        mid-flight kill exercises."""
+        it = iter(inner)
+        for r in regions:
+            c, s, e = r
+            if tuple(r) in resumed:
+                cols = [checkpoint.get(k) for k in region_keys(r)]
+                starts, ends, _, _ = window_bounds(s, e, window)
+                yield c, starts, ends, np.stack(cols)
+                continue
+            _faults.maybe_fail("shard", tuple(r))
+            blk = next(it)
+            if checkpoint is not None:
+                vals = blk[3]
+                checkpoint.put_many(
+                    (k, vals[i])
+                    for i, k in enumerate(region_keys(r))
+                    if quarantine is None or i not in quarantine)
+            yield blk
+
+    if checkpoint is not None or _faults.get_plan() is not None:
+        gen = _with_resilience(gen)
     return names, total_windows, gen
 
 
@@ -398,7 +541,14 @@ def run_cohortdepth(
     bed: str | None = None,
     prefetch_depth: int = 0,
     stage_timer=None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
+    resilient: bool = True,
 ):
+    """Returns the process exit code: 0 on a clean run, 3 when the
+    cohort completed degraded (one or more samples quarantined — the
+    partial matrix was written and the quarantine manifest records
+    who/why)."""
     out = out or sys.stdout
     if jax.process_count() > 1:
         # multi-host world (mesh.init_distributed): samples shard
@@ -429,28 +579,56 @@ def run_cohortdepth(
                     lo = hi
 
         blocks = chrom_blocks()
+        quarantine = checkpoint = None
     else:
+        from .. import resilience
+        from ..resilience import CheckpointStore, Quarantine, \
+            RetryPolicy
+
+        # the multi-host path above runs without the resilience layer
+        # (collectives make per-sample isolation a different problem);
+        # the single-host flagship path gets quarantine + retry by
+        # default and checkpointing when asked
+        quarantine = Quarantine() if resilient else None
+        policy = RetryPolicy() if resilient else None
+        checkpoint = None
+        if checkpoint_dir:
+            checkpoint = CheckpointStore(checkpoint_dir, resume=resume)
+        resilience.set_run_state(quarantine=quarantine,
+                                 checkpoint=checkpoint)
         names, _, blocks = cohort_matrix_blocks(
             bams, reference=reference, fai=fai, window=window,
             mapq=mapq, chrom=chrom, processes=processes, engine=engine,
             bed=bed, prefetch_depth=prefetch_depth,
-            stage_timer=stage_timer,
+            stage_timer=stage_timer, checkpoint=checkpoint,
+            quarantine=quarantine, policy=policy,
         )
     from ..io import native
 
-    out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
-    use_native_fmt = native.get_lib() is not None
-    for c, starts, ends, vals in blocks:
-        if use_native_fmt:
-            buf = native.format_matrix_rows(c, starts, ends, vals)
-            out.write(buf.decode("ascii"))
-        else:
-            lines = [
-                f"{c}\t{starts[i]}\t{ends[i]}\t"
-                + "\t".join(str(v) for v in vals[:, i]) + "\n"
-                for i in range(len(starts))
-            ]
-            out.write("".join(lines))
+    try:
+        out.write("#chrom\tstart\tend\t" + "\t".join(names) + "\n")
+        use_native_fmt = native.get_lib() is not None
+        for c, starts, ends, vals in blocks:
+            if use_native_fmt:
+                buf = native.format_matrix_rows(c, starts, ends, vals)
+                out.write(buf.decode("ascii"))
+            else:
+                lines = [
+                    f"{c}\t{starts[i]}\t{ends[i]}\t"
+                    + "\t".join(str(v) for v in vals[:, i]) + "\n"
+                    for i in range(len(starts))
+                ]
+                out.write("".join(lines))
+    finally:
+        if checkpoint is not None:
+            checkpoint.close()
+    if quarantine:
+        if checkpoint_dir:
+            quarantine.write(
+                os.path.join(checkpoint_dir, "quarantine.json"))
+        print(quarantine.exit_summary(), file=sys.stderr)
+        return 3
+    return 0
 
 
 def main(argv=None):
@@ -481,21 +659,33 @@ def main(argv=None):
                         "transfer up to N shards ahead of the shard "
                         "being computed (0 = serial path, identical "
                         "output)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="atomic sharded checkpoint store: per-region "
+                        "per-sample column blocks + fsync'd journal "
+                        "(docs/resilience.md); with --resume a killed "
+                        "run restarts from its committed shards with "
+                        "byte-identical output")
+    p.add_argument("--resume", action="store_true",
+                   help="replay the checkpoint journal and skip "
+                        "committed shards (requires --checkpoint-dir)")
     from . import add_no_crc_flag, apply_no_crc
 
     add_no_crc_flag(p)
     p.add_argument("bams", nargs="+")
     a = p.parse_args(argv)
     apply_no_crc(a.no_crc)
+    if a.resume and not a.checkpoint_dir:
+        p.error("--resume requires --checkpoint-dir")
     from ..parallel.mesh import init_distributed
 
     init_distributed()  # idempotent; the CLI dispatcher already ran it
-    run_cohortdepth(
+    return run_cohortdepth(
         a.bams, reference=a.reference, fai=a.fai, window=a.windowsize,
         mapq=a.mapq, chrom=a.chrom,
         processes=(auto_processes() if a.processes is None
                    else a.processes),
         engine=a.engine, bed=a.bed, prefetch_depth=a.prefetch_depth,
+        checkpoint_dir=a.checkpoint_dir, resume=a.resume,
     )
 
 

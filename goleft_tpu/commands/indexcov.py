@@ -172,6 +172,20 @@ def _pad_rows(rows: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray,
     return mat, valid, lengths
 
 
+def _index_file(path: str) -> str:
+    """The file actually read for ``path`` — what checkpoint keys must
+    bind (a .bam input's evidence is its .bai; rewriting the index
+    must invalidate the sample's shards even when the BAM is
+    untouched)."""
+    if path.endswith(".cram"):
+        return path + ".crai"
+    if path.endswith((".crai", ".bai")):
+        return path
+    if os.path.exists(path + ".bai"):
+        return path + ".bai"
+    return path[:-4] + ".bai"
+
+
 def run_indexcov(
     bams: list[str],
     directory: str,
@@ -183,6 +197,8 @@ def run_indexcov(
     include_gl: bool = False,
     write_html: bool = True,
     write_png: bool = True,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
 ) -> dict:
     os.makedirs(directory, exist_ok=True)
     sex_chroms = [s for s in sex.split(",") if s] if sex else []
@@ -212,6 +228,27 @@ def run_indexcov(
             idxs = list(ex.map(_load, bams))
             names = list(ex.map(get_short_name, bams))
     n_samples = len(idxs)
+
+    # per-chromosome checkpointing: the shard unit is one chromosome's
+    # launched QC state. Every sample contributes to every chromosome
+    # (cross-sample normalization), so keys bind ALL resolved index
+    # files' content identities — one stale index invalidates the run's
+    # shards, a stale chromosome list only its own.
+    checkpoint = None
+    ck_sig = None
+    if checkpoint_dir:
+        from ..parallel.scheduler import file_key
+        from ..resilience.checkpoint import CheckpointStore
+
+        def _safe_key(p):
+            try:
+                return file_key(_index_file(p))
+            except OSError:
+                return (p, -1, -1)
+
+        ck_sig = (tuple(_safe_key(b) for b in bams), sex,
+                  exclude_patt, chrom, extra_normalize)
+        checkpoint = CheckpointStore(checkpoint_dir, resume=resume)
 
     name = os.path.basename(os.path.abspath(directory))
     base = os.path.join(directory, name + "-indexcov")
@@ -359,6 +396,25 @@ def run_indexcov(
                         while len(plot_futs) > 8:
                             plot_futs.pop(0).result()
 
+    def _launch_or_resume(ref_id, ref_name, ref_len):
+        """_launch, unless this chromosome's QC state is already
+        committed — then the stored state (device result fetched to
+        host numpy) re-enters the emit pipeline with zero QC/device
+        work and byte-identical downstream artifacts."""
+        if checkpoint is None:
+            return _launch(ref_id, ref_name, ref_len)
+        key = ("indexcov", ck_sig, ref_id, ref_name, ref_len)
+        state = checkpoint.get(key)
+        if state is not None:
+            return state
+        state = _launch(ref_id, ref_name, ref_len)
+        packed = state[-1]
+        if packed is not None:
+            packed = np.asarray(packed)  # host-side for pickling
+            state = (*state[:-1], packed)
+        checkpoint.put(key, state)
+        return state
+
     plot_ex = cf.ThreadPoolExecutor(max_workers=4)
     plot_futs: list = []
     try:
@@ -366,7 +422,7 @@ def run_indexcov(
         for ref_id, ref_name, ref_len in refs:
             if exclude is not None and exclude.search(ref_name):
                 continue
-            cur = _launch(ref_id, ref_name, ref_len)
+            cur = _launch_or_resume(ref_id, ref_name, ref_len)
             if pending is not None:
                 _emit(pending)
             pending = cur
@@ -377,6 +433,8 @@ def run_indexcov(
                 f.result()  # surface the first page-render failure
     finally:
         plot_ex.shutdown(wait=True, cancel_futures=True)
+        if checkpoint is not None:
+            checkpoint.close()
 
     bed.close()
     bed_fh.close()
@@ -613,13 +671,25 @@ def main(argv=None):
                    help="normalize across samples (recommended for CRAI)")
     p.add_argument("--no-html", action="store_true",
                    help="skip html/png reports")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="per-chromosome QC checkpoint store "
+                        "(docs/resilience.md); with --resume, "
+                        "committed chromosomes skip index/QC work "
+                        "with byte-identical artifacts")
+    p.add_argument("--resume", action="store_true",
+                   help="replay the checkpoint journal and skip "
+                        "committed chromosomes (requires "
+                        "--checkpoint-dir)")
     p.add_argument("bam", nargs="+", help="bam(s)/bai(s)/crai(s)")
     a = p.parse_args(argv)
+    if a.resume and not a.checkpoint_dir:
+        p.error("--resume requires --checkpoint-dir")
     run_indexcov(
         a.bam, a.directory, sex=a.sex, exclude_patt=a.excludepatt,
         chrom=a.chrom, fai=a.fai, extra_normalize=a.extranormalize,
         include_gl=a.includegl, write_html=not a.no_html,
-        write_png=not a.no_html,
+        write_png=not a.no_html, checkpoint_dir=a.checkpoint_dir,
+        resume=a.resume,
     )
 
 

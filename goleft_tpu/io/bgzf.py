@@ -19,6 +19,8 @@ import struct
 import zlib
 from typing import BinaryIO
 
+from ..resilience import faults as _faults
+
 # Fixed empty final block from the SAM spec (magic EOF marker).
 BGZF_EOF = bytes(
     [
@@ -62,6 +64,7 @@ def bgzf_decompress(data: bytes) -> bytes:
     off = 0
     n = len(data)
     while off < n:
+        _faults.maybe_fail("bgzf", off)
         bsize, xlen = _parse_block_header(data, off)
         cdata_off = off + 12 + xlen
         cdata_len = bsize - 12 - xlen - 8  # minus header and crc32+isize
@@ -99,6 +102,7 @@ class BgzfReader:
             return cls(fh.read())
 
     def _load_block(self, coffset: int) -> None:
+        _faults.maybe_fail("bgzf", coffset)
         if coffset >= len(self._data):
             self._coffset = coffset
             self._block = b""

@@ -32,7 +32,20 @@ REQUIRED_KEYS = ("schema", "ts", "argv", "env", "backend", "spans",
 #: itself changed and old readers must refuse loudly.
 SCHEMA_PREFIX = "goleft-tpu.run-manifest/"
 SCHEMA_MAJOR = 1
-SCHEMA = f"{SCHEMA_PREFIX}1.1"
+SCHEMA = f"{SCHEMA_PREFIX}1.2"
+
+#: subsystem-contributed manifest sections (1.2): name -> provider().
+#: A provider returning None omits its section; a raising provider
+#: degrades to an error stub — manifest writing must never fail the
+#: run it is documenting. The resilience subsystem registers its
+#: quarantine/checkpoint block here.
+_SECTIONS: dict = {}
+
+
+def register_section(name: str, provider) -> None:
+    if name in REQUIRED_KEYS:
+        raise ValueError(f"cannot shadow required manifest key {name!r}")
+    _SECTIONS[name] = provider
 
 
 def parse_schema_version(schema: str) -> tuple[int, int]:
@@ -73,6 +86,13 @@ def build_manifest(tracer: Tracer | None = None,
         "metrics": registry.snapshot(),
         "trace_id": trace_id,
     }
+    for name in sorted(_SECTIONS):
+        try:
+            section = _SECTIONS[name]()
+        except Exception as e:  # noqa: BLE001 — never fail the run
+            section = {"error": repr(e)}
+        if section is not None:
+            doc[name] = section
     if extra:
         doc.update(extra)
     return doc

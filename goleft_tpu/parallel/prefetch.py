@@ -231,7 +231,8 @@ def run_prefetched_cohort(mesh, shard_len: int, window: int,
                           prefetch_depth: int = 2,
                           carry_mode: str = "all_gather",
                           timer=None, processes: int | None = None,
-                          keep_depth: bool = True):
+                          keep_depth: bool = True,
+                          checkpoint=None):
     """The chunked flagship cohort path through the staging pipeline.
 
     ``chunks`` is an ordered list of chunk descriptors; each covers the
@@ -250,6 +251,16 @@ def run_prefetched_cohort(mesh, shard_len: int, window: int,
     :func:`~goleft_tpu.parallel.cohort_pipeline.build_cohort_step`
     program fed the same segments, by the carry-threading argument in
     build_chunked_cohort_step.
+
+    ``checkpoint`` (a resilience.CheckpointStore) persists each
+    consumed chunk's (depth slice, wsums, carry) after its compute;
+    because the carry threads chunk-to-chunk, resume restores the
+    longest committed *prefix* of chunks (decode/stage/transfer/compute
+    all skipped for it), re-seeds the carry from the last committed
+    chunk, and runs only the remainder — bit-identical to a cold run,
+    since the stored host arrays are exactly the values the device
+    produced. Keys bind the run geometry (shard_len, window,
+    carry_mode, n_samples, keep_depth) and each chunk's descriptor.
     """
     import jax
     import jax.numpy as jnp
@@ -281,6 +292,26 @@ def run_prefetched_cohort(mesh, shard_len: int, window: int,
     depth_parts: list[np.ndarray] = []
     wsums_parts = []
 
+    def _chunk_key(i, desc):
+        return ("prefetched_cohort", shard_len, window, carry_mode,
+                n_samples, keep_depth, i, repr(desc))
+
+    done_prefix = 0
+    if checkpoint is not None:
+        # the carry threads chunk-to-chunk, so only a contiguous
+        # committed PREFIX is resumable; the first gap recomputes from
+        # there with the last committed carry re-seeded
+        for i, desc in enumerate(chunks):
+            rec = checkpoint.get(_chunk_key(i, desc))
+            if rec is None:
+                break
+            if keep_depth:
+                depth_parts.append(rec["depth"])
+            wsums_parts.append(jnp.asarray(rec["wsums"]))
+            carry = jax.device_put(jnp.asarray(rec["carry"]),
+                                   carry_shard)
+            done_prefix = i + 1
+
     def consume(staged: StagedChunk):
         nonlocal carry
         with timer.stage("compute"):
@@ -290,12 +321,21 @@ def run_prefetched_cohort(mesh, shard_len: int, window: int,
                 # depth the wsums stay device-resident until finalize
                 depth_parts.append(np.asarray(depth))
             wsums_parts.append(wsums)
+        if checkpoint is not None:
+            rec = {"wsums": np.asarray(wsums),
+                   "carry": np.asarray(carry)}
+            if keep_depth:
+                rec["depth"] = depth_parts[-1]
+            checkpoint.put(
+                _chunk_key(staged.index + done_prefix, staged.meta),
+                rec)
 
+    todo = list(chunks)[done_prefix:]
     if prefetch_depth < 1:
-        for i, desc in enumerate(chunks):
+        for i, desc in enumerate(todo):
             consume(StagedChunk(i, desc, transfer(produce(desc), desc)))
     else:
-        with ChunkPrefetcher(chunks, produce, depth=prefetch_depth,
+        with ChunkPrefetcher(todo, produce, depth=prefetch_depth,
                              transfer=transfer,
                              processes=processes) as pf:
             for staged in pf:

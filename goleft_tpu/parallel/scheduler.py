@@ -5,7 +5,12 @@ The reference's execution layer is gargs' process pool with
 (depth/depth.go:392-399); here the units of work are (bam, region) decode
 tasks feeding the device, run on a thread pool with:
 
-  - retry-once per shard (matching Retries: 1)
+  - retry per shard under the unified RetryPolicy
+    (resilience/policy.py): the default retry-once matches
+    ``Retries: 1``, but permanent failures (missing/corrupt input)
+    fail fast instead of burning a blind re-attempt, transients back
+    off with deterministic jitter, and both scheduler paths share ONE
+    cache-lookup + retry helper (``resilience.policy.execute_task``)
   - ordered result consumption (matching Ordered)
   - max-exit-code-style error propagation: failures are recorded, other
     shards keep running, and the first exception re-raises at the end
@@ -31,6 +36,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Sequence
 
 from ..obs import get_registry
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy, execute_task
 
 
 @dataclass
@@ -69,10 +76,25 @@ class ResultCache:
 
     def get(self, key: tuple):
         p = self._path(key)
+        faults.maybe_fail("cache", key)
         try:
             with open(p, "rb") as fh:
                 val = pickle.load(fh)
+        except FileNotFoundError:
+            with self._lock:
+                self.misses += 1
+            get_registry().counter("result_cache.misses_total").inc()
+            return None
         except Exception:
+            # corrupt entry (truncated/garbled pickle): counting it as
+            # a miss but leaving it on disk made every later get re-pay
+            # the failed load — unlink it (tolerating a concurrent
+            # remove/replace) so the next put heals the slot
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+            get_registry().counter("result_cache.corrupt_total").inc()
             with self._lock:
                 self.misses += 1
             get_registry().counter("result_cache.misses_total").inc()
@@ -89,9 +111,20 @@ class ResultCache:
     def put(self, key: tuple, value) -> None:
         p = self._path(key)
         tmp = p + f".{os.getpid()}.{threading.get_ident()}.tmp"
-        with open(tmp, "wb") as fh:
-            pickle.dump(value, fh)
-        os.replace(tmp, p)
+        faults.maybe_fail("cache", key)
+        try:
+            # a failed dump (unpicklable value, disk full) used to leak
+            # the .tmp forever: eviction and stats() skip non-.pkl
+            # names, so orphans grew unbounded
+            with open(tmp, "wb") as fh:
+                pickle.dump(value, fh)
+            os.replace(tmp, p)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
         if self.max_bytes is not None:
             self._evict()
 
@@ -160,11 +193,16 @@ def run_sharded(
     ordered: bool = True,
     strict: bool = False,
     max_in_flight: int | None = None,
+    policy: RetryPolicy | None = None,
 ) -> Iterable[ShardResult]:
     """Run fn(*task) per task; yield ShardResults in task order (ordered)
     or completion order. Failed shards come back with .error set and the
     rest keep running (the reference's max-exit-code behavior); with
     strict=True the first error re-raises once all tasks finish.
+
+    ``policy`` overrides the retry behavior wholesale; without one,
+    ``retries`` builds the default RetryPolicy (kept for the historical
+    signature — retry-once, permanent errors fail fast).
 
     At most ``max_in_flight`` shards (default 2 × processes) are submitted
     ahead of the consumer, so a slow writer bounds host memory at
@@ -177,25 +215,15 @@ def run_sharded(
     # attempt on the pool threads
     from .. import obs
 
+    if policy is None:
+        policy = RetryPolicy(retries=retries)
     span_ctx = obs.capture()
 
     def attempt(task) -> ShardResult:
         key = tuple(task)
         with obs.attach(span_ctx):
-            if cache is not None:
-                hit = cache.get(key)
-                if hit is not None:
-                    return ShardResult(key, hit, from_cache=True)
-            err = None
-            for a in range(retries + 1):
-                try:
-                    val = fn(*task)
-                    if cache is not None:
-                        cache.put(key, val)
-                    return ShardResult(key, val, attempts=a + 1)
-                except Exception as e:  # noqa: BLE001 - shard isolation
-                    err = e
-            return ShardResult(key, error=err, attempts=retries + 1)
+            return execute_task(key, lambda: fn(*task), cache=cache,
+                                policy=policy)
 
     if max_in_flight is None:
         max_in_flight = 2 * max(processes, 1)
@@ -244,37 +272,31 @@ def iter_prefetched(
     processes: int | None = None,
     retries: int = 1,
     cache: ResultCache | None = None,
+    policy: RetryPolicy | None = None,
 ) -> Iterable[ShardResult]:
     """The scheduler's PRODUCER role in the async staging pipeline
     (parallel/prefetch.py): run ``fn(*task)`` per task on the decode
-    pool with this module's shard semantics — retry-once (``Retries:
-    1``), optional result cache, failures yielded as ``.error`` results
-    while other shards keep running — delivered in task order through
-    the prefetcher's bounded queue, so at most ``depth`` results are
-    staged ahead of the consumer.
+    pool with this module's shard semantics — the unified RetryPolicy
+    (default retry-once, permanent errors fail fast), optional result
+    cache, failures yielded as ``.error`` results while other shards
+    keep running — delivered in task order through the prefetcher's
+    bounded queue, so at most ``depth`` results are staged ahead of
+    the consumer.
 
     Equivalent to ``run_sharded(ordered=True, max_in_flight=depth)``
     but on the prefetch machinery: chunk k+1's decode (and anything the
     caller chains in ``fn``, e.g. packing + an async device_put) runs
-    under the consumer's processing of chunk k."""
+    under the consumer's processing of chunk k. Both paths share the
+    one ``resilience.policy.execute_task`` helper."""
     from .prefetch import ChunkPrefetcher
+
+    if policy is None:
+        policy = RetryPolicy(retries=retries)
 
     def produce(task) -> ShardResult:
         key = tuple(task)
-        if cache is not None:
-            hit = cache.get(key)
-            if hit is not None:
-                return ShardResult(key, hit, from_cache=True)
-        err = None
-        for a in range(retries + 1):
-            try:
-                val = fn(*task)
-                if cache is not None:
-                    cache.put(key, val)
-                return ShardResult(key, val, attempts=a + 1)
-            except Exception as e:  # noqa: BLE001 - shard isolation
-                err = e
-        return ShardResult(key, error=err, attempts=retries + 1)
+        return execute_task(key, lambda: fn(*task), cache=cache,
+                            policy=policy)
 
     with ChunkPrefetcher(tasks, produce, depth=depth,
                          processes=processes) as pf:

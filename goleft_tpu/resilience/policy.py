@@ -1,0 +1,286 @@
+"""Unified retry policy: backoff, error classification, quarantine.
+
+Before this module the repo had two copy-pasted immediate-retry loops
+(``run_sharded.attempt`` and ``iter_prefetched.produce`` in
+parallel/scheduler.py) that re-attempted *every* failure — including a
+``FileNotFoundError`` that can never succeed — with zero backoff. This
+is the one place retry semantics live:
+
+  - **classification** (:meth:`RetryPolicy.classify`): transient
+    failures (flaky filesystem, timeouts, injected transients) are
+    retried; permanent ones (missing/corrupt input, type errors —
+    anything deterministic) fail fast. The table is documented in
+    docs/resilience.md and pinned by tests.
+  - **exponential backoff with deterministic jitter**
+    (:meth:`RetryPolicy.backoff_s`): delay doubles per attempt up to a
+    cap, scaled by a hash-of-(seed, key, attempt) fraction in
+    [0.5, 1.0) — reproducible schedules, no thundering herd.
+  - **per-task deadline**: a task whose next backoff would cross
+    ``deadline_s`` gives up early.
+  - **quarantine** (:class:`Quarantine`): a permanently-failing sample
+    is isolated so the cohort completes without it — graceful
+    degradation instead of all-or-nothing. The quarantined list lands
+    in the run manifest (obs), ``resilience.*`` counters and the CLI
+    exit summary.
+
+:func:`execute_task` is the shared cache-lookup + retry helper both
+scheduler paths now call — the single RetryPolicy call site for shard
+work.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..obs import get_logger, get_registry
+from .faults import InjectedFault, InjectedPermanentFault, maybe_fail
+
+log = get_logger("resilience.policy")
+
+#: deterministic failures: retrying cannot change the outcome. Checked
+#: before the transient table (FileNotFoundError is an OSError).
+PERMANENT_TYPES = (
+    FileNotFoundError, PermissionError, IsADirectoryError,
+    NotADirectoryError, ValueError, TypeError, KeyError, IndexError,
+    AttributeError, ZeroDivisionError, AssertionError,
+    NotImplementedError, EOFError, UnicodeError,
+)
+
+#: plausibly-environmental failures worth a re-attempt. Bare OSError
+#: (EIO on a flaky mount, ENOSPC that a cleaner may resolve) lands
+#: here too via the default.
+TRANSIENT_TYPES = (TimeoutError, ConnectionError, InterruptedError,
+                   BrokenPipeError, OSError, MemoryError)
+
+
+class RetriesExhausted(RuntimeError):
+    """A task failed past its retry/deadline budget (or permanently).
+
+    Carries the original exception (``cause``), how many attempts ran,
+    and the final classification — what a quarantine entry records.
+    """
+
+    def __init__(self, key, cause: BaseException, attempts: int,
+                 classification: str):
+        super().__init__(
+            f"task {key!r} failed after {attempts} attempt(s) "
+            f"({classification}): {cause!r}")
+        self.key = key
+        self.cause = cause
+        self.attempts = attempts
+        self.classification = classification
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry budget + backoff schedule + error classification.
+
+    ``retries`` is the number of *re*-attempts (1 matches the
+    reference's ``Options{Retries: 1}`` and the historical scheduler
+    behavior — up to 2 attempts total). ``deadline_s`` bounds one
+    task's total attempt+backoff wall clock.
+    """
+
+    retries: int = 1
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    deadline_s: float | None = None
+    seed: int = 0
+
+    def classify(self, exc: BaseException) -> str:
+        """'transient' (retry) or 'permanent' (fail fast)."""
+        if isinstance(exc, InjectedPermanentFault):
+            return "permanent"
+        if isinstance(exc, InjectedFault):
+            return "transient"
+        if isinstance(exc, PERMANENT_TYPES):
+            return "permanent"
+        if isinstance(exc, TRANSIENT_TYPES):
+            return "transient"
+        # unknown Exception subclasses: retrying an idempotent shard is
+        # cheap; a deterministic bug just fails once more
+        return "transient"
+
+    def backoff_s(self, key, attempt: int) -> float:
+        """Delay before re-attempt ``attempt + 1`` (attempt is
+        1-based): exponential growth capped at ``max_delay_s``, scaled
+        by a deterministic jitter fraction in [0.5, 1.0) derived from
+        (seed, key, attempt) — same key, same schedule, every run."""
+        raw = min(self.max_delay_s,
+                  self.base_delay_s * (2.0 ** (attempt - 1)))
+        h = hashlib.sha256(
+            f"{self.seed}:{key!r}:{attempt}".encode()).digest()
+        frac = 0.5 + int.from_bytes(h[:8], "big") / 2.0 ** 65
+        return raw * frac
+
+    def call(self, key, thunk):
+        """Run ``thunk()`` under this policy.
+
+        Returns ``(value, attempts)``; raises :class:`RetriesExhausted`
+        (original exception chained as ``cause``) when the budget is
+        spent or the failure is permanent. Only ``Exception`` is
+        handled — SystemExit/KeyboardInterrupt propagate (fatal by
+        design, matching the historical scheduler loops).
+        """
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return thunk(), attempt
+            except Exception as e:  # noqa: BLE001 — classified below
+                cls = self.classify(e)
+                if cls == "permanent" or attempt > self.retries:
+                    raise RetriesExhausted(key, e, attempt, cls) from e
+                delay = self.backoff_s(key, attempt)
+                if self.deadline_s is not None and (
+                        time.monotonic() - t0 + delay
+                        >= self.deadline_s):
+                    raise RetriesExhausted(
+                        key, e, attempt, "deadline") from e
+                get_registry().counter(
+                    "resilience.retries_total").inc()
+                log.debug("retrying %r after %s (attempt %d, "
+                          "backoff %.3fs)", key, e, attempt, delay)
+                if delay > 0:
+                    time.sleep(delay)
+
+
+#: the scheduler's default: retry-once with a short backoff — the
+#: historical semantics, minus pointless re-attempts of permanent
+#: failures
+DEFAULT_POLICY = RetryPolicy()
+
+
+def execute_task(key, thunk, cache=None, policy: RetryPolicy | None
+                 = None):
+    """Cache-lookup + retry for one shard task: the ONE helper behind
+    ``run_sharded`` and ``iter_prefetched`` (previously two copy-pasted
+    loops).
+
+    Returns a ``parallel.scheduler.ShardResult``; failures come back
+    with ``.error`` set (shard isolation — the caller decides whether
+    to raise). Cache I/O failures never fail the task: a computed
+    value beats a broken cache (counted in
+    ``result_cache.io_errors_total``).
+    """
+    from ..parallel.scheduler import ShardResult
+
+    if policy is None:
+        policy = DEFAULT_POLICY
+    reg = get_registry()
+    if cache is not None:
+        try:
+            hit = cache.get(key)
+        except Exception:  # noqa: BLE001 — cache must not fail tasks
+            reg.counter("result_cache.io_errors_total").inc()
+            hit = None
+        if hit is not None:
+            return ShardResult(key, hit, from_cache=True)
+
+    def attempt():
+        maybe_fail("shard", key)
+        return thunk()
+
+    try:
+        val, attempts = policy.call(key, attempt)
+    except RetriesExhausted as rx:
+        return ShardResult(key, error=rx.cause, attempts=rx.attempts)
+    if cache is not None:
+        try:
+            cache.put(key, val)
+        except Exception:  # noqa: BLE001 — cache must not fail tasks
+            reg.counter("result_cache.io_errors_total").inc()
+    return ShardResult(key, val, attempts=attempts)
+
+
+class Quarantine:
+    """Isolated permanently-failing inputs; the cohort completes
+    without them.
+
+    Thread-safe (samples fail on pool workers). ``add`` is idempotent
+    per key; entries record the source path, the error, the attempt
+    count, the classification and the phase ('open' failures drop the
+    sample's column entirely; 'decode' failures zero-fill its
+    remaining shards — documented in docs/resilience.md).
+
+    Membership is by an opaque caller-chosen ``key`` (cohortdepth uses
+    the sample *index* — SM tags are not guaranteed unique across a
+    cohort); entries carry the display name and source path.
+    """
+
+    def __init__(self):
+        self._entries: dict = {}
+        self._lock = threading.Lock()
+
+    def add(self, key, name: str, source: str, error: BaseException,
+            attempts: int = 1, classification: str = "permanent",
+            phase: str = "decode") -> bool:
+        with self._lock:
+            if key in self._entries:
+                return False
+            self._entries[key] = {
+                "sample": name,
+                "source": source,
+                "error": repr(error),
+                "attempts": attempts,
+                "classification": classification,
+                "phase": phase,
+            }
+        get_registry().counter("resilience.quarantined_total").inc()
+        log.warning("quarantined sample %s (%s, phase=%s): %r",
+                    name, source, phase, error)
+        return True
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    @property
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(e["sample"] for e in self._entries.values())
+
+    def summary(self) -> dict:
+        """The manifest block: {'quarantined': [entry...]} sorted by
+        sample name then source."""
+        with self._lock:
+            return {"quarantined": sorted(
+                self._entries.values(),
+                key=lambda e: (e["sample"], e["source"]))}
+
+    def write(self, path: str) -> None:
+        """Atomic JSON quarantine manifest (the chaos smoke's
+        artifact)."""
+        doc = self.summary()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    def exit_summary(self) -> str:
+        """The CLI's stderr epilogue for a degraded run."""
+        entries = self.summary()["quarantined"]
+        lines = [f"resilience: {len(entries)} sample(s) quarantined — "
+                 "cohort completed without them (exit 3)"]
+        for e in entries:
+            effect = ("column dropped" if e["phase"] == "open"
+                      else "remaining shards zero-filled")
+            lines.append(
+                f"  {e['sample']} ({e['source']}): {e['error']} "
+                f"[{e['classification']}, {e['attempts']} attempt(s), "
+                f"{effect}]")
+        return "\n".join(lines)

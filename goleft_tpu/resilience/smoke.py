@@ -1,0 +1,197 @@
+"""Chaos smoke: the ``make chaos-smoke`` body.
+
+A REAL ``goleft-tpu cohortdepth`` subprocess is killed mid-flight and
+must come back byte-identical:
+
+  1. cold run → reference bytes
+  2. same run with ``--checkpoint-dir`` + an injected deterministic
+     SIGKILL between journal commits (``shard:after=3:kill``) → the
+     process dies like a preempted worker (rc -9/137), the journal
+     holds the committed prefix
+  3. ``--resume`` → exit 0, stdout byte-identical to (1), and the run
+     manifest proves the journal replay skipped committed shards
+     (``checkpoint.shards_resumed_total``)
+  4. a permanently-corrupt sample → the run quarantines it and exits 3
+     with the partial cohort, byte-identical to a cold run over the
+     healthy samples, plus ``quarantine.json`` naming the culprit
+  5. happy-path overhead: the ``cohort_resume_overhead`` measurement
+     (the bench entry body) must show ≤5% checkpointing overhead
+
+Run directly::
+
+    python -m goleft_tpu.resilience.smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+OVERHEAD_BUDGET = 0.05
+
+
+def _make_cohort(d: str, n_samples: int = 3, ref_len: int = 6000,
+                 n_reads: int = 500, n_regions: int = 6):
+    """Tiny multi-region cohort fixture (hermetic, like the obs/serve
+    smokes): n BAMs + .fai + a bed tiling the contig into n_regions
+    shard-sized intervals."""
+    import numpy as np
+
+    from ..io.bai import build_bai, write_bai
+    from ..io.bam import BamWriter
+
+    rng = np.random.default_rng(5)
+    bams = []
+    for i in range(n_samples):
+        starts = np.sort(rng.integers(0, ref_len - 100, size=n_reads))
+        p = os.path.join(d, f"s{i}.bam")
+        with open(p, "wb") as fh:
+            with BamWriter(
+                fh, "@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr1\tLN:"
+                f"{ref_len}\n@RG\tID:r\tSM:s{i}\n", ["chr1"],
+                [ref_len], level=1,
+            ) as w:
+                for j, s in enumerate(starts):
+                    w.write_record(0, int(s), [(100, 0)], mapq=60,
+                                   name=f"r{j}")
+        write_bai(build_bai(p), p + ".bai")
+        bams.append(p)
+    fai = os.path.join(d, "ref.fa.fai")
+    with open(fai, "w") as fh:
+        fh.write(f"chr1\t{ref_len}\t6\t60\t61\n")
+    bed = os.path.join(d, "regions.bed")
+    step = ref_len // n_regions
+    with open(bed, "w") as fh:
+        for lo in range(0, ref_len, step):
+            fh.write(f"chr1\t{lo}\t{min(ref_len, lo + step)}\n")
+    return bams, fai, bed
+
+
+def _run(args, env, timeout_s):
+    return subprocess.run(args, env=env, capture_output=True,
+                          timeout=timeout_s)
+
+
+def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
+    """Returns 0 on success; raises on any failed step."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",     # CI has no accelerator
+               GOLEFT_TPU_PROBE="0")    # don't pay a probe timeout
+    env.pop("GOLEFT_TPU_FAULTS", None)  # hermetic: no inherited plan
+    with tempfile.TemporaryDirectory(prefix="goleft_chaos_") as d:
+        bams, fai, bed = _make_cohort(d)
+        base = [sys.executable, "-m", "goleft_tpu", "cohortdepth",
+                "--fai", fai, "-w", "200", "-b", bed, "-p", "2"]
+        ck = os.path.join(d, "ck")
+
+        # 1. the reference bytes
+        cold = _run(base + bams, env, timeout_s)
+        if cold.returncode != 0:
+            raise RuntimeError(
+                f"cold run failed ({cold.returncode}):\n"
+                f"{cold.stderr.decode()}")
+        if not cold.stdout:
+            raise RuntimeError("cold run produced no matrix")
+
+        # 2. deterministic mid-flight SIGKILL between journal commits
+        kill = _run(base + ["--checkpoint-dir", ck, "--inject-faults",
+                            "shard:after=3:kill"] + bams, env,
+                    timeout_s)
+        if kill.returncode not in (-9, 137):
+            raise RuntimeError(
+                "injected kill did not kill: rc="
+                f"{kill.returncode}\n{kill.stderr.decode()}")
+        journal = os.path.join(ck, "journal.jsonl")
+        committed = sum(1 for _ in open(journal))
+        if not 0 < committed < 6 * len(bams):
+            raise RuntimeError(
+                f"expected a committed prefix, journal has "
+                f"{committed} line(s)")
+        if verbose:
+            print(f"chaos-smoke: killed mid-flight (rc "
+                  f"{kill.returncode}, {committed} shard(s) "
+                  "committed)")
+
+        # 3. resume: byte-identical + journal replay proven by metrics
+        manifest_p = os.path.join(d, "resume.json")
+        res = _run(base + ["--checkpoint-dir", ck, "--resume",
+                           "--metrics-out", manifest_p] + bams, env,
+                   timeout_s)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"resume failed ({res.returncode}):\n"
+                f"{res.stderr.decode()}")
+        if res.stdout != cold.stdout:
+            raise RuntimeError(
+                "resumed output is NOT byte-identical to the cold run")
+        man = json.load(open(manifest_p))
+        counters = man["metrics"]["counters"]
+        resumed = counters.get("checkpoint.shards_resumed_total", 0)
+        if resumed != committed:
+            raise RuntimeError(
+                f"journal replay skipped {resumed} shard(s), "
+                f"expected {committed}")
+        if man.get("resilience", {}).get("quarantined"):
+            raise RuntimeError("healthy resume reported quarantine")
+        if verbose:
+            print(f"chaos-smoke: resume byte-identical "
+                  f"({resumed} shard(s) replayed, "
+                  f"{counters.get('checkpoint.shards_written_total')}"
+                  " written fresh)")
+
+        # 4. quarantine: a permanently-corrupt sample degrades, never
+        # kills — and the partial cohort equals a cold run without it
+        with open(bams[1], "r+b") as fh:
+            fh.write(b"\x00" * 64)  # trash the BGZF header
+        ck2 = os.path.join(d, "ck2")
+        quar = _run(base + ["--checkpoint-dir", ck2] + bams, env,
+                    timeout_s)
+        if quar.returncode != 3:
+            raise RuntimeError(
+                "quarantined run should exit 3, got "
+                f"{quar.returncode}\n{quar.stderr.decode()}")
+        healthy = _run(base + [bams[0], bams[2]], env, timeout_s)
+        if quar.stdout != healthy.stdout:
+            raise RuntimeError(
+                "partial cohort is not byte-identical to a cold run "
+                "over the healthy samples")
+        qman_p = os.path.join(ck2, "quarantine.json")
+        qman = json.load(open(qman_p))
+        q_sources = [e["source"] for e in qman["quarantined"]]
+        if q_sources != [bams[1]]:
+            raise RuntimeError(
+                f"quarantine manifest names {q_sources}, expected "
+                f"[{bams[1]}]")
+        if b"quarantined" not in quar.stderr:
+            raise RuntimeError("exit summary missing from stderr")
+        if verbose:
+            print("chaos-smoke: corrupt sample quarantined (exit 3, "
+                  "partial cohort byte-identical, manifest ok)")
+
+        # 5. happy-path overhead budget (the bench entry body): one
+        # retry at a larger fixture before declaring a regression —
+        # single-digit-percent timing on a shared host is noisy
+        from .overhead import measure_resume_overhead
+
+        entry = measure_resume_overhead(quick=True)
+        if entry["overhead_frac"] > OVERHEAD_BUDGET:
+            entry = measure_resume_overhead(quick=False)
+        if entry["overhead_frac"] > OVERHEAD_BUDGET:
+            raise RuntimeError(
+                "checkpointing overhead "
+                f"{entry['overhead_frac']:.1%} exceeds the "
+                f"{OVERHEAD_BUDGET:.0%} budget: {entry}")
+        if verbose:
+            print(f"chaos-smoke: checkpoint overhead "
+                  f"{entry['overhead_frac']:.1%} <= "
+                  f"{OVERHEAD_BUDGET:.0%} (resume replay "
+                  f"{entry['resume_speedup']}x faster)")
+            print("chaos-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_smoke())

@@ -57,7 +57,15 @@ def _device_stage(metrics, name: str, **attrs):
     device-kind attributes. The wrapped calls fetch their results to
     host numpy before returning, so the span's extent already fences
     on the device work — per-dispatch time here is honest without an
-    extra block_until_ready."""
+    extra block_until_ready.
+
+    Also the serve side's chaos hook: the ``device`` fault site fires
+    here, so an injected failure surfaces exactly where a real device/
+    tunnel fault would — inside the batch executor, turned into error
+    responses by the dispatcher, never a daemon crash."""
+    from ..resilience import faults
+
+    faults.maybe_fail("device", name)
     with _stage(metrics, "compute"), \
             obs.device_span(name, **attrs):
         yield

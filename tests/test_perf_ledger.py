@@ -356,3 +356,35 @@ def test_bench_live_run_records_shape():
     head = by_entry["cohort_depth_e2e_gbases_per_sec"]
     assert head["kind"] == "live" and head["metrics"]["value"] == 0.5
     assert all(r["round_label"].startswith("live-") for r in recs)
+
+
+def test_cohort_resume_overhead_entry_ingests(tmp_path):
+    """The resilience bench entry (cohort_resume_overhead) lands in
+    the ledger like any other host entry: numeric leaves become
+    metrics, the platform label classifies as host, nothing is
+    stale."""
+    details = {
+        "cohort_resume_overhead": {
+            "samples": 3, "regions": 8, "window": 500,
+            "seconds_plain": 0.52, "seconds_checkpointed": 0.53,
+            "seconds_resumed": 0.006, "overhead_frac": 0.019,
+            "resume_speedup": 86.7, "platform": "cpu",
+            "note": "plain vs --checkpoint-dir vs --resume replay",
+        },
+    }
+    recs = ledger.live_run_records(details, None)
+    by_entry = {r["entry"]: r for r in recs}
+    rec = by_entry["cohort_resume_overhead"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("overhead_frac", "seconds_plain",
+                "seconds_checkpointed", "seconds_resumed",
+                "resume_speedup"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["overhead_frac"] == pytest.approx(0.019)
+    # and it round-trips through the on-disk ledger
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "cohort_resume_overhead"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["overhead_frac"] == pytest.approx(0.019)

@@ -1,0 +1,338 @@
+"""resilience/: RetryPolicy classification/backoff, the shared
+execute_task helper behind both scheduler paths, deterministic fault
+injection, quarantine, and the ResultCache hardening satellites."""
+
+import os
+import pickle
+
+import pytest
+
+from goleft_tpu.obs import get_registry
+from goleft_tpu.parallel.scheduler import (
+    ResultCache, iter_prefetched, run_sharded,
+)
+from goleft_tpu.resilience import faults as faults_mod
+from goleft_tpu.resilience.faults import (
+    InjectedFault, InjectedPermanentFault, parse_faults,
+)
+from goleft_tpu.resilience.policy import (
+    Quarantine, RetriesExhausted, RetryPolicy, execute_task,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    """Fault plans are process-global: never leak one into other
+    tests."""
+    faults_mod.install(None)
+    yield
+    faults_mod.install(None)
+
+
+# ---- classification ----
+
+@pytest.mark.parametrize("exc,want", [
+    (FileNotFoundError("x"), "permanent"),
+    (PermissionError("x"), "permanent"),
+    (ValueError("corrupt"), "permanent"),
+    (TypeError("x"), "permanent"),
+    (EOFError("truncated"), "permanent"),
+    (InjectedPermanentFault("s", 1), "permanent"),
+    (TimeoutError("x"), "transient"),
+    (ConnectionError("x"), "transient"),
+    (OSError(5, "EIO"), "transient"),
+    (InjectedFault("s", 1), "transient"),
+    (RuntimeError("unknown"), "transient"),
+])
+def test_classification_table(exc, want):
+    assert RetryPolicy().classify(exc) == want
+
+
+def test_backoff_deterministic_exponential_capped():
+    p = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, seed=3)
+    d1 = p.backoff_s(("k",), 1)
+    assert d1 == p.backoff_s(("k",), 1)  # same key+attempt -> same
+    assert p.backoff_s(("other",), 1) != d1  # jitter is per-key
+    # raw doubles 0.1 -> 0.2 -> 0.4 -> capped 0.5; jitter in [.5, 1)
+    for a, raw in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.5), (9, 0.5)):
+        d = p.backoff_s(("k",), a)
+        assert raw * 0.5 <= d < raw
+
+
+def test_call_retries_transient_and_fails_fast_on_permanent():
+    p = RetryPolicy(retries=2, base_delay_s=0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    val, attempts = p.call("k", flaky)
+    assert (val, attempts, calls["n"]) == ("ok", 3, 3)
+
+    calls["n"] = 0
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        p.call("k", missing)
+    assert calls["n"] == 1  # permanent: never re-attempted
+    assert ei.value.attempts == 1
+    assert ei.value.classification == "permanent"
+    assert isinstance(ei.value.cause, FileNotFoundError)
+
+
+def test_call_deadline_stops_retrying():
+    p = RetryPolicy(retries=50, base_delay_s=10.0, deadline_s=0.01)
+
+    def always():
+        raise RuntimeError("transient")
+
+    with pytest.raises(RetriesExhausted) as ei:
+        p.call("k", always)
+    assert ei.value.attempts == 1  # first backoff would cross it
+    assert ei.value.classification == "deadline"
+
+
+# ---- the shared helper pins both scheduler paths' semantics ----
+
+def test_run_sharded_permanent_error_not_reattempted():
+    """Regression pin (the old loop blindly retried everything)."""
+    calls = {"n": 0}
+
+    def work(i):
+        calls["n"] += 1
+        raise FileNotFoundError(f"no such input {i}")
+
+    res = list(run_sharded([(1,)], work, retries=3))
+    assert res[0].error is not None and res[0].attempts == 1
+    assert calls["n"] == 1
+
+
+def test_iter_prefetched_permanent_error_not_reattempted():
+    calls = {"n": 0}
+
+    def work(i):
+        calls["n"] += 1
+        raise ValueError("corrupt shard")
+
+    res = list(iter_prefetched([(1,)], work, depth=2, retries=3))
+    assert res[0].error is not None and res[0].attempts == 1
+    assert calls["n"] == 1
+
+
+def test_run_sharded_policy_override():
+    calls = {"n": 0}
+
+    def work(i):
+        calls["n"] += 1
+        raise RuntimeError("transient")
+
+    policy = RetryPolicy(retries=2, base_delay_s=0.0)
+    res = list(run_sharded([(1,)], work, policy=policy))
+    assert res[0].attempts == 3 and calls["n"] == 3
+
+
+def test_execute_task_tolerates_broken_cache(tmp_path):
+    """Cache I/O failure must not fail (or retry) a computed task."""
+    class BrokenCache:
+        def get(self, key):
+            raise OSError("cache fs down")
+
+        def put(self, key, value):
+            raise OSError("cache fs down")
+
+    before = get_registry().counter(
+        "result_cache.io_errors_total").value
+    calls = {"n": 0}
+
+    def thunk():
+        calls["n"] += 1
+        return 42
+
+    res = execute_task(("k",), thunk, cache=BrokenCache())
+    assert res.value == 42 and res.error is None
+    assert calls["n"] == 1
+    assert get_registry().counter(
+        "result_cache.io_errors_total").value == before + 2
+
+
+# ---- fault spec parsing + plans ----
+
+def test_parse_faults_grammar():
+    cs = parse_faults("shard:after=3:kill;"
+                      "cache:p=0.25:seed=7:permanent:times=2;"
+                      "bgzf:every=10")
+    assert [c.site for c in cs] == ["shard", "cache", "bgzf"]
+    assert cs[0].after == 3 and cs[0].kind == "kill"
+    assert cs[1].p == 0.25 and cs[1].seed == 7 and cs[1].times == 2
+    assert cs[1].kind == "permanent"
+    assert cs[2].every == 10 and cs[2].kind == "transient"
+
+
+@pytest.mark.parametrize("bad", [
+    "", "shard", "shard:bogus=1", "shard:p=1.5", "shard:kill",
+    "shard:after=x",
+])
+def test_parse_faults_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_faults(bad)
+
+
+def test_fault_plan_after_every_times():
+    faults_mod.install("a:after=2;b:every=2:times=2")
+    for i in range(1, 6):
+        if i == 2:
+            with pytest.raises(InjectedFault):
+                faults_mod.maybe_fail("a")
+        else:
+            faults_mod.maybe_fail("a")  # no fire
+    fired = 0
+    for i in range(1, 9):
+        try:
+            faults_mod.maybe_fail("b")
+        except InjectedFault:
+            fired += 1
+    assert fired == 2  # every=2 would fire 4x; times=2 caps it
+    faults_mod.maybe_fail("unlisted-site")  # never fires
+
+
+def test_fault_plan_p_is_deterministic():
+    faults_mod.install("s:p=0.5:seed=9")
+    seq1 = []
+    for _ in range(40):
+        try:
+            faults_mod.maybe_fail("s")
+            seq1.append(0)
+        except InjectedFault:
+            seq1.append(1)
+    faults_mod.install("s:p=0.5:seed=9")  # fresh counters, same seed
+    seq2 = []
+    for _ in range(40):
+        try:
+            faults_mod.maybe_fail("s")
+            seq2.append(0)
+        except InjectedFault:
+            seq2.append(1)
+    assert seq1 == seq2
+    assert 0 < sum(seq1) < 40  # actually probabilistic, not degenerate
+
+
+def test_injected_transient_fault_is_retried_through_scheduler():
+    """The shard site raises INSIDE the attempt loop, so a transient
+    injection is recovered by the retry — chaos proves resilience."""
+    faults_mod.install("shard:after=1:transient")
+    res = list(run_sharded([(5,)], lambda x: x * 2, retries=1))
+    assert res[0].error is None and res[0].value == 10
+    assert res[0].attempts == 2
+
+
+def test_bgzf_fault_site_fires_in_codec():
+    from io import BytesIO
+
+    from goleft_tpu.io.bgzf import BgzfWriter, bgzf_decompress
+
+    buf = BytesIO()
+    with BgzfWriter(buf) as w:
+        w.write(b"payload" * 100)
+    data = buf.getvalue()
+    assert bgzf_decompress(data)  # healthy
+    faults_mod.install("bgzf:after=1:transient")
+    with pytest.raises(InjectedFault):
+        bgzf_decompress(data)
+
+
+# ---- quarantine ----
+
+def test_quarantine_records_and_counts():
+    before = get_registry().counter(
+        "resilience.quarantined_total").value
+    q = Quarantine()
+    assert not q
+    assert q.add(1, "s1", "/x/s1.bam", ValueError("bad"), attempts=2,
+                 classification="permanent")
+    assert not q.add(1, "s1", "/x/s1.bam", ValueError("again"))
+    q.add(("open", "/x/s2.bam"), "s2", "/x/s2.bam",
+          FileNotFoundError("gone"), phase="open")
+    assert 1 in q and ("open", "/x/s2.bam") in q and 2 not in q
+    assert len(q) == 2 and q.names == ["s1", "s2"]
+    s = q.summary()["quarantined"]
+    assert [e["sample"] for e in s] == ["s1", "s2"]
+    assert s[0]["attempts"] == 2 and s[1]["phase"] == "open"
+    assert get_registry().counter(
+        "resilience.quarantined_total").value == before + 2
+    text = q.exit_summary()
+    assert "2 sample(s) quarantined" in text and "s1" in text
+
+
+def test_quarantine_write_manifest(tmp_path):
+    import json
+
+    q = Quarantine()
+    q.add(0, "s0", "/x/s0.bam", ValueError("bad"))
+    p = str(tmp_path / "quarantine.json")
+    q.write(p)
+    doc = json.load(open(p))
+    assert doc["quarantined"][0]["sample"] == "s0"
+
+
+# ---- ResultCache hardening satellites ----
+
+def test_result_cache_put_failure_unlinks_tmp(tmp_path):
+    cache = ResultCache(str(tmp_path / "c"))
+    with pytest.raises(Exception):
+        cache.put(("k",), lambda: None)  # unpicklable
+    leftovers = os.listdir(cache.dir)
+    assert leftovers == []  # no orphan .tmp (old bug: grew unbounded)
+    # stats/eviction only ever saw .pkl names, hence the invisibility
+    assert cache.stats()["entries"] == 0
+
+
+def test_result_cache_corrupt_entry_unlinked_and_counted(tmp_path):
+    cache = ResultCache(str(tmp_path / "c"))
+    cache.put(("k",), 123)
+    p = cache._path(("k",))
+    with open(p, "wb") as fh:
+        fh.write(b"\x80garbage not a pickle")
+    c_corrupt = get_registry().counter("result_cache.corrupt_total")
+    before = c_corrupt.value
+    assert cache.get(("k",)) is None
+    assert not os.path.exists(p)  # corrupt entry removed
+    assert c_corrupt.value == before + 1
+    # subsequent get: a plain miss, not another corrupt hit
+    assert cache.get(("k",)) is None
+    assert c_corrupt.value == before + 1
+    # the slot heals on the next put
+    cache.put(("k",), 456)
+    assert cache.get(("k",)) == 456
+
+
+def test_result_cache_corrupt_tolerates_concurrent_remove(
+        tmp_path, monkeypatch):
+    cache = ResultCache(str(tmp_path / "c"))
+    cache.put(("k",), 1)
+    p = cache._path(("k",))
+    with open(p, "wb") as fh:
+        fh.write(b"junk")
+
+    real_load = pickle.load
+
+    def racing_load(fh):
+        os.remove(p)  # someone else unlinks first
+        return real_load(fh)
+
+    monkeypatch.setattr(pickle, "load", racing_load)
+    assert cache.get(("k",)) is None  # no OSError escapes
+
+
+def test_cache_fault_site_fires(tmp_path):
+    cache = ResultCache(str(tmp_path / "c"))
+    faults_mod.install("cache:after=1:transient")
+    with pytest.raises(InjectedFault):
+        cache.get(("k",))
+    cache.put(("k",), 1)  # invocation 2: no fire
+    assert cache.get(("k",)) == 1
