@@ -6,7 +6,8 @@ CXX ?= g++
 SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
-        serve-smoke obs-smoke chaos-smoke perf-gate clean
+        serve-smoke obs-smoke chaos-smoke pairhmm-smoke perf-gate \
+        clean
 
 native: build/libgoleftio.so
 
@@ -64,6 +65,15 @@ obs-smoke:
 # the other smokes.
 chaos-smoke:
 	python -m goleft_tpu.resilience.smoke
+
+# pair-HMM stack end-to-end: emdepth exports CNV candidates
+# (--candidates-out), the pairhmm CLI genotypes the planted het site
+# over them, a real serve daemon's /v1/pairhmm response is
+# byte-identical to the CLI, and an injected transient fault at the
+# pairhmm dispatch site is retried to byte-identical output.
+# Host-pinned like the other smokes.
+pairhmm-smoke:
+	python -m goleft_tpu.models.pairhmm_smoke
 
 # run the io test files with the AddressSanitized library preloaded.
 # Tests that execute XLA are excluded: ASan's allocator interposition is

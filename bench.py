@@ -1461,7 +1461,61 @@ def host_suite(quick: bool, emit=None) -> dict:
         _put("cohort_resume_overhead", _resume_overhead_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("cohort_resume_overhead", {"error": repr(e)})
+    try:
+        _put("pairhmm_forward", _pairhmm_forward_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("pairhmm_forward", {"error": repr(e)})
     return out
+
+
+def _pairhmm_forward_entry(quick: bool) -> dict:
+    """The pair-HMM wavefront forward (ops/pairhmm.py) on a synthetic
+    read×haplotype batch: the first compute-dense (non-memory-bound)
+    workload in the portfolio. Two read lengths exercise the length
+    bucketing (two compiled geometries); the timed pass reuses the
+    warm programs, so the number is steady-state dispatch throughput.
+    GCUPS = DP cell updates per second — the figure of merit the
+    pair-HMM accelerator papers (gpuPairHMM, Endeavor) report. Runs
+    on whatever backend is live; the entry's ``platform`` label
+    records which (host mode pins CPU), so the ledger tracks host and
+    device rates as separate provenance-matched series."""
+    import jax as _jax
+
+    from goleft_tpu.ops import pairhmm as ph
+
+    rng = np.random.default_rng(11)
+    n_pairs = 128 if quick else 512
+    bases = list("ACGT")
+    reads, quals, haps = [], [], []
+    for i in range(n_pairs):
+        rl = 100 if i % 2 else 150
+        hap = "".join(rng.choice(bases, rl + 100))
+        start = int(rng.integers(0, 100))
+        rd = list(hap[start:start + rl])
+        for kk in range(0, rl, 17):  # sprinkle mismatches
+            rd[kk] = bases[int(rng.integers(4))]
+        reads.append("".join(rd))
+        quals.append(rng.integers(10, 41, rl))
+        haps.append(hap)
+    ph.forward_pairs(reads, quals, haps)  # warmup: compile buckets
+    t0 = time.perf_counter()
+    ll = ph.forward_pairs(reads, quals, haps)
+    dt = time.perf_counter() - t0
+    if not np.all(np.isfinite(ll)):
+        raise RuntimeError("pairhmm forward produced non-finite "
+                           "likelihoods")
+    cells = ph.total_cells(reads, haps)
+    return {
+        "pairs": n_pairs, "read_lens": [100, 150],
+        "hap_lens": [200, 250], "cells": cells,
+        "seconds_warm": round(dt, 4),
+        "pairs_per_sec": round(n_pairs / dt, 1),
+        "gcups": round(cells / dt / 1e9, 4),
+        "platform": _jax.default_backend(),
+        "note": "rescaled-f32 anti-diagonal wavefront, vmapped "
+                "length-bucketed batch (2 geometries), warm jit; "
+                "GCUPS = DP cells/s",
+    }
 
 
 def _resume_overhead_entry(quick: bool) -> dict:

@@ -68,6 +68,8 @@ PROGS = {
              _lazy(".commands.dcnv_cmd"), True),
     "cnveval": ("evaluate CNV calls against a truth set",
                 _lazy(".commands.cnveval_cmd"), False),
+    "pairhmm": ("pair-HMM genotype likelihoods for candidate windows",
+                _lazy(".commands.pairhmm_cmd"), True),
     # bench manages its own device probe (subprocess, non-hanging) and
     # falls back to host mode itself — dispatch must not bring the
     # backend up first

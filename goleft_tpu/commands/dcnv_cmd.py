@@ -20,7 +20,8 @@ from .emdepth_cmd import read_matrix
 
 
 def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None,
-             plot_prefix: str | None = None):
+             plot_prefix: str | None = None,
+             candidates_out: str | None = None):
     out = out or sys.stdout
     chroms, starts, ends, depths, samples = read_matrix(matrix_path)
     fa = Faidx(fasta)
@@ -33,6 +34,18 @@ def run_dcnv(matrix_path: str, fasta: str, window: int = 9, out=None,
     for i in range(len(chroms)):
         vals = "\t".join(f"{v:.3f}" for v in norm[i])
         out.write(f"{chroms[i]}\t{starts[i]}\t{ends[i]}\t{vals}\n")
+    if candidates_out:
+        # aberrant intervals straight off the normalized matrix (the
+        # debiased values are scaled coverage around 1.0): the
+        # machine-readable handoff to `pairhmm --candidates`
+        from ..models.candidates import (
+            candidates_from_matrix, write_candidates,
+        )
+
+        write_candidates(
+            candidates_out,
+            candidates_from_matrix(chroms, starts, ends, norm,
+                                   samples), "dcnv")
     if plot_prefix:
         # reference parity: per-chromosome scaled-coverage chart pages
         # (dcnv.go:274-345 writes "<base>-depth-<chrom>.html" with a
@@ -71,9 +84,15 @@ def main(argv=None):
     p.add_argument("--plot", default=None, metavar="PREFIX",
                    help="write <PREFIX>-depth-<chrom>.html chart pages "
                         "(the reference prototype hardcodes 'dd')")
+    p.add_argument("--candidates-out", default=None, metavar="FILE",
+                   help="export aberrant intervals of the normalized "
+                        "matrix as CNV candidates (BED-style TSV, or "
+                        "JSON for *.json) — the `pairhmm "
+                        "--candidates` input")
     p.add_argument("matrix")
     a = p.parse_args(argv)
-    run_dcnv(a.matrix, a.fasta, window=a.window, plot_prefix=a.plot)
+    run_dcnv(a.matrix, a.fasta, window=a.window, plot_prefix=a.plot,
+             candidates_out=a.candidates_out)
 
 
 if __name__ == "__main__":

@@ -143,11 +143,12 @@ def run_emdepth(matrix_path: str, out=None, normalize: bool = True,
                 matrix_out: str | None = None,
                 vcf_out: str | None = None,
                 mops_out: str | None = None,
-                gain_out: str | None = None):
+                gain_out: str | None = None,
+                candidates_out: str | None = None):
     return call_cnvs(*read_matrix(matrix_path), out=out,
                      normalize=normalize, matrix_out=matrix_out,
                      vcf_out=vcf_out, mops_out=mops_out,
-                     gain_out=gain_out)
+                     gain_out=gain_out, candidates_out=candidates_out)
 
 
 def _mops_outputs(chroms, starts, ends, depths, samples, med, medmed,
@@ -209,7 +210,8 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
               gain_out: str | None = None,
               contig_lengths: dict | None = None,
               ref_fasta: str | None = None,
-              ref_fai: str | None = None):
+              ref_fai: str | None = None,
+              candidates_out: str | None = None):
     """EM copy-number calls from in-memory matrix arrays (the device
     pipeline's native feed — ``cnv`` passes cohortdepth's blocks here
     directly, no text round-trip)."""
@@ -283,6 +285,15 @@ def call_cnvs(chroms, starts, ends, depths, samples, out=None,
         write_cnv_vcf(vcf_out, results, samples,
                       contig_lengths=contig_lengths,
                       ref_fasta=ref_fasta, ref_fai=ref_fai)
+    if candidates_out:
+        # the machine-readable handoff to `pairhmm --candidates`: the
+        # same merged calls as the stdout table, stable schema
+        from ..models.candidates import (
+            candidates_from_calls, write_candidates,
+        )
+
+        write_candidates(candidates_out,
+                         candidates_from_calls(results), "emdepth")
     return results
 
 
@@ -302,11 +313,16 @@ def main(argv=None):
                    help="write the cn.mops posterior-CN matrix here")
     p.add_argument("--gain-out", default=None,
                    help="write per-window cn.mops information gain here")
+    p.add_argument("--candidates-out", default=None, metavar="FILE",
+                   help="export the merged CNV calls as candidate "
+                        "intervals (BED-style TSV, or JSON for "
+                        "*.json) — the `pairhmm --candidates` input")
     p.add_argument("matrix", help="depthwed-style matrix (tsv/gz)")
     a = p.parse_args(argv)
     run_emdepth(a.matrix, normalize=not a.no_normalize,
                 matrix_out=a.matrix_out, vcf_out=a.vcf,
-                mops_out=a.mops_out, gain_out=a.gain_out)
+                mops_out=a.mops_out, gain_out=a.gain_out,
+                candidates_out=a.candidates_out)
 
 
 if __name__ == "__main__":

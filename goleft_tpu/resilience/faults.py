@@ -22,6 +22,9 @@ Sites are plain strings; the instrumented ones are
             region loop)
     cache   ResultCache get/put
     device  the serve executors' device dispatch boundary
+    pairhmm the pair-HMM forward's per-bucket dispatch
+            (ops/pairhmm.py forward_pairs — CLI and serve paths
+            both route through it, under a RetryPolicy)
 
 Example: ``shard:after=3:kill`` SIGKILLs the process at the 3rd shard
 execution — the chaos smoke's mid-flight death; ``bgzf:every=100:p=0``

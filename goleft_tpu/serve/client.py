@@ -84,3 +84,10 @@ class ServeClient:
         """→ {matrix_tsv, samples, windows[, cached]}."""
         return self._request("/v1/cohortdepth",
                              {"bams": list(bams), **params})
+
+    def pairhmm(self, input_path: str, **params) -> dict:
+        """→ {likelihoods_tsv, windows[, cached]} — the bytes the
+        one-shot `goleft-tpu pairhmm` CLI writes for the same
+        windows document (+ optional candidates/gap params)."""
+        return self._request("/v1/pairhmm",
+                             {"input": input_path, **params})

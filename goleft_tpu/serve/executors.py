@@ -19,6 +19,11 @@ Coalescing is genuine device-level batching, not loop fusion:
   - cohortdepth: requests' cohorts concatenate into one
     ``cohort_matrix_blocks`` run (window means are per-sample
     independent) and each response slices its own sample columns
+  - pairhmm: all requests' windows flatten into ONE bucketed
+    wavefront batch (read×hap pairs are independent and the forward
+    is bitwise padding-invariant, so coalescing cannot change any
+    request's bytes); each response formats its own windows' rows —
+    byte-identical to the one-shot ``goleft-tpu pairhmm`` CLI
 
 Executors run on the batcher's single dispatcher thread: device passes
 are serialized, and all jitted programs stay warm in the process-wide
@@ -290,6 +295,82 @@ class IndexcovExecutor:
                     for j, v in enumerate(counters[k][lo:hi]):
                         r["bin_counters"][k][j] += int(v) - delta
         return out
+
+
+class PairhmmExecutor:
+    """`/v1/pairhmm`: windows JSON (+ optional candidates file) →
+    the genotype-likelihood table bytes the one-shot CLI writes,
+    byte-identical. The first compute-dense executor: decode cost is
+    trivial, the coalesced wavefront dispatch is the work."""
+
+    kind = "pairhmm"
+
+    def __init__(self, processes: int = 4, metrics=None):
+        self.processes = processes
+        self.metrics = metrics
+
+    def validate(self, req: dict) -> None:
+        path = _require(req, "input")
+        if not os.path.exists(path):
+            raise BadRequest(f"no such file: {path}")
+        cand = req.get("candidates")
+        if cand and not os.path.exists(cand):
+            raise BadRequest(f"no such file: {cand}")
+        # parse up front: a malformed document is this request's 400,
+        # never a 500 poisoning everyone who shared its batch
+        from ..commands.pairhmm_cmd import read_windows
+        from ..models.candidates import read_candidates
+
+        try:
+            read_windows(path)
+            if cand:
+                read_candidates(cand)
+        except ValueError as e:
+            raise BadRequest(str(e)) from None
+
+    def group_key(self, req: dict) -> tuple:
+        # only the numeric model parameters gate compatibility: each
+        # request's windows are selected before coalescing, and the
+        # forward is padding-invariant, so any same-parameter requests
+        # may share a batch
+        return (self.kind, float(req.get("gap_open", 45.0)),
+                float(req.get("gap_ext", 10.0)),
+                bool(req.get("f64", False)))
+
+    def cache_files(self, req: dict) -> list[str]:
+        files = [req["input"]]
+        if req.get("candidates"):
+            files.append(req["candidates"])
+        return files
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        from ..commands.pairhmm_cmd import read_windows, select_windows
+        from ..models import genotype
+
+        p0 = reqs[0]
+        with _stage(self.metrics, "decode"):
+            per_req = [select_windows(read_windows(r["input"]),
+                                      r.get("candidates") or None)
+                       for r in reqs]
+        windows = [w for ws in per_req for w in ws]
+        bounds = np.cumsum([0] + [len(ws) for ws in per_req])
+        n_pairs = sum(len(w["reads"]) * len(w["haps"])
+                      for w in windows)
+        with _device_stage(self.metrics, "serve.pairhmm.dispatch",
+                           windows=len(windows), pairs=n_pairs):
+            results, n_bad = genotype.score_windows(
+                windows,
+                gap_open=float(p0.get("gap_open", 45.0)),
+                gap_ext=float(p0.get("gap_ext", 10.0)),
+                dtype=np.float64 if p0.get("f64") else np.float32)
+        if self.metrics:
+            self.metrics.inc("device_passes_total")
+        with _stage(self.metrics, "format"):
+            return [{
+                "likelihoods_tsv": genotype.format_table(
+                    results[lo:hi]),
+                "windows": int(hi - lo),
+            } for lo, hi in zip(bounds, bounds[1:])]
 
 
 class CohortdepthExecutor:

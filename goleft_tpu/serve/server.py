@@ -32,6 +32,8 @@ Routes:
   POST /v1/indexcov     {bams: [...], fai, chrom?, excludepatt?}
   POST /v1/cohortdepth  {bams: [...], reference|fai, window?, mapq?,
                          chrom?, bed?, engine?}
+  POST /v1/pairhmm      {input, candidates?, gap_open?, gap_ext?,
+                         f64?}
   GET  /healthz         GET /metrics        GET /debug/flight
 """
 
@@ -48,6 +50,7 @@ import numpy as np
 from .batcher import DeadlineExceeded, MicroBatcher, Overloaded
 from .executors import (
     BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
+    PairhmmExecutor,
 )
 from .flight import FlightRecorder
 from .metrics import ServeMetrics
@@ -90,6 +93,7 @@ class ServeApp:
                 DepthExecutor(processes, self.metrics),
                 IndexcovExecutor(max(processes, 8), self.metrics),
                 CohortdepthExecutor(processes, self.metrics),
+                PairhmmExecutor(processes, self.metrics),
             )
         }
         self.cache = None

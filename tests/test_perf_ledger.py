@@ -388,3 +388,31 @@ def test_cohort_resume_overhead_entry_ingests(tmp_path):
             if r["entry"] == "cohort_resume_overhead"]
     assert len(back) == 1
     assert back[0]["metrics"]["overhead_frac"] == pytest.approx(0.019)
+
+
+def test_pairhmm_forward_entry_ingests(tmp_path):
+    """The pair-HMM bench entry (pairhmm_forward) lands in the ledger
+    like any other entry: numeric leaves become metrics, the platform
+    label classifies provenance — a cpu run is host, a tpu run is a
+    non-stale device claim the sentinel can trend separately."""
+    entry = {
+        "pairs": 512, "cells": 14_720_000, "seconds_warm": 0.41,
+        "pairs_per_sec": 1248.8, "gcups": 35.9, "platform": "tpu",
+        "note": "rescaled-f32 anti-diagonal wavefront",
+    }
+    recs = ledger.live_run_records({"pairhmm_forward": entry}, None)
+    by_entry = {r["entry"]: r for r in recs}
+    rec = by_entry["pairhmm_forward"]
+    assert rec["provenance"] == "device" and rec["stale"] is False
+    for key in ("gcups", "pairs_per_sec", "seconds_warm", "cells"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["gcups"] == pytest.approx(35.9)
+    # the host flavor classifies host and round-trips on disk
+    host = ledger.live_run_records(
+        {"pairhmm_forward": {**entry, "platform": "cpu"}}, None)
+    assert host[0]["provenance"] == "host"
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs + host)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "pairhmm_forward"]
+    assert len(back) == 2
