@@ -7,7 +7,7 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke perf-gate \
-        clean
+        plan-lint clean
 
 native: build/libgoleftio.so
 
@@ -61,10 +61,22 @@ obs-smoke:
 # proven through the run manifest's checkpoint counters), a
 # permanently-corrupt sample is quarantined (exit 3, partial cohort
 # byte-identical to a run without it), and the happy-path
-# checkpointing overhead is held to the <=5% budget. Host-pinned like
+# checkpointing overhead is held to the <=5% budget — then the serve
+# legs against real daemons: poison isolation (one 400, seven
+# byte-identical 200s), circuit-breaker trip/recover, watchdog
+# re-queue of a hung pass, and a checkpoint:true request resuming
+# byte-identically across a daemon SIGKILL+restart. Host-pinned like
 # the other smokes.
 chaos-smoke:
 	python -m goleft_tpu.resilience.smoke
+
+# the dispatch-path-split regression gate: fails if any module outside
+# goleft_tpu/plan/ calls execute_task or a raw RetryPolicy.call loop —
+# the plan Executor is the ONE place retry/quarantine/checkpoint/
+# faults/spans compose (docs/resilience.md). `# plan-lint: ok` on a
+# line is an explicit reviewed waiver.
+plan-lint:
+	python -m goleft_tpu.plan.lint
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -255,25 +255,24 @@ def cohort_matrix_blocks(
             sharding = NamedSharding(mesh, P("data", None))
             S_pad = ((S + n_dev - 1) // n_dev) * n_dev
 
+    # the plan layer: per-sample decode/reduce and the per-region
+    # checkpoint/fault boundary both lower into Steps run by this one
+    # Executor, so retry/quarantine/checkpoint compose here exactly as
+    # they do for the scheduler and serve paths
+    from ..plan import Executor as PlanExecutor, Step
+
+    pex = PlanExecutor(policy=policy, quarantine=quarantine,
+                       checkpoint=checkpoint)
+
     def _guard_sample(i, key, thunk, fallback):
         """Per-sample resilience boundary: retry under the policy,
         quarantine on exhaustion (zero-filling via ``fallback``),
         transparent when the resilience layer is off."""
-        if quarantine is not None and i in quarantine:
-            return fallback()
-        if policy is None:
-            return thunk()
-        from ..resilience.policy import RetriesExhausted
-
-        try:
-            val, _ = policy.call(key, thunk)
-            return val
-        except RetriesExhausted as rx:
-            if quarantine is None:
-                raise rx.cause from rx
-            quarantine.add(i, names[i], bam_paths[i], rx.cause,
-                           rx.attempts, rx.classification)
-            return fallback()
+        return pex.run(Step(key=key, fn=thunk,
+                            quarantine_key=i,
+                            quarantine_name=names[i],
+                            quarantine_source=bam_paths[i],
+                            fallback=fallback))
 
     def decode(args):
         """(seg_start, seg_end) already filtered/clipped for the device
@@ -498,30 +497,41 @@ def cohort_matrix_blocks(
 
     from ..resilience import faults as _faults
 
+    def _region_step(r, it):
+        """One region as a plan Step: the 'shard' fault site fires per
+        computed region — exactly between journal commits, which is
+        what the chaos smoke's mid-flight kill exercises — and a fully
+        committed region restores from the store byte-identically
+        (no decode, no compute). ``retry=False``: the region advance
+        wraps the engines' own per-sample Steps, which carry the
+        policy; a region-level failure propagates raw as before."""
+        c, s, e = r
+
+        def restore(cols):
+            starts, ends, _, _ = window_bounds(s, e, window)
+            return c, starts, ends, np.stack(cols)
+
+        def commit(blk):
+            vals = blk[3]
+            return [(k, vals[i])
+                    for i, k in enumerate(region_keys(r))
+                    if quarantine is None or i not in quarantine]
+
+        return Step(key=tuple(r), fn=lambda: next(it), site="shard",
+                    retry=False,
+                    checkpoint_keys=(region_keys(r)
+                                     if checkpoint is not None
+                                     else None),
+                    restore=restore, commit=commit)
+
     def _with_resilience(inner):
         """Interleave resumed blocks (from the checkpoint store, in
         region order) with freshly computed ones, committing each
-        computed region's per-sample columns in one journal commit.
-        The 'shard' fault site fires per computed region — exactly
-        between journal commits, which is what the chaos smoke's
-        mid-flight kill exercises."""
+        computed region's per-sample columns in one journal commit —
+        all through the plan Executor."""
         it = iter(inner)
         for r in regions:
-            c, s, e = r
-            if tuple(r) in resumed:
-                cols = [checkpoint.get(k) for k in region_keys(r)]
-                starts, ends, _, _ = window_bounds(s, e, window)
-                yield c, starts, ends, np.stack(cols)
-                continue
-            _faults.maybe_fail("shard", tuple(r))
-            blk = next(it)
-            if checkpoint is not None:
-                vals = blk[3]
-                checkpoint.put_many(
-                    (k, vals[i])
-                    for i, k in enumerate(region_keys(r))
-                    if quarantine is None or i not in quarantine)
-            yield blk
+            yield pex.run(_region_step(r, it))
 
     if checkpoint is not None or _faults.get_plan() is not None:
         gen = _with_resilience(gen)

@@ -396,24 +396,31 @@ def run_indexcov(
                         while len(plot_futs) > 8:
                             plot_futs.pop(0).result()
 
+    from ..plan import Executor as PlanExecutor, Step
+
+    pex = PlanExecutor(checkpoint=checkpoint)
+
     def _launch_or_resume(ref_id, ref_name, ref_len):
-        """_launch, unless this chromosome's QC state is already
-        committed — then the stored state (device result fetched to
-        host numpy) re-enters the emit pipeline with zero QC/device
-        work and byte-identical downstream artifacts."""
-        if checkpoint is None:
-            return _launch(ref_id, ref_name, ref_len)
-        key = ("indexcov", ck_sig, ref_id, ref_name, ref_len)
-        state = checkpoint.get(key)
-        if state is not None:
+        """One chromosome's QC as a plan Step: unless the state is
+        already committed — then the stored state (device result
+        fetched to host numpy) re-enters the emit pipeline with zero
+        QC/device work and byte-identical downstream artifacts. The
+        'shard' fault site fires per computed chromosome, uniform with
+        the cohortdepth region boundary."""
+
+        def fn():
+            state = _launch(ref_id, ref_name, ref_len)
+            if checkpoint is not None and state[-1] is not None:
+                # host-side for pickling (unchanged bytes downstream)
+                state = (*state[:-1], np.asarray(state[-1]))
             return state
-        state = _launch(ref_id, ref_name, ref_len)
-        packed = state[-1]
-        if packed is not None:
-            packed = np.asarray(packed)  # host-side for pickling
-            state = (*state[:-1], packed)
-        checkpoint.put(key, state)
-        return state
+
+        return pex.run(Step(
+            key=("indexcov", ref_name), fn=fn, site="shard",
+            retry=False,
+            checkpoint_key=(("indexcov", ck_sig, ref_id, ref_name,
+                             ref_len) if checkpoint is not None
+                            else None)))
 
     plot_ex = cf.ThreadPoolExecutor(max_workers=4)
     plot_futs: list = []

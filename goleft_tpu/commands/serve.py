@@ -65,6 +65,32 @@ def main(argv=None) -> int:
     p.add_argument("--slo-window-s", type=float, default=300.0,
                    help="availability/error-rate window for the SLO "
                         "gauges")
+    p.add_argument("--grace-s", type=float, default=0.05,
+                   help="how long past its deadline a waiter lets an "
+                        "already-started batch deliver")
+    p.add_argument("--no-bisect", action="store_true",
+                   help="disable poison-request isolation (a failed "
+                        "coalesced pass then fails every request in "
+                        "it, the pre-PR-7 behavior)")
+    p.add_argument("--watchdog-s", type=float, default=300.0,
+                   help="hung-dispatch budget: a device pass exceeding "
+                        "it is abandoned and its requests re-queued "
+                        "once, then failed 504 (0 disables)")
+    p.add_argument("--watchdog-requeues", type=int, default=1,
+                   help="re-queue budget per request before a hung "
+                        "dispatch fails it")
+    p.add_argument("--breaker-threshold", type=int, default=5,
+                   help="consecutive 500-class failures per endpoint "
+                        "before its circuit breaker trips open (503 "
+                        "shedding)")
+    p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                   help="how long a tripped breaker stays open before "
+                        "a half-open probe")
+    p.add_argument("--checkpoint-root", default=None,
+                   help="enable checkpoint-backed requests: "
+                        "cohortdepth requests with checkpoint: true "
+                        "commit per-region shards under this "
+                        "directory and resume across daemon restarts")
     a = p.parse_args(argv)
 
     from .. import obs
@@ -80,7 +106,15 @@ def main(argv=None) -> int:
                    processes=a.processes, registry=obs.get_registry(),
                    flight_records=a.flight_records,
                    slo_p99_target_s=a.slo_p99_target_s,
-                   slo_window_s=a.slo_window_s)
+                   slo_window_s=a.slo_window_s,
+                   grace_s=a.grace_s,
+                   bisect_isolation=not a.no_bisect,
+                   watchdog_s=a.watchdog_s if a.watchdog_s > 0
+                   else None,
+                   watchdog_requeues=a.watchdog_requeues,
+                   breaker_threshold=a.breaker_threshold,
+                   breaker_cooldown_s=a.breaker_cooldown_s,
+                   checkpoint_root=a.checkpoint_root)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)

@@ -349,8 +349,8 @@ def forward_pairs_partial(reads, quals, haps, *,
     caller (models/genotype.py) quarantines the affected windows
     instead of losing the whole run.
     """
-    from ..resilience.policy import DEFAULT_POLICY, RetriesExhausted
-    from ..resilience import faults
+    from ..plan import Executor as PlanExecutor, Step
+    from ..resilience.policy import DEFAULT_POLICY
 
     if not (len(reads) == len(quals) == len(haps)):
         raise ValueError(
@@ -384,29 +384,32 @@ def forward_pairs_partial(reads, quals, haps, *,
 
     from .. import obs
 
+    pex = PlanExecutor(policy=policy)
     groups = bucket_pairs(enc_reads, enc_haps, bucket)
     for (r_pad, h_pad), idxs in sorted(groups.items()):
         packed = _pack_bucket(idxs, enc_reads, errs, enc_haps,
                               r_pad, h_pad, dtype)
         key = ("pairhmm", r_pad, h_pad, len(idxs))
 
-        def thunk(packed=packed, key=key):
-            faults.maybe_fail("pairhmm", key)
+        def thunk(packed=packed):
             contribs, shifts = obs.dispatch(
                 "pairhmm_forward", _forward_bucket, *packed,
                 trans, rescale=rescale)
             return np.asarray(contribs), np.asarray(shifts)
 
         reg.counter("pairhmm.buckets_total").inc()
-        try:
-            (contribs, shifts), _ = policy.call(key, thunk)
-        except RetriesExhausted as rx:
+        # one bucket dispatch = one plan Step at the 'pairhmm' fault
+        # site, retried under the policy like every other dispatch
+        outcome = pex.run_step(Step(key=key, fn=thunk,
+                                    site="pairhmm"))
+        if outcome.error is not None:
             if not allow_partial:
-                raise
+                raise outcome.retries_exhausted
             for i in idxs:
-                failed[i] = rx.cause
+                failed[i] = outcome.error
             reg.counter("pairhmm.buckets_failed_total").inc()
             continue
+        contribs, shifts = outcome.value
         out[np.asarray(idxs)] = _fold_contribs(contribs, shifts)
     return out, failed
 

@@ -312,23 +312,46 @@ def run_prefetched_cohort(mesh, shard_len: int, window: int,
                                    carry_shard)
             done_prefix = i + 1
 
+    from ..plan import Executor as PlanExecutor, Step
+
+    pex = PlanExecutor(checkpoint=checkpoint)
+
     def consume(staged: StagedChunk):
+        """One chunk's compute+commit as a plan Step. ``resumable=
+        False``: the carry threads chunk-to-chunk, so resume is the
+        contiguous-prefix scan above, never a per-step store skip —
+        the Step only owns the atomic commit (and the 'shard' fault
+        site, uniform with the other cohort boundaries)."""
         nonlocal carry
-        with timer.stage("compute"):
-            depth, wsums, carry = chunk_fn(*staged.value, carry)
-            if keep_depth:
-                # D2H fetch synchronizes this chunk's compute; without
-                # depth the wsums stay device-resident until finalize
-                depth_parts.append(np.asarray(depth))
-            wsums_parts.append(wsums)
-        if checkpoint is not None:
+
+        def fn():
+            nonlocal carry
+            with timer.stage("compute"):
+                depth, wsums, carry = chunk_fn(*staged.value, carry)
+                if keep_depth:
+                    # D2H fetch synchronizes this chunk's compute;
+                    # without depth the wsums stay device-resident
+                    # until finalize
+                    depth_parts.append(np.asarray(depth))
+                wsums_parts.append(wsums)
+            return wsums, carry
+
+        def commit(res):
+            wsums, carry2 = res
             rec = {"wsums": np.asarray(wsums),
-                   "carry": np.asarray(carry)}
+                   "carry": np.asarray(carry2)}
             if keep_depth:
                 rec["depth"] = depth_parts[-1]
-            checkpoint.put(
-                _chunk_key(staged.index + done_prefix, staged.meta),
-                rec)
+            return [(_chunk_key(staged.index + done_prefix,
+                                staged.meta), rec)]
+
+        pex.run(Step(
+            key=("prefetched_cohort", staged.index + done_prefix),
+            fn=fn, site="shard", retry=False, resumable=False,
+            checkpoint_key=(_chunk_key(staged.index + done_prefix,
+                                       staged.meta)
+                            if checkpoint is not None else None),
+            commit=commit))
 
     todo = list(chunks)[done_prefix:]
     if prefetch_depth < 1:

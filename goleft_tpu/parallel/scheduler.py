@@ -10,7 +10,8 @@ tasks feeding the device, run on a thread pool with:
     ``Retries: 1``, but permanent failures (missing/corrupt input)
     fail fast instead of burning a blind re-attempt, transients back
     off with deterministic jitter, and both scheduler paths share ONE
-    cache-lookup + retry helper (``resilience.policy.execute_task``)
+    cache-lookup + retry helper (``plan.executor.execute_task`` — the
+    plan layer every dispatch path lowers into)
   - ordered result consumption (matching Ordered)
   - max-exit-code-style error propagation: failures are recorded, other
     shards keep running, and the first exception re-raises at the end
@@ -36,8 +37,21 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Sequence
 
 from ..obs import get_registry
+from ..plan.core import Step
+from ..plan.executor import Executor as PlanExecutor
 from ..resilience import faults
-from ..resilience.policy import RetryPolicy, execute_task
+from ..resilience.policy import RetryPolicy
+
+
+def _shard_step(pex: "PlanExecutor", key: tuple, thunk,
+                cacheable: bool) -> ShardResult:
+    """One shard task through the plan layer — the ShardResult shape
+    both scheduler paths yield."""
+    out = pex.run_step(Step(key=key, fn=thunk, site="shard",
+                            cacheable=cacheable))
+    return ShardResult(key, out.value, error=out.error,
+                       attempts=out.attempts,
+                       from_cache=out.from_cache)
 
 
 @dataclass
@@ -218,12 +232,13 @@ def run_sharded(
     if policy is None:
         policy = RetryPolicy(retries=retries)
     span_ctx = obs.capture()
+    pex = PlanExecutor(policy=policy, cache=cache)
 
     def attempt(task) -> ShardResult:
         key = tuple(task)
         with obs.attach(span_ctx):
-            return execute_task(key, lambda: fn(*task), cache=cache,
-                                policy=policy)
+            return _shard_step(pex, key, lambda: fn(*task),
+                               cache is not None)
 
     if max_in_flight is None:
         max_in_flight = 2 * max(processes, 1)
@@ -286,17 +301,18 @@ def iter_prefetched(
     Equivalent to ``run_sharded(ordered=True, max_in_flight=depth)``
     but on the prefetch machinery: chunk k+1's decode (and anything the
     caller chains in ``fn``, e.g. packing + an async device_put) runs
-    under the consumer's processing of chunk k. Both paths share the
-    one ``resilience.policy.execute_task`` helper."""
+    under the consumer's processing of chunk k. Both paths lower
+    their shard tasks through the one plan-layer Executor."""
     from .prefetch import ChunkPrefetcher
 
     if policy is None:
         policy = RetryPolicy(retries=retries)
+    pex = PlanExecutor(policy=policy, cache=cache)
 
     def produce(task) -> ShardResult:
         key = tuple(task)
-        return execute_task(key, lambda: fn(*task), cache=cache,
-                            policy=policy)
+        return _shard_step(pex, key, lambda: fn(*task),
+                           cache is not None)
 
     with ChunkPrefetcher(tasks, produce, depth=depth,
                          processes=processes) as pf:

@@ -1,0 +1,51 @@
+"""goleft_tpu.plan — the one plan-then-execute layer.
+
+Before this package the repo had three parallel dispatch paths — the
+cold CLI pipelines, ``run_prefetched_cohort`` and the serve executors —
+each hand-composing its own slice of the resilience stack: the CLI got
+checkpoint/resume and quarantine, prefetch got retry, serve got fault
+injection and nothing else. A serve request could neither checkpoint
+nor quarantine, and the retry loop lived in three shapes.
+
+Now every entry point lowers its work into :class:`~goleft_tpu.plan.core.Step`
+values — content-keyed units of work — and ONE
+:class:`~goleft_tpu.plan.executor.Executor` runs them with the full
+composition applied uniformly, in a fixed order:
+
+    quarantine short-circuit → checkpoint resume → result-cache lookup
+    → [fault site → span → fn]  under the RetryPolicy
+    → quarantine on exhaustion → cache put → checkpoint commit
+
+  - :mod:`~goleft_tpu.plan.core` — ``Step`` / ``Plan`` / ``StepOutcome``
+  - :mod:`~goleft_tpu.plan.executor` — the ``Executor`` plus
+    ``execute_task`` (the shard-scheduler facade, moved here from
+    resilience/policy.py)
+  - :mod:`~goleft_tpu.plan.lint` — the ``make plan-lint`` body: fails
+    when any module outside this package calls ``execute_task`` or
+    ``policy.call`` directly, so the three-path split can't silently
+    regrow
+
+Lowered call sites (the inventory the lint protects):
+
+  - ``parallel/scheduler.py`` ``run_sharded`` / ``iter_prefetched`` →
+    ``execute_task``
+  - ``commands/cohortdepth.py`` per-sample decode/reduce and the
+    per-region checkpoint/fault boundary → sample / region Steps
+  - ``commands/indexcov.py`` per-chromosome QC → chromosome Steps
+  - ``parallel/prefetch.py`` ``run_prefetched_cohort`` per-chunk
+    commit → chunk Steps
+  - ``ops/pairhmm.py`` per-bucket wavefront dispatch → bucket Steps
+  - ``serve/executors.py`` every device dispatch → device Steps
+    (transient device faults are now retried inside the batch instead
+    of failing every coalesced neighbor)
+
+Import is jax-free and cheap.
+"""
+
+from __future__ import annotations
+
+from .core import Plan, Step, StepOutcome  # noqa: F401
+from .executor import Executor, execute_task, run_device_step  # noqa: F401
+
+__all__ = ["Executor", "Plan", "Step", "StepOutcome", "execute_task",
+           "run_device_step"]

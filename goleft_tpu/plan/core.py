@@ -1,0 +1,124 @@
+"""Plan/Step data model: content-keyed units of work.
+
+A :class:`Step` is the smallest schedulable unit every entry point
+lowers to: a thunk plus the metadata the
+:class:`~goleft_tpu.plan.executor.Executor` needs to apply the
+resilience stack uniformly — a content-identity key, a fault-injection
+site, optional checkpoint keys (with commit/restore adapters for
+multi-key shards), optional quarantine identity and fallback. The data
+model is deliberately passive: a Step never executes itself, so the
+composition order (quarantine → resume → cache → retry → commit) is
+defined in exactly one place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+
+@dataclass
+class Step:
+    """One content-keyed unit of work.
+
+    ``key`` is the step's identity everywhere: the retry policy's
+    deterministic-jitter seed, the fault site's logged key, the
+    result-cache key (when ``cacheable``). Callers build it from
+    content identity (``parallel.scheduler.file_key`` of each input +
+    canonical params) so a stale input invalidates only its own steps.
+
+    ``checkpoint_key``/``checkpoint_keys`` make the step resumable: a
+    step whose every key is committed in the executor's store is
+    *restored* (``restore(values)``; default: the single value) with no
+    fault site, no retry, no ``fn``. After a fresh compute, ``commit``
+    maps the value to ``(key, block)`` pairs persisted in ONE journal
+    commit (default: ``[(checkpoint_key, value)]``).
+
+    ``quarantine_key`` opts the step into graceful degradation: a step
+    of an already-quarantined key short-circuits to ``fallback()``, and
+    a permanently-failing step quarantines itself and degrades to
+    ``fallback()`` instead of erroring (matching cohortdepth's
+    per-sample contract).
+
+    ``retry=False`` runs ``fn`` outside the retry policy (errors
+    propagate raw) while keeping the fault site and checkpoint
+    behavior — the region-advance steps, whose inner work carries its
+    own per-sample policy.
+    """
+
+    key: tuple
+    fn: Callable[[], Any]
+    name: str = ""
+    site: str | None = None
+    retry: bool = True
+    policy: Any = None             # RetryPolicy override (else executor's)
+    cacheable: bool = False
+    checkpoint_key: tuple | None = None
+    checkpoint_keys: Sequence[tuple] | None = None
+    resumable: bool = True         # False: commit-only (no store skip —
+    #                                order-dependent steps whose resume
+    #                                is a caller-level prefix decision)
+    restore: Callable[[list], Any] | None = None
+    commit: Callable[[Any], Sequence[tuple]] | None = None
+    quarantine_key: Any = None
+    quarantine_name: str = ""
+    quarantine_source: str = ""
+    fallback: Callable[[], Any] | None = None
+    span: str | None = None        # obs span name (None: no extra span)
+    device: bool = False           # span is a device-event span
+    attrs: dict = field(default_factory=dict)
+
+    def ck_keys(self) -> list[tuple]:
+        """The step's checkpoint keys, normalized to a list."""
+        if self.checkpoint_keys is not None:
+            return list(self.checkpoint_keys)
+        if self.checkpoint_key is not None:
+            return [self.checkpoint_key]
+        return []
+
+
+@dataclass
+class StepOutcome:
+    """What running one Step produced (the executor never raises for
+    policy-managed failures — the caller decides, via :meth:`value_or_raise`
+    or by inspecting ``error``)."""
+
+    key: tuple
+    value: Any = None
+    error: BaseException | None = None
+    retries_exhausted: BaseException | None = None  # the RetriesExhausted
+    attempts: int = 1
+    classification: str = ""
+    from_cache: bool = False
+    resumed: bool = False
+    quarantined: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+    def value_or_raise(self):
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+@dataclass
+class Plan:
+    """An ordered sequence of Steps plus the request-level metadata
+    (entry-point kind, canonical params) — what an entry point lowers
+    its whole invocation into before anything executes."""
+
+    kind: str
+    steps: list[Step] = field(default_factory=list)
+    meta: dict = field(default_factory=dict)
+
+    def add(self, step: Step) -> Step:
+        self.steps.append(step)
+        return step
+
+    def __len__(self) -> int:
+        return len(self.steps)
+
+    def __iter__(self):
+        return iter(self.steps)

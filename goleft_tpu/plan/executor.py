@@ -1,0 +1,206 @@
+"""The one Step executor: retry × quarantine × checkpoint × faults × spans.
+
+Every dispatch path — CLI shard schedulers, the prefetched cohort
+pipeline, the per-chromosome indexcov loop, the pair-HMM bucket
+dispatch, the serve executors — runs its Steps through
+:meth:`Executor.run_step`, so the composition order is defined once:
+
+    1. quarantine short-circuit (an already-quarantined key degrades
+       to its fallback with zero work)
+    2. checkpoint resume (every key committed → restore, no fault
+       site, no retry, counted in ``checkpoint.shards_resumed_total``)
+    3. result-cache lookup (I/O failures never fail the step —
+       ``result_cache.io_errors_total``)
+    4. the attempt loop under the RetryPolicy: each attempt fires the
+       step's fault-injection site, then runs ``fn`` inside the step's
+       span (a device-event span for device steps)
+    5. on exhaustion: quarantine + fallback when the step carries a
+       quarantine identity, else the failure lands in the outcome
+    6. cache put, then checkpoint commit (one journal commit per step)
+
+``execute_task`` is the shard-scheduler facade (moved here from
+resilience/policy.py): same (key, thunk, cache, policy) →
+``ShardResult`` contract both scheduler paths have used since PR 5.
+``run_device_step`` is the serve executors' facade: one coalesced
+device dispatch as a retried Step, so a transient device/tunnel fault
+costs one backoff instead of failing the whole batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..obs import get_registry
+from ..resilience import faults
+from ..resilience.policy import (
+    DEFAULT_POLICY, RetriesExhausted, RetryPolicy,
+)
+from .core import Plan, Step, StepOutcome
+
+
+class Executor:
+    """Runs Steps under one (policy, quarantine, checkpoint, cache)
+    composition. All collaborators optional: a bare ``Executor()``
+    just calls the thunk — entry points construct one unconditionally
+    and the resilience features engage exactly when their objects are
+    wired, which is what makes the lowering transparent."""
+
+    def __init__(self, policy: RetryPolicy | None = None,
+                 quarantine=None, checkpoint=None, cache=None):
+        self.policy = policy
+        self.quarantine = quarantine
+        self.checkpoint = checkpoint
+        self.cache = cache
+
+    # ---- the composition ----
+
+    def run_step(self, step: Step) -> StepOutcome:
+        q = self.quarantine
+        if q is not None and step.quarantine_key is not None \
+                and step.quarantine_key in q:
+            return StepOutcome(
+                step.key, quarantined=True,
+                value=step.fallback() if step.fallback else None)
+
+        ck = self.checkpoint
+        ck_keys = step.ck_keys() if ck is not None else []
+        if ck_keys and step.resumable \
+                and all(ck.has(k) for k in ck_keys):
+            vals = [ck.get(k) for k in ck_keys]
+            value = step.restore(vals) if step.restore is not None \
+                else vals[0] if step.checkpoint_key is not None \
+                else vals
+            return StepOutcome(step.key, value=value, resumed=True)
+
+        reg = get_registry()
+        if self.cache is not None and step.cacheable:
+            try:
+                hit = self.cache.get(step.key)
+            except Exception:  # noqa: BLE001 — cache must not fail steps
+                reg.counter("result_cache.io_errors_total").inc()
+                hit = None
+            if hit is not None:
+                return StepOutcome(step.key, value=hit, from_cache=True)
+
+        def attempt():
+            if step.site:
+                faults.maybe_fail(step.site, step.key)
+            with self._span(step):
+                return step.fn()
+
+        policy = step.policy if step.policy is not None else self.policy
+        if policy is None or not step.retry:
+            # resilience layer off (or a no-retry boundary step): run
+            # raw — errors propagate to the caller, exactly the
+            # pre-plan behavior of the unguarded paths
+            value = attempt()
+            attempts = 1
+        else:
+            try:
+                value, attempts = policy.call(step.key, attempt)
+            except RetriesExhausted as rx:
+                if q is not None and step.quarantine_key is not None:
+                    q.add(step.quarantine_key, step.quarantine_name,
+                          step.quarantine_source, rx.cause,
+                          rx.attempts, rx.classification)
+                    return StepOutcome(
+                        step.key, quarantined=True,
+                        attempts=rx.attempts,
+                        classification=rx.classification,
+                        value=step.fallback() if step.fallback
+                        else None)
+                return StepOutcome(step.key, error=rx.cause,
+                                   retries_exhausted=rx,
+                                   attempts=rx.attempts,
+                                   classification=rx.classification)
+
+        if self.cache is not None and step.cacheable:
+            try:
+                self.cache.put(step.key, value)
+            except Exception:  # noqa: BLE001 — cache must not fail steps
+                reg.counter("result_cache.io_errors_total").inc()
+        if ck_keys:
+            items = step.commit(value) if step.commit is not None \
+                else [(ck_keys[0], value)]
+            ck.put_many(items)
+        return StepOutcome(step.key, value=value, attempts=attempts)
+
+    def run(self, step: Step):
+        """run_step, raising the failure (the exhausted attempt's
+        original cause) instead of returning it — the call shape for
+        entry points that want plain values."""
+        return self.run_step(step).value_or_raise()
+
+    def execute(self, plan: Plan):
+        """Run a whole Plan, yielding one StepOutcome per Step in
+        order (lazy: a generator, so streaming consumers overlap)."""
+        for step in plan:
+            yield self.run_step(step)
+
+    # ---- span plumbing ----
+
+    @staticmethod
+    def _span(step: Step):
+        import contextlib
+
+        if step.span is None:
+            return contextlib.nullcontext()
+        from .. import obs
+
+        if step.device:
+            return obs.device_span(step.span, **step.attrs)
+        return obs.span(step.span, **step.attrs)
+
+
+def execute_task(key, thunk, cache=None,
+                 policy: RetryPolicy | None = None):
+    """Cache-lookup + retry for one shard task: the ONE helper behind
+    ``run_sharded`` and ``iter_prefetched``.
+
+    Returns a ``parallel.scheduler.ShardResult``; failures come back
+    with ``.error`` set (shard isolation — the caller decides whether
+    to raise). Cache I/O failures never fail the task: a computed
+    value beats a broken cache (counted in
+    ``result_cache.io_errors_total``).
+    """
+    from ..parallel.scheduler import ShardResult
+
+    ex = Executor(policy=policy if policy is not None
+                  else DEFAULT_POLICY, cache=cache)
+    out = ex.run_step(Step(key=key, fn=thunk, site="shard",
+                           cacheable=cache is not None))
+    return ShardResult(key, out.value, error=out.error,
+                       attempts=out.attempts,
+                       from_cache=out.from_cache)
+
+
+def run_device_step(name: str, fn, *, key=None, metrics=None,
+                    policy: RetryPolicy | None = None,
+                    retry: bool = True, **attrs):
+    """One coalesced serve device dispatch as a Step.
+
+    The serve executors' dispatch boundary: the shared ``compute``
+    stage wall-clock PLUS a device-event span carrying backend/
+    platform attributes, with the ``device`` fault site fired per
+    attempt — so an injected (or real) transient device fault is
+    retried under the policy instead of failing every request that
+    shared the batch. The wrapped ``fn`` fetches its results to host
+    numpy before returning, so the span already fences on the device
+    work. Raises the original failure on exhaustion (the batcher's
+    bisect-and-retry isolation takes it from there).
+    """
+    import contextlib
+
+    def staged():
+        if metrics is None:
+            cm = contextlib.nullcontext()
+        else:
+            cm = metrics.timer.stage("compute")
+        with cm:
+            return fn()
+
+    ex = Executor(policy=policy if policy is not None
+                  else DEFAULT_POLICY)
+    return ex.run(Step(key=key if key is not None else (name,),
+                       fn=staged, site="device", retry=retry,
+                       span=name, device=True, attrs=attrs))

@@ -1,0 +1,89 @@
+"""plan-lint: the dispatch-path-split regression gate.
+
+The tentpole refactor's value is that there is ONE place retry/
+checkpoint/quarantine compose (plan/executor.py). This check fails CI
+(``make plan-lint``) when any module outside ``goleft_tpu/plan/``
+grows a direct call to the retry machinery again:
+
+  - ``execute_task(...)`` — the scheduler facade must be reached
+    through the plan package
+  - ``<policy>.call(...)`` — a raw RetryPolicy attempt loop
+  - ``RetriesExhausted`` handling paired with a hand-rolled retry
+    ``while True:`` loop is caught by the two patterns above (the loop
+    needs one of them to retry)
+
+Definitions inside ``goleft_tpu/plan/`` and the test tree are exempt;
+``# plan-lint: ok`` on the offending line grants an explicit waiver
+(none exist today — a waiver should be a reviewed decision).
+
+Run: ``python -m goleft_tpu.plan.lint [root]`` — exits 1 with one
+line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+#: pattern → why it is banned outside goleft_tpu/plan/
+BANNED = [
+    (re.compile(r"\bexecute_task\s*\("),
+     "call execute_task via goleft_tpu.plan (Executor/Step)"),
+    (re.compile(r"\bpolicy\s*\.\s*call\s*\("),
+     "raw RetryPolicy.call loop — lower the work into a plan Step"),
+    (re.compile(r"\bRetryPolicy\s*\([^)]*\)\s*\.\s*call\s*\("),
+     "raw RetryPolicy.call loop — lower the work into a plan Step"),
+]
+
+WAIVER = "# plan-lint: ok"
+
+
+def check_tree(root: str) -> list[str]:
+    """Return one 'path:line: message' string per violation under
+    ``root`` (the goleft_tpu package directory)."""
+    violations: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "plan")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    if WAIVER in line:
+                        continue
+                    stripped = line.lstrip()
+                    if stripped.startswith("#"):
+                        continue
+                    for patt, why in BANNED:
+                        if patt.search(line):
+                            rel = os.path.relpath(path,
+                                                  os.path.dirname(root))
+                            violations.append(
+                                f"{rel}:{lineno}: {why}\n"
+                                f"    {line.rstrip()}")
+                            break
+    return violations
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = check_tree(root)
+    if violations:
+        print(f"plan-lint: {len(violations)} direct retry-layer "
+              "call(s) outside goleft_tpu/plan/ — lower them into "
+              "plan Steps (docs/resilience.md):", file=sys.stderr)
+        for v in violations:
+            print(v, file=sys.stderr)
+        return 1
+    print("plan-lint: ok — all dispatch paths lower through "
+          "goleft_tpu/plan/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,21 +32,34 @@ from __future__ import annotations
 
 import threading
 
+from .breaker import CircuitBreaker  # noqa: F401
 from .checkpoint import CheckpointCorrupt, CheckpointStore  # noqa: F401
 from .faults import (  # noqa: F401
     InjectedFault, InjectedPermanentFault, maybe_fail, parse_faults,
 )
 from .policy import (  # noqa: F401
     DEFAULT_POLICY, Quarantine, RetriesExhausted, RetryPolicy,
-    execute_task,
 )
 
 __all__ = [
-    "CheckpointCorrupt", "CheckpointStore", "DEFAULT_POLICY",
+    "CheckpointCorrupt", "CheckpointStore", "CircuitBreaker",
+    "DEFAULT_POLICY",
     "InjectedFault", "InjectedPermanentFault", "Quarantine",
     "RetriesExhausted", "RetryPolicy", "execute_task", "maybe_fail",
     "parse_faults", "set_run_state",
 ]
+
+def __getattr__(name):
+    # execute_task moved to the plan layer (PR 7); lazy alias so the
+    # historical `from goleft_tpu.resilience import execute_task`
+    # keeps working without an eager resilience → plan import
+    if name == "execute_task":
+        from ..plan.executor import execute_task as impl
+
+        return impl
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
 
 _STATE_LOCK = threading.Lock()
 _RUN_STATE: dict = {}

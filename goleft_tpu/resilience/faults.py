@@ -14,6 +14,7 @@ race. A fault plan is a list of clauses parsed from
             | "seed=" N       seed for the p= hash (default 0)
             | "times=" N      cap total firings of this clause
             | "transient" | "permanent" | "kill"   (default transient)
+            | "hang" | "hang=" SECONDS   block inside the call site
 
 Sites are plain strings; the instrumented ones are
 
@@ -34,7 +35,10 @@ composes.
 Effects: ``transient`` raises :class:`InjectedFault` (classified
 retryable by the RetryPolicy), ``permanent`` raises
 :class:`InjectedPermanentFault` (not re-attempted), ``kill`` sends the
-process SIGKILL — indistinguishable from a preemption.
+process SIGKILL — indistinguishable from a preemption — and ``hang``
+sleeps inside the instrumented call (default 3600s, i.e. forever on
+any test timescale) — a wedged device pass, which is what the serve
+watchdog must abandon and re-queue.
 
 Determinism scope: firing depends only on the clause and the per-site
 invocation index (a locked counter), so a run with a fixed task order
@@ -79,7 +83,8 @@ class InjectedPermanentFault(InjectedFault):
 @dataclass
 class FaultClause:
     site: str
-    kind: str = "transient"  # transient | permanent | kill
+    kind: str = "transient"  # transient | permanent | kill | hang
+    hang_s: float = 3600.0
     after: int | None = None
     every: int | None = None
     p: float | None = None
@@ -132,7 +137,11 @@ def parse_faults(spec: str) -> list[FaultClause]:
                     c.seed = int(val)
                 elif key == "times":
                     c.times = int(val)
-                elif part in ("transient", "permanent", "kill"):
+                elif key == "hang" and val:
+                    c.kind = "hang"
+                    c.hang_s = float(val)
+                elif part in ("transient", "permanent", "kill",
+                              "hang"):
                     c.kind = part
                 else:
                     raise ValueError(f"unknown part {part!r}")
@@ -185,6 +194,14 @@ class FaultPlan:
             os.kill(os.getpid(), signal.SIGKILL)
         log.warning("injected %s fault at site %s invocation %d "
                     "(key %r)", fire.kind, site, index, key)
+        if fire.kind == "hang":
+            # a wedged call, not a failed one: block right here (the
+            # serve watchdog's prey — the abandoned worker thread
+            # keeps sleeping, daemonic, until process exit)
+            import time
+
+            time.sleep(fire.hang_s)
+            return
         if fire.kind == "permanent":
             raise InjectedPermanentFault(site, index, fire.spec)
         raise InjectedFault(site, index, fire.spec)

@@ -23,9 +23,10 @@ is the one place retry semantics live:
     in the run manifest (obs), ``resilience.*`` counters and the CLI
     exit summary.
 
-:func:`execute_task` is the shared cache-lookup + retry helper both
-scheduler paths now call — the single RetryPolicy call site for shard
-work.
+The shared cache-lookup + retry helper (``execute_task``) moved to
+:mod:`goleft_tpu.plan.executor` — the plan layer is the single
+RetryPolicy call site now (``make plan-lint`` enforces it); a lazy
+alias here keeps the historical import path working.
 """
 
 from __future__ import annotations
@@ -98,6 +99,11 @@ class RetryPolicy:
             return "permanent"
         if isinstance(exc, InjectedFault):
             return "transient"
+        if isinstance(exc, SystemExit):
+            # a die()'d input error (io/bam.py raises SystemExit on a
+            # corrupt/unreadable file): deterministic — the poison
+            # classification the serve bisection relies on
+            return "permanent"
         if isinstance(exc, PERMANENT_TYPES):
             return "permanent"
         if isinstance(exc, TRANSIENT_TYPES):
@@ -157,46 +163,15 @@ class RetryPolicy:
 DEFAULT_POLICY = RetryPolicy()
 
 
-def execute_task(key, thunk, cache=None, policy: RetryPolicy | None
-                 = None):
-    """Cache-lookup + retry for one shard task: the ONE helper behind
-    ``run_sharded`` and ``iter_prefetched`` (previously two copy-pasted
-    loops).
+def __getattr__(name):
+    # historical import path: the implementation lives in the plan
+    # layer now (lazy to avoid a policy ↔ plan import cycle)
+    if name == "execute_task":
+        from ..plan.executor import execute_task as impl
 
-    Returns a ``parallel.scheduler.ShardResult``; failures come back
-    with ``.error`` set (shard isolation — the caller decides whether
-    to raise). Cache I/O failures never fail the task: a computed
-    value beats a broken cache (counted in
-    ``result_cache.io_errors_total``).
-    """
-    from ..parallel.scheduler import ShardResult
-
-    if policy is None:
-        policy = DEFAULT_POLICY
-    reg = get_registry()
-    if cache is not None:
-        try:
-            hit = cache.get(key)
-        except Exception:  # noqa: BLE001 — cache must not fail tasks
-            reg.counter("result_cache.io_errors_total").inc()
-            hit = None
-        if hit is not None:
-            return ShardResult(key, hit, from_cache=True)
-
-    def attempt():
-        maybe_fail("shard", key)
-        return thunk()
-
-    try:
-        val, attempts = policy.call(key, attempt)
-    except RetriesExhausted as rx:
-        return ShardResult(key, error=rx.cause, attempts=rx.attempts)
-    if cache is not None:
-        try:
-            cache.put(key, val)
-        except Exception:  # noqa: BLE001 — cache must not fail tasks
-            reg.counter("result_cache.io_errors_total").inc()
-    return ShardResult(key, val, attempts=attempts)
+        return impl
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
 
 
 class Quarantine:

@@ -17,6 +17,24 @@ must come back byte-identical:
   5. happy-path overhead: the ``cohort_resume_overhead`` measurement
      (the bench entry body) must show ≤5% checkpointing overhead
 
+then the serve legs — the same failure domains against a REAL
+``goleft-tpu serve`` daemon (PR 7):
+
+  6. poison isolation: a coalesced batch of 8 depth requests with one
+     corrupt BAM → seven 200s byte-identical to solo runs, one 400
+     flagged ``poison``, ``serve.poison_total`` incremented
+  7. circuit breaker: injected permanent device faults trip the
+     endpoint (500,500,500 → 503 shed with retry_after) and a
+     half-open probe recovers it to 200/closed
+  8. watchdog: an injected hung device pass is abandoned after the
+     budget and its request re-queued to a 200
+     (``serve.watchdog_requeues_total``)
+  9. checkpointed serve requests: a ``checkpoint: true`` cohortdepth
+     request dies with a SIGKILLed daemon mid-run; re-issued against a
+     restarted daemon it resumes from the journal byte-identically
+     (``checkpoint.shards_resumed_total`` > 0 in the /metrics
+     Prometheus body)
+
 Run directly::
 
     python -m goleft_tpu.resilience.smoke
@@ -73,6 +91,221 @@ def _make_cohort(d: str, n_samples: int = 3, ref_len: int = 6000,
 def _run(args, env, timeout_s):
     return subprocess.run(args, env=env, capture_output=True,
                           timeout=timeout_s)
+
+
+def _spawn_daemon(env, *extra_args):
+    """A real ``goleft-tpu serve`` child on an ephemeral port; returns
+    (child, base_url) once the listen line is scraped."""
+    child = subprocess.Popen(
+        [sys.executable, "-m", "goleft_tpu", "serve", "--port", "0",
+         "--no-warmup", *extra_args],
+        stdout=subprocess.PIPE, text=True, env=env)
+    line = child.stdout.readline()
+    if "listening on " not in line:
+        child.kill()
+        raise RuntimeError(
+            f"serve did not announce its port: {line!r}")
+    return child, line.rsplit("listening on ", 1)[1].strip()
+
+
+def _stop_daemon(child):
+    import signal as _signal
+
+    if child.poll() is None:
+        child.send_signal(_signal.SIGTERM)
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+    child.stdout.close()
+
+
+def _serve_poison_leg(d, fai, template_bam, env, verbose):
+    """Leg 6: one corrupt BAM in a coalesced batch of 8 fails alone
+    (400, flagged poison) while its seven neighbors' responses are
+    byte-identical to solo runs on the same daemon."""
+    import shutil
+    import threading
+
+    from ..serve.client import ServeClient, ServeError
+
+    pool = []
+    for i in range(8):
+        p = os.path.join(d, f"pool{i}.bam")
+        shutil.copy(template_bam, p)
+        shutil.copy(template_bam + ".bai", p + ".bai")
+        pool.append(p)
+    with open(pool[3], "r+b") as fh:
+        fh.write(b"\x00" * 64)  # the poison: exists, but corrupt
+    child, url = _spawn_daemon(env, "--batch-window-ms", "400")
+    try:
+        client = ServeClient(url, timeout_s=60.0)
+        solo = {p: client.depth(p, fai=fai, window=200)
+                for p in pool if p != pool[3]}
+        codes = [0] * 8
+        bodies: list = [None] * 8
+
+        def one(i):
+            try:
+                bodies[i] = client.depth(pool[i], fai=fai,
+                                         window=200)
+                codes[i] = 200
+            except ServeError as e:
+                codes[i] = e.status
+                bodies[i] = e.message
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        if sorted(codes) != [200] * 7 + [400]:
+            raise RuntimeError(
+                f"poison batch: expected seven 200s + one 400, got "
+                f"{codes}")
+        if codes[3] != 400 or "poison" not in str(bodies[3]):
+            raise RuntimeError(
+                f"the corrupt request was not the poisoned one: "
+                f"{codes[3]} {bodies[3]!r}")
+        for i, p in enumerate(pool):
+            if i != 3 and bodies[i] != solo[p]:
+                raise RuntimeError(
+                    f"neighbor {i} response differs from its solo "
+                    "run")
+        m = client.metrics()
+        if m["counters"].get("poison_total", 0) < 1:
+            raise RuntimeError("serve.poison_total not incremented")
+        if verbose:
+            print("chaos-smoke: serve poison isolated (one 400, "
+                  "seven byte-identical 200s, poison_total="
+                  f"{m['counters']['poison_total']})")
+    finally:
+        _stop_daemon(child)
+
+
+def _serve_breaker_leg(d, fai, bam, env, verbose):
+    """Leg 7: three injected permanent device faults trip the depth
+    breaker (503 shed before any queue/device work), and the half-open
+    probe after the cooldown recovers it to 200/closed."""
+    import time as _time
+
+    from ..serve.client import ServeClient, ServeError
+
+    env = dict(env, GOLEFT_TPU_FAULTS="device:every=1:permanent:"
+                                      "times=3")
+    child, url = _spawn_daemon(env, "--breaker-threshold", "3",
+                               "--breaker-cooldown-s", "0.5")
+    try:
+        client = ServeClient(url, timeout_s=60.0)
+        codes = []
+        for _ in range(4):
+            try:
+                client.depth(bam, fai=fai, window=200)
+                codes.append(200)
+            except ServeError as e:
+                codes.append(e.status)
+        if codes != [500, 500, 500, 503]:
+            raise RuntimeError(
+                f"breaker trip: expected [500, 500, 500, 503], got "
+                f"{codes}")
+        if client.metrics()["breakers"]["depth"] != "open":
+            raise RuntimeError("breaker not open after the trip")
+        _time.sleep(0.7)  # past the cooldown: half-open probe allowed
+        r = client.depth(bam, fai=fai, window=200)
+        if "depth_bed" not in r:
+            raise RuntimeError(f"probe response malformed: {r!r}")
+        m = client.metrics()
+        if m["breakers"]["depth"] != "closed":
+            raise RuntimeError("breaker did not close after the "
+                               "successful probe")
+        if m["counters"].get("breaker_rejected_total.depth", 0) < 1:
+            raise RuntimeError("no shed counted while open")
+        if verbose:
+            print("chaos-smoke: serve breaker tripped (3x500 -> 503 "
+                  "shed) and recovered (probe 200 -> closed)")
+    finally:
+        _stop_daemon(child)
+
+
+def _serve_watchdog_leg(d, fai, bam, env, verbose):
+    """Leg 8: the first device pass hangs (injected); the watchdog
+    abandons it after the 1s budget, re-queues the request at the
+    front, and the retry pass answers 200."""
+    from ..serve.client import ServeClient
+
+    env = dict(env, GOLEFT_TPU_FAULTS="device:after=1:hang")
+    child, url = _spawn_daemon(env, "--watchdog-s", "1",
+                               "--watchdog-requeues", "1")
+    try:
+        client = ServeClient(url, timeout_s=120.0)
+        r = client.depth(bam, fai=fai, window=200)
+        if "depth_bed" not in r or not r["depth_bed"]:
+            raise RuntimeError(f"post-requeue response empty: {r!r}")
+        m = client.metrics()
+        if m["counters"].get("watchdog_requeues_total", 0) != 1:
+            raise RuntimeError(
+                "watchdog_requeues_total != 1: "
+                f"{m['counters'].get('watchdog_requeues_total')}")
+        if verbose:
+            print("chaos-smoke: serve watchdog abandoned the hung "
+                  "pass and the re-queued request answered 200")
+    finally:
+        _stop_daemon(child)
+
+
+def _serve_checkpoint_leg(d, bams, fai, bed, env, verbose):
+    """Leg 9: a ``checkpoint: true`` cohortdepth request rides a
+    daemon that is SIGKILLed mid-run by an injected fault; re-issued
+    against a FRESH daemon on the same --checkpoint-root it resumes
+    from the journal, byte-identical to a non-checkpointed run."""
+    import re
+
+    from ..serve.client import ServeClient
+
+    ckroot = os.path.join(d, "serve-ck")
+    req = dict(fai=fai, window=200, bed=bed)
+    kill_env = dict(env, GOLEFT_TPU_FAULTS="shard:after=3:kill")
+    child, url = _spawn_daemon(kill_env, "--checkpoint-root", ckroot)
+    try:
+        client = ServeClient(url, timeout_s=60.0)
+        try:
+            client.cohortdepth(bams, checkpoint=True, **req)
+            raise RuntimeError(
+                "request survived a daemon that should have died")
+        except OSError:
+            pass  # connection died with the daemon — expected
+        rc = child.wait(timeout=30)
+        if rc not in (-9, 137):
+            raise RuntimeError(f"daemon did not die by SIGKILL: {rc}")
+    finally:
+        _stop_daemon(child)
+    journal = os.path.join(ckroot, "cohortdepth", "journal.jsonl")
+    committed = sum(1 for _ in open(journal))
+    if committed <= 0:
+        raise RuntimeError("no shards committed before the kill")
+
+    child, url = _spawn_daemon(env, "--checkpoint-root", ckroot)
+    try:
+        client = ServeClient(url, timeout_s=60.0)
+        resumed = client.cohortdepth(bams, checkpoint=True, **req)
+        reference = client.cohortdepth(bams, **req)
+        if resumed["matrix_tsv"] != reference["matrix_tsv"]:
+            raise RuntimeError(
+                "resumed serve matrix is NOT byte-identical to the "
+                "non-checkpointed run")
+        prom = client.metrics_prometheus()
+        m = re.search(r"^checkpoint_shards_resumed_total (\d+)",
+                      prom, re.M)
+        if m is None or int(m.group(1)) < committed:
+            raise RuntimeError(
+                f"journal replay not proven: committed={committed}, "
+                f"prom={'absent' if m is None else m.group(1)}")
+        if verbose:
+            print("chaos-smoke: serve checkpoint resumed across a "
+                  f"daemon SIGKILL+restart ({m.group(1)} shard(s) "
+                  "replayed, byte-identical)")
+    finally:
+        _stop_daemon(child)
 
 
 def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
@@ -189,6 +422,17 @@ def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
                   f"{entry['overhead_frac']:.1%} <= "
                   f"{OVERHEAD_BUDGET:.0%} (resume replay "
                   f"{entry['resume_speedup']}x faster)")
+
+        # 6-9. the serve legs: the same failure domains against a
+        # real daemon (poison isolation, breaker trip/recover,
+        # watchdog re-queue, checkpointed requests across a SIGKILL)
+        healthy_bam = bams[0]  # bams[1] was corrupted by step 4
+        _serve_poison_leg(d, fai, healthy_bam, env, verbose)
+        _serve_breaker_leg(d, fai, healthy_bam, env, verbose)
+        _serve_watchdog_leg(d, fai, healthy_bam, env, verbose)
+        _serve_checkpoint_leg(d, [bams[0], bams[2]], fai, bed, env,
+                              verbose)
+        if verbose:
             print("chaos-smoke: PASS")
     return 0
 

@@ -5,10 +5,9 @@ work item and blocks until its batch executes. A single dispatcher
 thread anchors a batch on the oldest queued item, then keeps pulling
 compatible items (same group key — same endpoint + parameter/geometry
 signature) until the batching window closes or the batch is full, and
-runs ``run_batch(key, payloads)`` once for all of them. Batches
-execute on the dispatcher thread, so device passes are serialized by
-construction — concurrency lives in the batch width, not in competing
-device dispatches.
+runs ``run_batch(key, payloads)`` once for all of them. Dispatches are
+serialized by construction — concurrency lives in the batch width,
+not in competing device passes.
 
 Bounds and failure behavior:
 
@@ -17,10 +16,26 @@ Bounds and failure behavior:
     HTTP 429) — a burst beyond capacity degrades loudly instead of
     growing an unbounded backlog
   - per-request deadline: an item still queued past its deadline is
-    failed with :class:`DeadlineExceeded` (HTTP 504) at pickup time;
-    once its batch starts executing it runs to completion
-  - error isolation: an executor exception fails every item of THAT
-    batch (each waiter re-raises it); other groups keep flowing
+    failed with :class:`DeadlineExceeded` (HTTP 504) at batch-
+    formation time — expired items never ride into a wasted device
+    pass; once its batch starts executing a request runs to
+    completion. ``grace_s`` is how long past its deadline a waiter
+    lets a STARTED batch deliver (execution time is the executor's
+    business, not the queue's)
+  - poison isolation (``bisect_isolation``): a failed multi-request
+    pass is bisected — each half re-dispatched — until the failure is
+    narrowed to the request(s) that actually cause it. An isolated
+    permanent failure with succeeding siblings fails alone as
+    :class:`PoisonRequest` (HTTP 400) while its neighbors get their
+    byte-identical results; a pass where *nobody* survives keeps the
+    original error (systemic — the server's circuit breaker's
+    business)
+  - hung-dispatch watchdog (``watchdog_s``): each pass runs on an
+    expendable worker thread; a pass exceeding the budget is
+    abandoned (its eventual results discarded) and its items re-queued
+    at the FRONT once (``max_requeues``), then failed with
+    :class:`WatchdogTimeout` (HTTP 504) — a wedged device pass costs
+    one budget, not the whole dispatcher
   - drain: ``close(drain=True)`` stops admission and lets the
     dispatcher finish everything already queued — the SIGTERM path
 """
@@ -43,6 +58,21 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before its batch executed (504)."""
 
 
+class WatchdogTimeout(DeadlineExceeded):
+    """The request's dispatch hung past the watchdog budget even after
+    a re-queue (504) — the device pass was abandoned, not wedged on."""
+
+
+class PoisonRequest(RuntimeError):
+    """This request's payload permanently fails the executor while its
+    batch siblings succeed — isolated by bisection, the server maps it
+    to HTTP 400 so one bad request cannot 500 its neighbors."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(f"request poisoned its batch: {cause!r}")
+        self.cause = cause
+
+
 @dataclass(eq=False)  # identity semantics: deque remove/in must not
 class _Item:          # compare payloads
     seq: int
@@ -52,6 +82,7 @@ class _Item:          # compare payloads
     done: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: BaseException | None = None
+    requeues: int = 0
 
     def finish(self, result=None, error=None) -> None:
         self.result = result
@@ -65,14 +96,31 @@ class MicroBatcher:
 
     def __init__(self, run_batch: Callable[[Hashable, Sequence], list],
                  window_s: float = 0.01, max_batch: int = 16,
-                 max_queue: int = 64, metrics=None):
+                 max_queue: int = 64, metrics=None,
+                 grace_s: float = 0.05,
+                 bisect_isolation: bool = True,
+                 classify: Callable[[BaseException], str] | None = None,
+                 watchdog_s: float | None = None,
+                 max_requeues: int = 1):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1 (got {max_batch})")
+        if grace_s <= 0:
+            raise ValueError(f"grace_s must be > 0 (got {grace_s})")
         self._run_batch = run_batch
         self.window_s = window_s
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.metrics = metrics
+        self.grace_s = grace_s
+        self.bisect_isolation = bisect_isolation
+        self.watchdog_s = watchdog_s
+        self.max_requeues = max_requeues
+        if classify is None:
+            # transient-vs-permanent table shared with the retry layer
+            from ..resilience.policy import DEFAULT_POLICY
+
+            classify = DEFAULT_POLICY.classify
+        self._classify = classify
         self._q: deque[_Item] = deque()
         self._cond = threading.Condition()
         self._seq = itertools.count()
@@ -100,11 +148,11 @@ class MicroBatcher:
             item = _Item(next(self._seq), key, payload, deadline)
             self._q.append(item)
             self._cond.notify_all()
-        # wait past the deadline by a grace period: if the batch STARTED
-        # in time it should be allowed to deliver (execution time is
-        # the executor's business, not the queue's)
+        # wait past the deadline by the grace period: if the batch
+        # STARTED in time it should be allowed to deliver
         while not item.done.wait(timeout=max(
-                0.05, deadline - time.monotonic() + 0.05)):
+                self.grace_s, deadline - time.monotonic()
+                + self.grace_s)):
             with self._cond:
                 if item in self._q and time.monotonic() > deadline:
                     # still queued and expired — withdraw it ourselves
@@ -122,18 +170,24 @@ class MicroBatcher:
 
     # ---- consumer side (the one dispatcher thread) ----
 
+    def _purge_expired(self, now: float) -> None:
+        """Fail every queued item whose deadline already passed (holds
+        the lock): expired work must never ride into a device pass."""
+        expired = [it for it in self._q if it.deadline < now]
+        for it in expired:
+            self._q.remove(it)
+            it.finish(error=DeadlineExceeded(
+                "request expired in queue"))
+            if self.metrics is not None:
+                self.metrics.inc("deadline_timeouts_total")
+
     def _take_batch(self) -> list[_Item] | None:
         """Anchor on the oldest live item, then collect same-key items
         until the window closes or the batch fills. Returns None when
         stopping with an empty queue."""
         with self._cond:
             while True:
-                now = time.monotonic()
-                while self._q and self._q[0].deadline < now:
-                    self._q.popleft().finish(error=DeadlineExceeded(
-                        "request expired in queue"))
-                    if self.metrics is not None:
-                        self.metrics.inc("deadline_timeouts_total")
+                self._purge_expired(time.monotonic())
                 if self._q:
                     break
                 if self._stopped:
@@ -143,6 +197,7 @@ class MicroBatcher:
             batch = [anchor]
             window_end = time.monotonic() + self.window_s
             while len(batch) < self.max_batch:
+                self._purge_expired(time.monotonic())
                 matched = [it for it in self._q if it.key == anchor.key]
                 for it in matched[: self.max_batch - len(batch)]:
                     self._q.remove(it)
@@ -155,36 +210,96 @@ class MicroBatcher:
                 self._cond.wait(timeout=remaining)
         return batch
 
-    def _loop(self) -> None:
+    def _run_tree(self, key, items: list[_Item],
+                  abandoned: threading.Event | None):
+        """One coalesced pass; on failure bisect to isolate. Returns
+        [(item, value_or_error, is_error)] covering every item."""
         from .. import obs
 
+        try:
+            kind = key[0] if isinstance(key, tuple) and key else key
+            with obs.trace(f"batch.{kind}", kind="serve-batch",
+                           batch=len(items)):
+                results = self._run_batch(
+                    key, [it.payload for it in items])
+            if len(results) != len(items):
+                raise RuntimeError(
+                    f"executor returned {len(results)} results for "
+                    f"a batch of {len(items)}")
+        except BaseException as e:  # noqa: BLE001 — batch isolation
+            if len(items) == 1 or not self.bisect_isolation \
+                    or (abandoned is not None and abandoned.is_set()):
+                return [(it, e, True) for it in items]
+            # bisect: re-dispatch each half so a poison request fails
+            # alone and its neighbors still get their (deterministic,
+            # byte-identical) results
+            if self.metrics is not None:
+                self.metrics.inc("bisect_splits_total")
+            mid = len(items) // 2
+            return self._run_tree(key, items[:mid], abandoned) + \
+                self._run_tree(key, items[mid:], abandoned)
+        return [(it, res, False) for it, res in zip(items, results)]
+
+    def _dispatch_batch(self, key, items: list[_Item],
+                        abandoned: threading.Event | None = None) \
+            -> None:
+        """Run the pass (with isolation) and finish every item. An
+        isolated permanent failure among succeeding siblings is a
+        poison request; a pass with zero survivors keeps its original
+        (systemic) error."""
+        outcomes = self._run_tree(key, items, abandoned)
+        n_ok = sum(1 for _, _, is_err in outcomes if not is_err)
+        for it, val, is_err in outcomes:
+            if abandoned is not None and abandoned.is_set():
+                return  # the watchdog owns these items now
+            if not is_err:
+                it.finish(result=val)
+            elif n_ok > 0 and self._classify(val) == "permanent":
+                if self.metrics is not None:
+                    self.metrics.inc("poison_total")
+                it.finish(error=PoisonRequest(val))
+            else:
+                it.finish(error=val)
+
+    def _loop(self) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
             if self.metrics is not None:
                 self.metrics.observe_batch(len(batch))
-            try:
-                # the dispatcher thread's own trace: one root per
-                # coalesced pass, so the executors' stage spans (which
-                # run on this thread) group under the batch they served
-                key = batch[0].key
-                kind = key[0] if isinstance(key, tuple) and key \
-                    else key
-                with obs.trace(f"batch.{kind}", kind="serve-batch",
-                               batch=len(batch)):
-                    results = self._run_batch(
-                        batch[0].key, [it.payload for it in batch])
-                if len(results) != len(batch):
-                    raise RuntimeError(
-                        f"executor returned {len(results)} results for "
-                        f"a batch of {len(batch)}")
-            except BaseException as e:  # noqa: BLE001 — batch isolation
-                for it in batch:
-                    it.finish(error=e)
+            key = batch[0].key
+            if self.watchdog_s is None:
+                self._dispatch_batch(key, batch)
                 continue
-            for it, res in zip(batch, results):
-                it.finish(result=res)
+            # watchdog: the pass runs on an expendable worker; a hang
+            # is abandoned and the items re-queued instead of wedging
+            # this (the only) dispatcher thread
+            abandoned = threading.Event()
+            worker = threading.Thread(
+                target=self._dispatch_batch, args=(key, batch,
+                                                   abandoned),
+                daemon=True, name="goleft-serve-dispatch")
+            worker.start()
+            worker.join(self.watchdog_s)
+            if not worker.is_alive():
+                continue
+            abandoned.set()
+            if self.metrics is not None:
+                self.metrics.inc("watchdog_requeues_total")
+            with self._cond:
+                for it in reversed(batch):
+                    if it.done.is_set():
+                        continue  # finished before the abandon flag
+                    it.requeues += 1
+                    if it.requeues > self.max_requeues:
+                        it.finish(error=WatchdogTimeout(
+                            f"dispatch exceeded the {self.watchdog_s:g}s "
+                            f"watchdog budget {it.requeues} times"))
+                    else:
+                        # front of the queue: they are the oldest work
+                        self._q.appendleft(it)
+                self._cond.notify_all()
 
     # ---- lifecycle ----
 

@@ -55,25 +55,25 @@ def _stage(metrics, name: str):
     return metrics.timer.stage(name)
 
 
-@contextlib.contextmanager
-def _device_stage(metrics, name: str, **attrs):
-    """The executors' dispatch boundary: the shared ``compute`` stage
-    wall-clock PLUS a device-event span carrying backend/platform/
-    device-kind attributes. The wrapped calls fetch their results to
-    host numpy before returning, so the span's extent already fences
-    on the device work — per-dispatch time here is honest without an
-    extra block_until_ready.
+def _dispatch(metrics, name: str, fn, retry: bool = True, **attrs):
+    """The executors' dispatch boundary, lowered through the plan
+    layer (plan/executor.py run_device_step): the shared ``compute``
+    stage wall-clock PLUS a device-event span carrying backend/
+    platform attributes, with the ``device`` fault site fired per
+    attempt and transient failures retried under the default
+    RetryPolicy — a flaky device/tunnel blip costs one backoff instead
+    of failing every request that shared the batch. The wrapped calls
+    fetch their results to host numpy before returning, so the span's
+    extent already fences on the device work.
 
-    Also the serve side's chaos hook: the ``device`` fault site fires
-    here, so an injected failure surfaces exactly where a real device/
-    tunnel fault would — inside the batch executor, turned into error
-    responses by the dispatcher, never a daemon crash."""
-    from ..resilience import faults
+    Failures that survive the retry budget raise out of the executor;
+    the batcher's bisect-and-retry isolation (serve/batcher.py) then
+    narrows them to the poisoned request instead of 500ing the whole
+    coalesced batch."""
+    from ..plan.executor import run_device_step
 
-    faults.maybe_fail("device", name)
-    with _stage(metrics, "compute"), \
-            obs.device_span(name, **attrs):
-        yield
+    return run_device_step(name, fn, metrics=metrics, retry=retry,
+                           **attrs)
 
 
 def _require(req: dict, field: str):
@@ -177,12 +177,10 @@ class DepthExecutor:
 
                     with _stage(self.metrics, "decode"):
                         segs = list(ex.map(_dec, opened))
-                    with _device_stage(self.metrics,
-                                       "serve.depth.dispatch",
-                                       batch=len(segs),
-                                       region=f"{c}:{s}-{e}"):
-                        starts, ends, sums, cls = \
-                            engine.run_segments_batch(segs, s, e)
+                    starts, ends, sums, cls = _dispatch(
+                        self.metrics, "serve.depth.dispatch",
+                        lambda: engine.run_segments_batch(segs, s, e),
+                        batch=len(segs), region=f"{c}:{s}-{e}")
                     if self.metrics:
                         self.metrics.inc("device_passes_total")
                     with _stage(self.metrics, "format"):
@@ -267,11 +265,11 @@ class IndexcovExecutor:
             longest = int(lengths.max())
             if longest == 0:
                 continue
-            with _device_stage(self.metrics,
-                               "serve.indexcov.dispatch",
-                               samples=S, chrom=ref_name):
-                packed = np.asarray(
-                    ops.chrom_qc(mat, valid, np.int32(longest)))
+            packed = _dispatch(
+                self.metrics, "serve.indexcov.dispatch",
+                lambda: np.asarray(
+                    ops.chrom_qc(mat, valid, np.int32(longest))),
+                samples=S, chrom=ref_name)
             if self.metrics:
                 self.metrics.inc("device_passes_total")
             _rocs, counters, cn = ops.unpack_chrom_qc(packed, S)
@@ -356,13 +354,14 @@ class PairhmmExecutor:
         bounds = np.cumsum([0] + [len(ws) for ws in per_req])
         n_pairs = sum(len(w["reads"]) * len(w["haps"])
                       for w in windows)
-        with _device_stage(self.metrics, "serve.pairhmm.dispatch",
-                           windows=len(windows), pairs=n_pairs):
-            results, n_bad = genotype.score_windows(
+        results, n_bad = _dispatch(
+            self.metrics, "serve.pairhmm.dispatch",
+            lambda: genotype.score_windows(
                 windows,
                 gap_open=float(p0.get("gap_open", 45.0)),
                 gap_ext=float(p0.get("gap_ext", 10.0)),
-                dtype=np.float64 if p0.get("f64") else np.float32)
+                dtype=np.float64 if p0.get("f64") else np.float32),
+            windows=len(windows), pairs=n_pairs)
         if self.metrics:
             self.metrics.inc("device_passes_total")
         with _stage(self.metrics, "format"):
@@ -376,15 +375,29 @@ class PairhmmExecutor:
 class CohortdepthExecutor:
     """`/v1/cohortdepth`: requests' cohorts concatenate into one
     cohort_matrix_blocks pass; each response carries its own
-    byte-identical `#chrom start end sample…` matrix."""
+    byte-identical `#chrom start end sample…` matrix.
+
+    ``checkpoint: true`` (needs the daemon's ``--checkpoint-root``)
+    runs the pass against a persistent CheckpointStore: each region's
+    per-sample columns commit as they compute, keyed by content
+    identity (file_key per BAM + window/mapq/region — independent of
+    batch composition), so a long request re-issued after a daemon
+    crash/restart resumes from the committed shards byte-identically
+    instead of starting over."""
 
     kind = "cohortdepth"
 
-    def __init__(self, processes: int = 4, metrics=None):
+    def __init__(self, processes: int = 4, metrics=None,
+                 checkpoint_root: str | None = None):
         self.processes = processes
         self.metrics = metrics
+        self.checkpoint_root = checkpoint_root
 
     def validate(self, req: dict) -> None:
+        if req.get("checkpoint") and not self.checkpoint_root:
+            raise BadRequest(
+                "checkpoint: true needs the daemon started with "
+                "--checkpoint-root")
         for p in _require(req, "bams"):
             if not os.path.exists(p):
                 raise BadRequest(f"no such file: {p}")
@@ -394,7 +407,8 @@ class CohortdepthExecutor:
         return (self.kind, _resolve_fai(req),
                 int(req.get("window", 250)), int(req.get("mapq", 1)),
                 req.get("chrom", "") or "", req.get("bed") or None,
-                req.get("engine", "auto"))
+                req.get("engine", "auto"),
+                bool(req.get("checkpoint")))
 
     def cache_files(self, req: dict) -> list[str]:
         return list(req["bams"])
@@ -403,18 +417,41 @@ class CohortdepthExecutor:
         """Advance the lazy block generator under the dispatch span:
         each block's decode + vmapped device pass happens inside
         ``next()``, so this is the cohortdepth executor's device-event
-        boundary (the values arrive as host numpy — already fenced)."""
+        boundary (the values arrive as host numpy — already fenced).
+        ``retry=False``: a half-consumed generator is not safely
+        re-attemptable — failures go straight to the batcher's bisect
+        isolation, which re-runs whole sub-batches from scratch."""
+        done = object()
         it = iter(blocks)
         i = 0
         while True:
-            with _device_stage(self.metrics,
-                               "serve.cohortdepth.dispatch", block=i):
+            def _advance():
                 try:
-                    blk = next(it)
+                    return next(it)
                 except StopIteration:
-                    return
+                    return done
+
+            blk = _dispatch(self.metrics,
+                            "serve.cohortdepth.dispatch", _advance,
+                            retry=False, block=i)
+            if blk is done:
+                return
             i += 1
             yield blk
+
+    def _open_store(self, reqs):
+        """The persistent store for ``checkpoint: true`` requests —
+        always opened with ``resume=True`` so commits accumulate
+        across requests AND daemon restarts (content-keyed: stale
+        inputs simply stop matching; entries for them go inert)."""
+        if not (self.checkpoint_root
+                and any(r.get("checkpoint") for r in reqs)):
+            return None
+        from ..resilience.checkpoint import CheckpointStore
+
+        return CheckpointStore(
+            os.path.join(self.checkpoint_root, "cohortdepth"),
+            resume=True)
 
     def run(self, reqs: Sequence[dict]) -> list[dict]:
         from ..commands.cohortdepth import cohort_matrix_blocks
@@ -423,34 +460,44 @@ class CohortdepthExecutor:
         p0 = reqs[0]
         all_bams = [p for r in reqs for p in r["bams"]]
         bounds = np.cumsum([0] + [len(r["bams"]) for r in reqs])
-        names, total_windows, blocks = cohort_matrix_blocks(
-            all_bams, fai=_resolve_fai(p0),
-            window=int(p0.get("window", 250)),
-            mapq=int(p0.get("mapq", 1)),
-            chrom=p0.get("chrom", "") or "",
-            processes=max(1, self.processes),
-            engine=p0.get("engine", "auto"), bed=p0.get("bed") or None,
-            stage_timer=self.metrics.timer if self.metrics else None,
-        )
-        use_native_fmt = native.get_lib() is not None
-        bufs = [io.StringIO() for _ in reqs]
-        for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):
-            buf.write("#chrom\tstart\tend\t"
-                      + "\t".join(names[lo:hi]) + "\n")
-        for c, starts, ends, vals in self._iter_blocks(blocks):
-            if self.metrics:
-                self.metrics.inc("device_passes_total")
+        store = self._open_store(reqs)
+        try:
+            names, total_windows, blocks = cohort_matrix_blocks(
+                all_bams, fai=_resolve_fai(p0),
+                window=int(p0.get("window", 250)),
+                mapq=int(p0.get("mapq", 1)),
+                chrom=p0.get("chrom", "") or "",
+                processes=max(1, self.processes),
+                engine=p0.get("engine", "auto"),
+                bed=p0.get("bed") or None,
+                stage_timer=self.metrics.timer if self.metrics
+                else None,
+                checkpoint=store,
+            )
+            use_native_fmt = native.get_lib() is not None
+            bufs = [io.StringIO() for _ in reqs]
             for buf, (lo, hi) in zip(bufs, zip(bounds, bounds[1:])):
-                sub = vals[lo:hi]
-                if use_native_fmt:
-                    buf.write(native.format_matrix_rows(
-                        c, starts, ends, sub).decode("ascii"))
-                else:
-                    buf.write("".join(
-                        f"{c}\t{starts[i]}\t{ends[i]}\t"
-                        + "\t".join(str(v) for v in sub[:, i]) + "\n"
-                        for i in range(len(starts))
-                    ))
+                buf.write("#chrom\tstart\tend\t"
+                          + "\t".join(names[lo:hi]) + "\n")
+            for c, starts, ends, vals in self._iter_blocks(blocks):
+                if self.metrics:
+                    self.metrics.inc("device_passes_total")
+                for buf, (lo, hi) in zip(bufs, zip(bounds,
+                                                   bounds[1:])):
+                    sub = vals[lo:hi]
+                    if use_native_fmt:
+                        buf.write(native.format_matrix_rows(
+                            c, starts, ends, sub).decode("ascii"))
+                    else:
+                        buf.write("".join(
+                            f"{c}\t{starts[i]}\t{ends[i]}\t"
+                            + "\t".join(str(v) for v in sub[:, i])
+                            + "\n"
+                            for i in range(len(starts))
+                        ))
+        finally:
+            if store is not None:
+                store.close()
         return [{
             "matrix_tsv": b.getvalue(),
             "samples": names[lo:hi],

@@ -114,7 +114,8 @@ class ServeMetrics:
 
     def snapshot(self, queue_depth: int | None = None,
                  cache_stats: dict | None = None,
-                 slo: dict | None = None) -> dict:
+                 slo: dict | None = None,
+                 breakers: dict | None = None) -> dict:
         counters = {
             n: v for n, v in self.registry.counters(_PREFIX).items()
             if not n.startswith("batch_size.")
@@ -139,4 +140,6 @@ class ServeMetrics:
             out["cache"] = cache_stats
         if slo is not None:
             out["slo"] = slo
+        if breakers is not None:
+            out["breakers"] = breakers
         return out

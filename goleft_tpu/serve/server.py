@@ -47,7 +47,9 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from .batcher import DeadlineExceeded, MicroBatcher, Overloaded
+from .batcher import (
+    DeadlineExceeded, MicroBatcher, Overloaded, PoisonRequest,
+)
 from .executors import (
     BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
     PairhmmExecutor,
@@ -73,7 +75,14 @@ class ServeApp:
                  processes: int = 4, registry=None,
                  flight_records: int = 32,
                  slo_p99_target_s: float = 2.0,
-                 slo_window_s: float = 300.0):
+                 slo_window_s: float = 300.0,
+                 grace_s: float = 0.05,
+                 bisect_isolation: bool = True,
+                 watchdog_s: float | None = 300.0,
+                 watchdog_requeues: int = 1,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown_s: float = 30.0,
+                 checkpoint_root: str | None = None):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
@@ -81,6 +90,7 @@ class ServeApp:
         self.default_timeout_s = default_timeout_s
         self.slo_p99_target_s = slo_p99_target_s
         self.slo_window_s = slo_window_s
+        self.checkpoint_root = checkpoint_root
         # flight recorder: listens on the PROCESS tracer (the serve
         # request/batch traces record there), detached in close()
         from .. import obs
@@ -92,10 +102,30 @@ class ServeApp:
             ex.kind: ex for ex in (
                 DepthExecutor(processes, self.metrics),
                 IndexcovExecutor(max(processes, 8), self.metrics),
-                CohortdepthExecutor(processes, self.metrics),
+                CohortdepthExecutor(processes, self.metrics,
+                                    checkpoint_root=checkpoint_root),
                 PairhmmExecutor(processes, self.metrics),
             )
         }
+        # per-endpoint circuit breakers: repeated systemic (500-class)
+        # failures trip the endpoint open and requests shed with 503
+        # before they ever reach the queue/429 cliff; state published
+        # as the serve.breaker.state.<kind> gauge (0 closed, 1
+        # half-open, 2 open)
+        from ..resilience.breaker import CircuitBreaker
+
+        def _make_breaker(kind):
+            gauge = self.metrics.registry.gauge(
+                f"serve.breaker.state.{kind}")
+            gauge.set(0)
+            return CircuitBreaker(
+                name=f"serve.{kind}",
+                failure_threshold=breaker_threshold,
+                cooldown_s=breaker_cooldown_s,
+                on_state=gauge.set)
+
+        self.breakers = {kind: _make_breaker(kind)
+                         for kind in self.executors}
         self.cache = None
         if cache_dir:
             from ..parallel.scheduler import ResultCache
@@ -106,8 +136,13 @@ class ServeApp:
                                     window_s=batch_window_s,
                                     max_batch=max_batch,
                                     max_queue=max_queue,
-                                    metrics=self.metrics)
+                                    metrics=self.metrics,
+                                    grace_s=grace_s,
+                                    bisect_isolation=bisect_isolation,
+                                    watchdog_s=watchdog_s,
+                                    max_requeues=watchdog_requeues)
         self.draining = False
+        self._closed = False
 
     def _run_batch(self, key, payloads):
         return self.executors[key[0]].run(payloads)
@@ -144,6 +179,21 @@ class ServeApp:
             return 404, {"error": f"unknown endpoint {kind!r}"}
         t0 = time.perf_counter()
         self.metrics.inc(f"requests_total.{kind}")
+        breaker = self.breakers.get(kind)
+        if breaker is not None and not breaker.allow():
+            # tripped: shed immediately — no queue slot, no device
+            # pass, a clear retry hint — instead of piling toward 429
+            self.metrics.inc(f"breaker_rejected_total.{kind}")
+            return 503, {
+                "error": f"circuit breaker open for {kind!r} after "
+                         "repeated upstream failures",
+                "retry_after_s": round(breaker.retry_after_s(), 3)}
+        # the breaker verdict: only a real executed request proves the
+        # site up ("success") and only a 500-class failure proves it
+        # broken ("failure") — everything else (4xx, shed, deadline,
+        # cache hit) carries no verdict but must still release a
+        # half-open probe slot
+        verdict = None
         try:
             ex.validate(req)
             ckey = self._cache_key(kind, req) if self.cache else None
@@ -157,17 +207,32 @@ class ServeApp:
                                     self.default_timeout_s))
             result = self.batcher.submit(ex.group_key(req), req,
                                          timeout_s=timeout)
+            verdict = "success"
             if ckey is not None:
                 self.cache.put(ckey, result)
         except BadRequest as e:
             return 400, {"error": str(e)}
+        except PoisonRequest as e:
+            # isolated by bisection: THIS request's payload is at
+            # fault (its siblings already got their results) — the
+            # client's 400, never the batch's 500, and never a
+            # breaker failure
+            return 400, {"error": str(e), "poison": True}
         except Overloaded as e:
             return 429, {"error": str(e)}
         except DeadlineExceeded as e:
             return 504, {"error": str(e)}
-        except Exception as e:  # noqa: BLE001 — request isolation
+        except (Exception, SystemExit) as e:  # noqa: BLE001 —
+            # request isolation. SystemExit included: io/bam.py
+            # die()s on a corrupt input, which inside a batch is a
+            # request failure, never a daemon (or handler-thread)
+            # death
             log.exception("serve: %s request failed", kind)
+            verdict = "failure"
             return 500, {"error": repr(e)}
+        finally:
+            if breaker is not None:
+                breaker.settle(verdict)
         self.metrics.observe_latency(kind, time.perf_counter() - t0)
         return 200, result
 
@@ -192,6 +257,7 @@ class ServeApp:
             slo=self.metrics.slo_snapshot(
                 p99_target_s=self.slo_p99_target_s,
                 window_s=self.slo_window_s),
+            breakers={k: b.state for k, b in self.breakers.items()},
         )
 
     def metrics_prometheus(self) -> str:
@@ -234,7 +300,14 @@ class ServeApp:
         return time.perf_counter() - t0
 
     def close(self, drain: bool = True) -> None:
+        """Idempotent: SIGTERM racing atexit (or a test fixture racing
+        ServerThread.__exit__) may close twice — the second call is a
+        no-op, and the span-listener detach itself tolerates an
+        already-detached listener."""
         self.draining = True
+        if self._closed:
+            return
+        self._closed = True
         self.batcher.close(drain=drain)
         self._tracer.remove_listener(self.flight.on_span)
 

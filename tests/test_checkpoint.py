@@ -235,6 +235,52 @@ def test_cohortdepth_midstream_failure_quarantines_and_zero_fills(
     assert rc2 == 3 and out2 == out
 
 
+def test_cohortdepth_quarantine_exit3_under_prefetch(
+        tmp_path, monkeypatch, capsys):
+    """The exit-3 quarantine contract holds on the PREFETCHED path
+    (PR 5 only proved it serial): an open-phase-corrupt sample is
+    dropped, the partial cohort is byte-identical to a healthy-only
+    run at the same --prefetch-depth AND to the serial one, and the
+    manifest names the culprit."""
+    monkeypatch.setattr(depth_mod, "STEP", 1000)
+    fa, bams = _cohort(tmp_path, seed=5)
+    with open(bams[1], "r+b") as fh:
+        fh.write(b"\x00" * 64)  # trash the BGZF header
+    ck = str(tmp_path / "ck")
+    rc, out = _run_cd(bams, fa, prefetch_depth=2, checkpoint_dir=ck)
+    assert rc == 3
+    rc_s, healthy_serial = _run_cd([bams[0], bams[2]], fa)
+    rc_p, healthy_pf = _run_cd([bams[0], bams[2]], fa,
+                               prefetch_depth=2)
+    assert rc_s == 0 and rc_p == 0
+    assert healthy_pf == healthy_serial
+    assert out == healthy_serial
+    q = json.load(open(os.path.join(ck, "quarantine.json")))
+    assert [e["source"] for e in q["quarantined"]] == [bams[1]]
+    assert "quarantined" in capsys.readouterr().err
+
+
+def test_quarantine_json_survives_resume(tmp_path, monkeypatch):
+    """--resume over a degraded run re-quarantines the still-corrupt
+    sample: exit 3 again, byte-identical partial cohort (here under
+    --prefetch-depth 2), and quarantine.json still names it."""
+    monkeypatch.setattr(depth_mod, "STEP", 1000)
+    fa, bams = _cohort(tmp_path, seed=6)
+    with open(bams[2], "r+b") as fh:
+        fh.write(b"\xff" * 64)
+    ck = str(tmp_path / "ck")
+    rc, out = _run_cd(bams, fa, checkpoint_dir=ck)
+    assert rc == 3
+    qp = os.path.join(ck, "quarantine.json")
+    assert [e["source"]
+            for e in json.load(open(qp))["quarantined"]] == [bams[2]]
+    rc2, out2 = _run_cd(bams, fa, checkpoint_dir=ck, resume=True,
+                        prefetch_depth=2)
+    assert rc2 == 3 and out2 == out
+    assert [e["source"]
+            for e in json.load(open(qp))["quarantined"]] == [bams[2]]
+
+
 def test_cohortdepth_resume_flag_requires_checkpoint_dir():
     with pytest.raises(SystemExit):
         cd.main(["--resume", "x.bam"])

@@ -1,0 +1,275 @@
+"""The plan-then-execute layer (goleft_tpu/plan/): Step/Executor
+composition semantics, the execute_task facade contract, the lint
+gate, and the cross-entry-point byte-identity acceptance (CLI vs
+prefetched vs serve outputs at every --prefetch-depth)."""
+
+import io
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import goleft_tpu
+from goleft_tpu.plan import Executor, Plan, Step, execute_task
+from goleft_tpu.plan.lint import check_tree
+from goleft_tpu.resilience import faults as faults_mod
+from goleft_tpu.resilience.checkpoint import CheckpointStore
+from goleft_tpu.resilience.policy import Quarantine, RetryPolicy
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults_mod.install(None)
+    yield
+    faults_mod.install(None)
+
+
+FAST = RetryPolicy(base_delay_s=0.0, max_delay_s=0.0)
+
+
+# ---------------- Step/Executor composition ----------------
+
+
+def test_bare_executor_just_runs_the_thunk():
+    out = Executor().run_step(Step(key=("k",), fn=lambda: 41 + 1))
+    assert out.value == 42 and out.ok and out.attempts == 1
+    assert not (out.resumed or out.from_cache or out.quarantined)
+
+
+def test_transient_failure_retried_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise TimeoutError("blip")
+        return "ok"
+
+    out = Executor(policy=FAST).run_step(Step(key=("k",), fn=flaky))
+    assert out.value == "ok" and out.attempts == 2
+
+
+def test_permanent_failure_fails_fast_and_carries_cause():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("deterministic")
+
+    out = Executor(policy=FAST).run_step(Step(key=("k",), fn=broken))
+    assert calls["n"] == 1  # never re-attempted
+    assert isinstance(out.error, ValueError)
+    assert out.classification == "permanent"
+    with pytest.raises(ValueError, match="deterministic"):
+        out.value_or_raise()
+
+
+def test_retry_false_propagates_raw():
+    with pytest.raises(TimeoutError):
+        Executor(policy=FAST).run_step(
+            Step(key=("k",), fn=lambda: (_ for _ in ()).throw(
+                TimeoutError("raw")), retry=False))
+
+
+def test_quarantine_short_circuit_and_on_exhaustion():
+    q = Quarantine()
+    pex = Executor(policy=FAST, quarantine=q)
+    out = pex.run_step(Step(
+        key=("s0", 0, 100), fn=lambda: 1 / 0,
+        quarantine_key=0, quarantine_name="s0",
+        quarantine_source="/x/s0.bam", fallback=lambda: "zeros"))
+    assert out.quarantined and out.value == "zeros"
+    assert 0 in q and q.names == ["s0"]
+    # already-quarantined key short-circuits: fn never runs
+    ran = {"n": 0}
+
+    def never():
+        ran["n"] += 1
+
+    out2 = pex.run_step(Step(key=("s0", 100, 200), fn=never,
+                             quarantine_key=0,
+                             fallback=lambda: "zeros"))
+    assert out2.quarantined and out2.value == "zeros" and ran["n"] == 0
+
+
+def test_checkpoint_resume_and_commit_single_key(tmp_path):
+    with CheckpointStore(str(tmp_path / "ck")) as ck:
+        pex = Executor(checkpoint=ck)
+        calls = {"n": 0}
+
+        def work():
+            calls["n"] += 1
+            return {"v": 7}
+
+        s = Step(key=("a",), fn=work, checkpoint_key=("ck", "a"))
+        assert pex.run(s) == {"v": 7} and calls["n"] == 1
+        assert pex.run(s) == {"v": 7} and calls["n"] == 1  # resumed
+        assert pex.run_step(s).resumed
+
+
+def test_checkpoint_multi_key_restore_and_commit(tmp_path):
+    with CheckpointStore(str(tmp_path / "ck")) as ck:
+        pex = Executor(checkpoint=ck)
+        step = Step(
+            key=("region",), fn=lambda: [10, 20],
+            checkpoint_keys=[("c", 0), ("c", 1)],
+            commit=lambda vals: [(("c", i), v)
+                                 for i, v in enumerate(vals)],
+            restore=lambda vals: [v + 1 - 1 for v in vals])
+        assert pex.run(step) == [10, 20]
+        assert ck.has(("c", 0)) and ck.has(("c", 1))
+        out = pex.run_step(step)
+        assert out.resumed and out.value == [10, 20]
+
+
+def test_resumable_false_is_commit_only(tmp_path):
+    with CheckpointStore(str(tmp_path / "ck")) as ck:
+        pex = Executor(checkpoint=ck)
+        calls = {"n": 0}
+
+        def work():
+            calls["n"] += 1
+            return calls["n"]
+
+        s = Step(key=("o",), fn=work, checkpoint_key=("ck", "o"),
+                 resumable=False)
+        assert pex.run(s) == 1
+        assert pex.run(s) == 2  # recomputed (and re-committed)
+        assert ck.get(("ck", "o")) == 2
+
+
+def test_cache_hit_and_broken_cache_tolerated(tmp_path):
+    from goleft_tpu.parallel.scheduler import ResultCache
+
+    cache = ResultCache(str(tmp_path / "rc"))
+    pex = Executor(policy=FAST, cache=cache)
+    s = Step(key=("k", 1), fn=lambda: "fresh", cacheable=True)
+    assert pex.run_step(s).from_cache is False
+    assert pex.run_step(s).from_cache is True
+
+    class Broken:
+        def get(self, key):
+            raise OSError("disk gone")
+
+        def put(self, key, value):
+            raise OSError("disk gone")
+
+    out = Executor(policy=FAST, cache=Broken()).run_step(
+        Step(key=("k", 2), fn=lambda: "computed", cacheable=True))
+    assert out.value == "computed" and out.ok
+
+
+def test_fault_site_fires_per_attempt():
+    faults_mod.install("siteX:after=1:transient")
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        return "v"
+
+    out = Executor(policy=FAST).run_step(
+        Step(key=("k",), fn=fn, site="siteX"))
+    # attempt 1 consumed by the injected transient, attempt 2 ran fn
+    assert out.value == "v" and out.attempts == 2 and calls["n"] == 1
+
+
+def test_execute_task_facade_contract(tmp_path):
+    res = execute_task(("t",), lambda: 5, policy=FAST)
+    assert res.value == 5 and res.error is None
+    res = execute_task(("t",), lambda: 1 / 0, policy=FAST)
+    assert isinstance(res.error, ZeroDivisionError)
+    # the historical import path still resolves to the same function
+    from goleft_tpu.resilience.policy import (
+        execute_task as legacy,
+    )
+
+    assert legacy is execute_task
+
+
+def test_plan_container_executes_in_order():
+    ran = []
+    plan = Plan(kind="demo")
+    for i in range(4):
+        plan.add(Step(key=("s", i),
+                      fn=lambda i=i: ran.append(i) or i * i))
+    vals = [o.value for o in Executor().execute(plan)]
+    assert vals == [0, 1, 4, 9] and ran == [0, 1, 2, 3]
+
+
+# ---------------- the lint gate ----------------
+
+
+def test_plan_lint_tree_is_clean():
+    root = os.path.dirname(os.path.abspath(goleft_tpu.__file__))
+    assert check_tree(root) == []
+
+
+def test_plan_lint_catches_raw_retry_calls(tmp_path):
+    pkg = tmp_path / "goleft_tpu"
+    (pkg / "sub").mkdir(parents=True)
+    (pkg / "sub" / "bad.py").write_text(
+        "res = execute_task(key, thunk)\n"
+        "val, _ = policy.call(key, thunk)\n"
+        "waived = execute_task(key, thunk)  # plan-lint: ok\n"
+        "# comment: execute_task( is fine in comments\n")
+    (pkg / "plan").mkdir()
+    (pkg / "plan" / "ok.py").write_text(
+        "res = execute_task(key, thunk)\n")
+    violations = check_tree(str(pkg))
+    assert len(violations) == 2
+    assert all("bad.py" in v for v in violations)
+
+
+# ---------------- cross-entry-point byte identity ----------------
+
+
+def _cohort(tmp_path, n=3, ref_len=4000, seed=11):
+    rng = np.random.default_rng(seed)
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    from goleft_tpu.io.fai import write_fai
+
+    write_fai(fa)
+    bams = []
+    for i in range(n):
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:s{i}\n")
+        p = str(tmp_path / f"s{i}.bam")
+        write_bam_and_bai(p, random_reads(rng, 400, 0, ref_len),
+                          ref_names=("chr1",), ref_lens=(ref_len,),
+                          header_text=hdr)
+        bams.append(p)
+    return fa, bams
+
+
+def test_cli_prefetched_and_serve_byte_identical(tmp_path,
+                                                 monkeypatch):
+    """Acceptance: the same cohort through all three dispatch paths —
+    cold CLI, --prefetch-depth N, and a live serve app — produces the
+    same matrix bytes at every depth."""
+    from goleft_tpu.commands import cohortdepth as cd
+    from goleft_tpu.commands import depth as depth_mod
+    from goleft_tpu.serve.client import ServeClient
+    from goleft_tpu.serve.server import ServeApp, ServerThread
+
+    monkeypatch.setattr(depth_mod, "STEP", 1000)  # 4 regions
+    fa, bams = _cohort(tmp_path)
+
+    def run_cli(**kw):
+        buf = io.StringIO()
+        rc = cd.run_cohortdepth(bams, reference=fa, window=200,
+                                out=buf, processes=2, **kw)
+        assert rc == 0
+        return buf.getvalue()
+
+    cold = run_cli()
+    for depth in (1, 2, 4):
+        assert run_cli(prefetch_depth=depth) == cold, \
+            f"prefetch depth {depth} diverged"
+
+    app = ServeApp(batch_window_s=0.05, max_batch=8)
+    with ServerThread(app) as url:
+        r = ServeClient(url, timeout_s=120).cohortdepth(
+            bams, fai=fa + ".fai", window=200)
+    assert r["matrix_tsv"] == cold

@@ -1,0 +1,408 @@
+"""Serve failure-domain hardening (PR 7): poison-request isolation by
+bisection, per-endpoint circuit breaker, hung-dispatch watchdog,
+checkpoint-backed cohortdepth requests, batcher expired-drop/grace
+satellites, idempotent double-close.
+
+Deterministic: stub executors + event gates, no sleeps > 1s.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from goleft_tpu.obs import get_registry
+from goleft_tpu.resilience.breaker import CircuitBreaker
+from goleft_tpu.serve.batcher import (
+    DeadlineExceeded, MicroBatcher, PoisonRequest, WatchdogTimeout,
+)
+from goleft_tpu.serve.server import ServeApp
+from helpers import write_bam_and_bai, write_fasta, random_reads
+
+
+class StubExec:
+    """Deterministic per-payload executor: payloads named 'poison*'
+    raise ValueError (permanent), others return a marker dict."""
+
+    kind = "depth"
+
+    def __init__(self, gates=None):
+        self.calls = []          # payload lists, per dispatch
+        self.gates = gates or [] # Events consumed one per run() call
+        self._lock = threading.Lock()
+
+    def validate(self, req):
+        pass
+
+    def group_key(self, req):
+        return ("depth", "stub")
+
+    def cache_files(self, req):
+        return []
+
+    def run(self, reqs):
+        with self._lock:
+            self.calls.append([r["name"] for r in reqs])
+            gate = self.gates.pop(0) if self.gates else None
+        if gate is not None:
+            gate.wait(timeout=30)
+        for r in reqs:
+            if r["name"].startswith("poison"):
+                raise ValueError(f"bad payload {r['name']}")
+        return [{"ok": r["name"]} for r in reqs]
+
+
+def _fire_all(app, reqs):
+    codes, bodies = [None] * len(reqs), [None] * len(reqs)
+
+    def one(i):
+        codes[i], bodies[i] = app.handle("depth", reqs[i])
+
+    ts = [threading.Thread(target=one, args=(i,))
+          for i in range(len(reqs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return codes, bodies
+
+
+# ---------------- poison isolation ----------------
+
+
+def test_poison_request_isolated_in_batch_of_8():
+    """Acceptance: a batch of 8 with one permanent failure → seven
+    200s identical to a healthy run and one 400, poison counted."""
+    app = ServeApp(batch_window_s=0.4, max_batch=8, watchdog_s=None)
+    stub = app.executors["depth"] = StubExec()
+    try:
+        reqs = [{"name": f"r{i}"} for i in range(8)]
+        reqs[3] = {"name": "poison-3"}
+        codes, bodies = _fire_all(app, reqs)
+        assert sorted(codes) == [200] * 7 + [400]
+        for req, code, body in zip(reqs, codes, bodies):
+            if req["name"] == "poison-3":
+                assert code == 400 and body.get("poison") is True
+                assert "poison-3" in body["error"]
+            else:
+                # exactly the bytes a healthy solo run returns
+                assert code == 200 and body == {"ok": req["name"]}
+        snap = app.metrics.snapshot()
+        assert snap["counters"]["poison_total"] == 1
+        assert snap["counters"]["bisect_splits_total"] >= 1
+        # coalescing actually happened (one original pass of 8)
+        assert stub.calls[0] and len(stub.calls[0]) == 8
+    finally:
+        app.close()
+
+
+def test_systemic_batch_failure_stays_500_not_poison():
+    """Every request failing is a site problem, not a poison — no
+    request should be blamed (400) for a dead device."""
+    app = ServeApp(batch_window_s=0.3, max_batch=4, watchdog_s=None)
+    app.executors["depth"] = StubExec()
+    try:
+        codes, bodies = _fire_all(
+            app, [{"name": f"poison-{i}"} for i in range(3)])
+        assert codes == [500] * 3
+        assert all("poison" not in b for b in bodies)
+        assert "poison_total" not in app.metrics.snapshot()["counters"]
+    finally:
+        app.close()
+
+
+def test_corrupt_bam_poisons_alone_real_executor(tmp_path):
+    """The realistic poison vector through the REAL depth executor: a
+    corrupt input file (io/bam.py die()s with SystemExit) 400s alone
+    while its batch siblings' responses stay byte-identical to solo
+    runs. Pins SystemExit classified permanent (resilience/policy.py)
+    and caught by the server — a poison request must never kill the
+    handler thread or 500 its neighbors."""
+    fa, bams = _cohort(tmp_path, n=3)
+    with open(bams[1], "r+b") as fh:
+        fh.write(b"\x00" * 64)  # trash the BGZF header
+    app = ServeApp(batch_window_s=0.3, max_batch=8, watchdog_s=None)
+    try:
+        solo = {}
+        for p in (bams[0], bams[2]):
+            code, body = app.handle(
+                "depth", {"bam": p, "fai": fa + ".fai",
+                          "window": 200})
+            assert code == 200
+            solo[p] = body
+        reqs = [{"bam": p, "fai": fa + ".fai", "window": 200}
+                for p in bams]
+        codes, bodies = _fire_all(app, reqs)
+        assert codes[1] == 400 and bodies[1].get("poison") is True
+        assert codes[0] == 200 and codes[2] == 200
+        assert bodies[0] == solo[bams[0]]
+        assert bodies[2] == solo[bams[2]]
+        assert app.metrics.snapshot()["counters"]["poison_total"] == 1
+    finally:
+        app.close()
+
+
+# ---------------- circuit breaker ----------------
+
+
+def test_breaker_unit_state_machine():
+    t = {"now": 0.0}
+    states = []
+    br = CircuitBreaker(name="t", failure_threshold=2, cooldown_s=10.0,
+                        on_state=states.append,
+                        clock=lambda: t["now"])
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"  # 1 < threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.retry_after_s() == pytest.approx(10.0)
+    t["now"] = 10.5
+    assert br.allow()            # the half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()        # only one probe at a time
+    br.record_failure()          # probe failed: re-open
+    assert br.state == "open"
+    t["now"] = 21.0
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    assert states == [2, 1, 2, 1, 0]
+    # a success resets the consecutive-failure streak
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_trips_sheds_and_recovers_end_to_end():
+    app = ServeApp(batch_window_s=0.0, max_batch=1, watchdog_s=None,
+                   breaker_threshold=3, breaker_cooldown_s=0.2)
+    stub = app.executors["depth"] = StubExec()
+    try:
+        # three systemic failures trip it
+        for i in range(3):
+            code, _ = app.handle("depth", {"name": f"poison-{i}"})
+            assert code == 500
+        code, body = app.handle("depth", {"name": "r-shed"})
+        assert code == 503 and "circuit breaker" in body["error"]
+        assert body["retry_after_s"] > 0
+        # shed without touching the executor
+        assert all("r-shed" not in c for call in stub.calls
+                   for c in call)
+        assert app.metrics.registry.gauge(
+            "serve.breaker.state.depth").value == 2
+        snap = app.metrics_snapshot()
+        assert snap["breakers"]["depth"] == "open"
+        assert snap["counters"]["breaker_rejected_total.depth"] == 1
+        # cooldown elapses → half-open probe succeeds → closed
+        time.sleep(0.25)
+        code, body = app.handle("depth", {"name": "r-probe"})
+        assert code == 200 and body == {"ok": "r-probe"}
+        assert app.metrics_snapshot()["breakers"]["depth"] == "closed"
+        assert app.metrics.registry.gauge(
+            "serve.breaker.state.depth").value == 0
+    finally:
+        app.close()
+
+
+def test_breaker_probe_slot_released_on_nonverdict():
+    """A 400 during half-open must release the probe slot, not wedge
+    the breaker in half-open forever."""
+    t = {"now": 0.0}
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: t["now"])
+    br.record_failure()
+    t["now"] = 2.0
+    assert br.allow()
+    br.settle(None)  # the probe turned out to be a client error
+    assert br.allow()  # next candidate may probe
+
+
+# ---------------- hung-dispatch watchdog ----------------
+
+
+def test_watchdog_requeues_hung_dispatch_then_succeeds():
+    gate = threading.Event()  # never set: the first dispatch hangs
+    app = ServeApp(batch_window_s=0.0, max_batch=1, watchdog_s=0.3,
+                   watchdog_requeues=1)
+    app.executors["depth"] = StubExec(gates=[gate])
+    try:
+        code, body = app.handle("depth", {"name": "r0"})
+        assert code == 200 and body == {"ok": "r0"}
+        snap = app.metrics.snapshot()
+        assert snap["counters"]["watchdog_requeues_total"] == 1
+    finally:
+        gate.set()
+        app.close()
+
+
+def test_watchdog_fails_request_after_requeue_budget():
+    g1, g2 = threading.Event(), threading.Event()  # both hang
+    app = ServeApp(batch_window_s=0.0, max_batch=1, watchdog_s=0.25,
+                   watchdog_requeues=1)
+    app.executors["depth"] = StubExec(gates=[g1, g2])
+    try:
+        code, body = app.handle("depth", {"name": "r0"})
+        assert code == 504
+        assert "watchdog" in body["error"]
+        assert app.metrics.snapshot()["counters"][
+            "watchdog_requeues_total"] == 2
+    finally:
+        g1.set()
+        g2.set()
+        app.close()
+
+
+def test_watchdog_timeout_is_a_deadline_subclass():
+    assert issubclass(WatchdogTimeout, DeadlineExceeded)
+
+
+# ---------------- batcher satellites ----------------
+
+
+def test_expired_items_dropped_at_batch_formation():
+    """An item whose deadline passed while queued must NOT ride into
+    a device pass (it used to coast in on the submit-side grace)."""
+    release = threading.Event()
+    seen = []
+
+    def run(key, payloads):
+        seen.append(list(payloads))
+        if payloads == ["first"]:
+            release.wait(timeout=30)
+        return list(payloads)
+
+    mb = MicroBatcher(run, window_s=0.0, max_batch=8, grace_s=5.0)
+    t0 = threading.Thread(
+        target=lambda: mb.submit(("k",), "first", timeout_s=30))
+    t0.start()
+    time.sleep(0.15)  # dispatcher is now stuck executing "first"
+    errs = []
+
+    def expired():
+        try:
+            mb.submit(("k",), "late", timeout_s=0.1)
+        except DeadlineExceeded as e:
+            errs.append(e)
+
+    t1 = threading.Thread(target=expired)
+    t1.start()
+    time.sleep(0.3)   # "late" expires while still queued
+    release.set()     # next formation must purge, not batch, it
+    t1.join(timeout=30)
+    t0.join(timeout=30)
+    mb.close()
+    assert len(errs) == 1
+    assert all("late" not in batch for batch in seen)
+
+
+def test_grace_period_is_a_constructor_knob():
+    mb = MicroBatcher(lambda k, p: list(p), grace_s=0.5)
+    assert mb.grace_s == 0.5
+    mb.close()
+    with pytest.raises(ValueError, match="grace_s"):
+        MicroBatcher(lambda k, p: list(p), grace_s=0.0)
+
+
+def test_poison_request_unit_semantics():
+    cause = ValueError("boom")
+    pr = PoisonRequest(cause)
+    assert pr.cause is cause and "boom" in str(pr)
+
+
+def test_double_close_is_idempotent():
+    app = ServeApp(batch_window_s=0.0)
+    app.close()
+    app.close()  # SIGTERM racing atexit: must not raise
+    assert app.draining
+
+
+# ---------------- checkpoint-backed serve requests ----------------
+
+
+def _cohort(tmp_path, n=3, ref_len=4000, seed=21):
+    rng = np.random.default_rng(seed)
+    fa = write_fasta(str(tmp_path / "r.fa"), {"chr1": "A" * ref_len})
+    from goleft_tpu.io.fai import write_fai
+
+    write_fai(fa)
+    bams = []
+    for i in range(n):
+        hdr = ("@HD\tVN:1.6\tSO:coordinate\n"
+               f"@SQ\tSN:chr1\tLN:{ref_len}\n@RG\tID:r\tSM:s{i}\n")
+        p = str(tmp_path / f"s{i}.bam")
+        write_bam_and_bai(p, random_reads(rng, 400, 0, ref_len),
+                          ref_names=("chr1",), ref_lens=(ref_len,),
+                          header_text=hdr)
+        bams.append(p)
+    return fa, bams
+
+
+def test_checkpoint_request_without_root_is_400(tmp_path):
+    fa, bams = _cohort(tmp_path, n=1)
+    app = ServeApp(batch_window_s=0.0, watchdog_s=None)
+    try:
+        code, body = app.handle("cohortdepth", {
+            "bams": bams, "fai": fa + ".fai", "checkpoint": True})
+        assert code == 400 and "--checkpoint-root" in body["error"]
+    finally:
+        app.close()
+
+
+def test_serve_cohortdepth_checkpoint_resumes_across_apps(
+        tmp_path, monkeypatch):
+    """A checkpointed serve request re-issued to a FRESH app (a
+    restarted daemon) resumes from the committed shards: zero decodes,
+    byte-identical matrix."""
+    from goleft_tpu.commands import cohortdepth as cd
+    from goleft_tpu.commands import depth as depth_mod
+
+    monkeypatch.setattr(depth_mod, "STEP", 1000)  # 4 regions
+    fa, bams = _cohort(tmp_path)
+    root = str(tmp_path / "serve-ck")
+    req = {"bams": bams, "fai": fa + ".fai", "window": 200,
+           "checkpoint": True}
+
+    app1 = ServeApp(batch_window_s=0.0, checkpoint_root=root,
+                    watchdog_s=None)
+    try:
+        code, cold = app1.handle("cohortdepth", dict(req))
+        assert code == 200
+        # the plain (non-checkpoint) response is byte-identical
+        code, plain = app1.handle("cohortdepth", {
+            k: v for k, v in req.items() if k != "checkpoint"})
+        assert code == 200
+        assert plain["matrix_tsv"] == cold["matrix_tsv"]
+    finally:
+        app1.close()
+    journal = os.path.join(root, "cohortdepth", "journal.jsonl")
+    committed = sum(1 for _ in open(journal))
+    assert committed == 4 * 3  # regions x samples
+
+    calls = {"n": 0}
+    real = cd._decode_shard_segments
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(cd, "_decode_shard_segments", counting)
+    resumed_before = get_registry().counter(
+        "checkpoint.shards_resumed_total").value
+    app2 = ServeApp(batch_window_s=0.0, checkpoint_root=root,
+                    watchdog_s=None)
+    try:
+        code, warm = app2.handle("cohortdepth", dict(req))
+        assert code == 200
+        assert warm["matrix_tsv"] == cold["matrix_tsv"]
+        assert calls["n"] == 0  # every shard replayed from the store
+        assert get_registry().counter(
+            "checkpoint.shards_resumed_total").value \
+            == resumed_before + committed
+    finally:
+        app2.close()
