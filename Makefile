@@ -7,7 +7,7 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke perf-gate \
-        plan-lint clean
+        lint lint-changed plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -70,13 +70,32 @@ obs-smoke:
 chaos-smoke:
 	python -m goleft_tpu.resilience.smoke
 
+# the AST invariant analyzer over the whole package: determinism
+# (sorted iteration where bytes/keys are produced), tracer hygiene in
+# jitted code, lock discipline in the threaded modules, exception
+# classification, and the plan dispatch boundary. Fails on any
+# non-baselined finding; `# gtlint: ok <rule-id> — reason` on a line
+# is a reviewed waiver, .gtlint_baseline.json the grandfathered debt
+# (docs/static-analysis.md).
+lint:
+	python -m goleft_tpu lint
+
+# the fast pre-commit shape: lint only files changed vs git HEAD
+lint-changed:
+	python -m goleft_tpu lint --changed-only
+
 # the dispatch-path-split regression gate: fails if any module outside
 # goleft_tpu/plan/ calls execute_task or a raw RetryPolicy.call loop —
 # the plan Executor is the ONE place retry/quarantine/checkpoint/
-# faults/spans compose (docs/resilience.md). `# plan-lint: ok` on a
-# line is an explicit reviewed waiver.
+# faults/spans compose (docs/resilience.md). Now the AST-resolved
+# plan-boundary rule (aliasing can't dodge it); `# plan-lint: ok` on a
+# line is still the explicit reviewed waiver.
 plan-lint:
-	python -m goleft_tpu.plan.lint
+	python -m goleft_tpu lint --only plan-boundary
+
+# the check-style aggregate: static gates first (cheap, loud), then
+# the test suite
+check: lint plan-lint test
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -1,0 +1,14 @@
+"""goleft_tpu.analysis: the AST-based invariant analyzer.
+
+Stdlib-``ast`` static analysis guarding the invariants the system's
+guarantees rest on — determinism of anything feeding output bytes or
+content keys, tracer hygiene in jitted code, lock discipline in the
+threaded serve/prefetch layers, exhaustive exception classification,
+and the plan-layer dispatch boundary. ``goleft-tpu lint`` / ``make
+lint`` is the gate; docs/static-analysis.md is the rule catalog.
+"""
+
+from .engine import AnalysisResult, run_analysis
+from .findings import Finding
+
+__all__ = ["AnalysisResult", "Finding", "run_analysis"]

@@ -1,0 +1,58 @@
+"""The committed findings baseline: grandfathered debt, with reasons.
+
+An entry suppresses findings matching (rule, path, snippet) — snippet
+rather than line number, so unrelated edits to the file do not
+resurrect it, while any edit to the offending line itself does (the
+right moment to fix it for real). Policy (docs/static-analysis.md):
+the baseline should stay near-empty; an entry needs a ``reason``
+saying why the fix is genuinely risky, and new code never lands new
+entries — it gets fixed or carries an inline ``# gtlint: ok`` waiver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .findings import Finding
+
+DEFAULT_NAME = ".gtlint_baseline.json"
+
+
+def load(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or doc.get("version") != 1 \
+            or not isinstance(doc.get("entries"), list):
+        raise ValueError(
+            f"{path}: not a gtlint baseline (want "
+            '{"version": 1, "entries": [...]})')
+    return doc["entries"]
+
+
+def save(path: str, findings: list[Finding],
+         reason: str = "grandfathered at baseline creation") -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "snippet": f.snippet,
+         "reason": reason}
+        for f in sorted(findings,
+                        key=lambda f: (f.path, f.line, f.rule))
+    ]
+    doc = {"version": 1, "entries": entries}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def split(findings: list[Finding], entries: list[dict]) \
+        -> tuple[list[Finding], list[Finding]]:
+    """(live, suppressed): findings matching a baseline entry are
+    suppressed; an entry matches any number of identical lines."""
+    keys = {(e.get("rule"), e.get("path"), e.get("snippet"))
+            for e in entries}
+    live = [f for f in findings if f.key() not in keys]
+    return live, [f for f in findings if f.key() in keys]

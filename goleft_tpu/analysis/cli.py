@@ -1,0 +1,150 @@
+"""lint: the AST invariant analyzer's CLI (``goleft-tpu lint``).
+
+Runs the five rule families over the package (default: the installed
+``goleft_tpu/`` tree), subtracts per-line waivers and the committed
+baseline, prints human or ``--json`` findings, and exits 1 on any
+live finding — the ``make lint`` CI gate.
+
+    goleft-tpu lint                      # whole package
+    goleft-tpu lint --only plan-boundary # the dispatch-split gate
+    goleft-tpu lint --changed-only       # just git-modified files
+    goleft-tpu lint --json               # stable machine output
+    goleft-tpu lint --write-baseline     # grandfather current findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from . import baseline as baseline_mod
+from .engine import run_analysis
+from .findings import to_json, to_text
+from .rules import known_ids, select
+
+
+def _default_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _changed_files(repo_root: str) -> list[str] | None:
+    """Working-tree .py changes vs HEAD plus untracked files; None
+    when git is unavailable (the caller falls back to a full run)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines())
+    if extra.returncode == 0:
+        names |= set(extra.stdout.splitlines())
+    return [os.path.join(repo_root, n) for n in sorted(names)
+            if n.endswith(".py")]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "goleft-tpu lint",
+        description="AST-based invariant analyzer: determinism, "
+                    "tracer hygiene, lock discipline, exception "
+                    "classification, plan boundary")
+    p.add_argument("root", nargs="?", default=None,
+                   help="package directory to analyze (default: the "
+                        "installed goleft_tpu package)")
+    p.add_argument("--only", default=None,
+                   help="comma-separated rule ids or family prefixes "
+                        "(e.g. plan-boundary, det, lck)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings (stable schema)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files changed vs git HEAD (falls "
+                        "back to the full tree without git)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        f"<repo>/{baseline_mod.DEFAULT_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the committed baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings as the new baseline "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print every rule id and exit")
+    a = p.parse_args(argv)
+
+    if a.list_rules:
+        for rule in select(None):
+            for rid in rule.ids:
+                print(f"{rid:<22} {rule.description}")
+        return 0
+
+    root = os.path.abspath(a.root) if a.root else _default_root()
+    if not os.path.isdir(root):
+        print(f"goleft-tpu lint: no such directory: {root}",
+              file=sys.stderr)
+        return 2
+    repo_root = os.path.dirname(root)
+    only = [s.strip() for s in a.only.split(",")] if a.only else None
+    if only:
+        bad = [o for o in only
+               if not any(rid == o or rid.startswith(o + "-")
+                          for rid in known_ids())]
+        if bad:
+            print(f"goleft-tpu lint: unknown rule id(s): "
+                  f"{', '.join(bad)} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+
+    files = None
+    if a.changed_only:
+        files = _changed_files(repo_root)
+        if files is not None and not files:
+            print("gtlint: no changed .py files — nothing to lint")
+            return 0
+
+    result = run_analysis(root, only=only, files=files)
+    for path in result.index.syntax_errors:
+        print(f"goleft-tpu lint: syntax error in {path} — skipped",
+              file=sys.stderr)
+
+    bl_path = a.baseline or os.path.join(repo_root,
+                                         baseline_mod.DEFAULT_NAME)
+    if a.write_baseline:
+        baseline_mod.save(bl_path, result.findings)
+        print(f"gtlint: baseline written to {bl_path} "
+              f"({len(result.findings)} entr"
+              f"{'y' if len(result.findings) == 1 else 'ies'})")
+        return 0
+
+    baselined = 0
+    findings = result.findings
+    if not a.no_baseline:
+        try:
+            entries = baseline_mod.load(bl_path)
+        except ValueError as e:
+            print(f"goleft-tpu lint: {e}", file=sys.stderr)
+            return 2
+        findings, suppressed = baseline_mod.split(findings, entries)
+        baselined = len(suppressed)
+
+    out = to_json(findings, baselined=baselined,
+                  waived=result.waived,
+                  rules=[r.id for r in select(only)]) if a.json \
+        else to_text(findings, baselined=baselined,
+                     waived=result.waived)
+    stream = sys.stdout if a.json or not findings else sys.stderr
+    print(out, end="" if a.json else "\n", file=stream)
+    if result.index.syntax_errors:
+        return 1
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""The analyzer's driver: index once, run every rule, apply waivers.
+
+``run_analysis`` is the whole pipeline short of baseline handling
+(cli.py owns that, so library callers — the plan-lint shim, tests —
+get raw findings):
+
+    index = build_index(root[, files])
+    for rule in select(only):
+        for module in index.modules:
+            findings += rule.check(module, index)
+    findings -= per-line waivers
+
+Findings come back sorted (path, line, rule) so two runs over the same
+tree emit byte-identical reports — the analyzer holds itself to the
+determinism bar it enforces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from . import waivers as waivers_mod
+from .findings import Finding, sort_findings
+from .index import PackageIndex, build_index
+from .rules import select
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]
+    waived: int = 0
+    index: PackageIndex | None = field(default=None, repr=False)
+
+
+def run_analysis(root: str, only: list[str] | None = None,
+                 files: list[str] | None = None) -> AnalysisResult:
+    index = build_index(root, files=files)
+    rules = select(only)
+    raw: list[Finding] = []
+    for rule in rules:
+        for module in index.modules:
+            raw.extend(rule.check(module, index))
+    live: list[Finding] = []
+    waived = 0
+    by_rel = {m.rel: m for m in index.modules}
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and waivers_mod.waives(
+                mod.waivers, f.line, f.rule):
+            waived += 1
+            continue
+        live.append(f)
+    return AnalysisResult(findings=sort_findings(live), waived=waived,
+                          index=index)

@@ -1,0 +1,77 @@
+"""Structured lint findings: the one record every rule emits.
+
+A :class:`Finding` is anchored to a source line and carries the rule
+id, a severity and a one-line message. ``snippet`` is the stripped
+source line — it doubles as the baseline identity (line numbers shift
+as files are edited; the offending *text* rarely does), so a
+grandfathered finding stays suppressed across unrelated edits.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+#: severity ladder, most severe first (sort order for reports)
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str      # repo-relative, forward slashes
+    line: int      # 1-based anchor line
+    rule: str      # rule id, e.g. "lck-unguarded-write"
+    message: str
+    severity: str = "error"
+    snippet: str = field(default="", compare=False)
+
+    def key(self) -> tuple:
+        """Baseline identity: rule + file + offending line text."""
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line} {self.rule} " \
+              f"{self.severity}: {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet}"
+        return out
+
+
+def sort_findings(findings: list[Finding]) -> list[Finding]:
+    return sorted(findings,
+                  key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def to_text(findings: list[Finding], baselined: int = 0,
+            waived: int = 0) -> str:
+    """The human report: one block per finding plus a tally line."""
+    lines = [f.render() for f in findings]
+    tail = f"gtlint: {len(findings)} finding(s)"
+    extras = []
+    if baselined:
+        extras.append(f"{baselined} baselined")
+    if waived:
+        extras.append(f"{waived} waived")
+    if extras:
+        tail += " (" + ", ".join(extras) + ")"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def to_json(findings: list[Finding], baselined: int = 0,
+            waived: int = 0, rules: list[str] | None = None) -> str:
+    """Stable machine-readable report (schema pinned by
+    tests/test_analysis.py — bump ``version`` on any shape change)."""
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "version": 1,
+        "findings": [asdict(f) for f in findings],
+        "counts": dict(sorted(counts.items())),
+        "baselined": baselined,
+        "waived": waived,
+    }
+    if rules is not None:
+        doc["rules"] = sorted(rules)
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"

@@ -1,0 +1,355 @@
+"""The shared module index every rule visits.
+
+One parse per file, shared by all rules: the AST (with parent links),
+source lines, per-line waivers, an import table that resolves local
+names to dotted origins (so ``from ..plan.executor import execute_task
+as et`` cannot dodge a rule that looks for ``execute_task``), and —
+for the lock-discipline rule — per-class structure: methods, inferred
+lock attributes, which attributes are mutated under which lock, and a
+lightweight intra-class call graph (which methods call which, and
+whether the call site holds a lock).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from . import waivers as waivers_mod
+
+#: constructors whose result makes an attribute a lock (threading.*)
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
+#: method calls that mutate their receiver in place — a
+#: ``self._q.append(...)`` is a write to ``_q`` as far as the lock
+#: rule is concerned
+MUTATORS = {
+    "append", "appendleft", "pop", "popleft", "popitem", "remove",
+    "clear", "add", "discard", "update", "extend", "insert",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+
+def set_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._gt_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST):
+    """Yield ancestors, innermost first."""
+    cur = getattr(node, "_gt_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_gt_parent", None)
+
+
+def dotted(node: ast.expr) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class AttrAccess:
+    """One write/mutation of ``self.<attr>`` inside a method."""
+
+    attr: str
+    line: int
+    locks_held: frozenset[str]  # lock attrs held at this point
+    kind: str                   # "assign" | "mutate"
+
+
+@dataclass
+class SelfCall:
+    name: str
+    line: int
+    locks_held: frozenset[str]
+
+
+@dataclass
+class MethodInfo:
+    name: str
+    node: ast.AST
+    writes: list[AttrAccess] = field(default_factory=list)
+    calls: list[SelfCall] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    lock_attrs: set[str] = field(default_factory=set)
+    methods: dict[str, MethodInfo] = field(default_factory=dict)
+
+    def guarded_attrs(self) -> set[str]:
+        """Attributes mutated at least once while holding a lock
+        (outside __init__) — the class's lock-protected state."""
+        out: set[str] = set()
+        for m in self.methods.values():
+            if m.name == "__init__":
+                continue
+            for w in m.writes:
+                if w.locks_held:
+                    out.add(w.attr)
+        return out
+
+    def lock_held_methods(self) -> set[str]:
+        """Methods whose every intra-class call site holds a lock (or
+        comes from __init__ / another lock-held method): the class's
+        '_caller holds the lock_' helpers. Fixpoint over the call
+        graph; a method with no intra-class call sites is NOT held
+        (it is a public entry point)."""
+        sites: dict[str, list[tuple[str, frozenset]]] = {}
+        for m in self.methods.values():
+            for c in m.calls:
+                sites.setdefault(c.name, []).append(
+                    (m.name, c.locks_held))
+        held: set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, callers in sites.items():
+                if name in held or name not in self.methods:
+                    continue
+                if all(bool(locks) or caller == "__init__"
+                       or caller in held
+                       for caller, locks in callers):
+                    held.add(name)
+                    changed = True
+        return held
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect self-attribute writes/mutations and self-calls in one
+    method body, tracking which lock attributes are held (``with
+    self.<lock>:`` nesting)."""
+
+    def __init__(self, info: MethodInfo, lock_attrs: set[str]):
+        self.info = info
+        self.lock_attrs = lock_attrs
+        self._held: list[str] = []
+
+    def _self_attr(self, node) -> str | None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _record_target(self, target: ast.expr, line: int) -> None:
+        # self.x = ... / self.x[...] = ... both mutate x
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        attr = self._self_attr(target)
+        if attr is not None:
+            self.info.writes.append(AttrAccess(
+                attr, line, frozenset(self._held), "assign"))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, line)
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv_attr = self._self_attr(fn.value)
+            if recv_attr is not None and fn.attr in MUTATORS:
+                self.info.writes.append(AttrAccess(
+                    recv_attr, node.lineno, frozenset(self._held),
+                    "mutate"))
+            self_call = self._self_attr(fn)
+            if self_call is not None:
+                self.info.calls.append(SelfCall(
+                    self_call, node.lineno, frozenset(self._held)))
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With):
+        acquired: list[str] = []
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call):
+                ctx = ctx.func  # with self._lock() / acquire helpers
+            attr = self._self_attr(ctx)
+            if attr is not None and attr in self.lock_attrs:
+                acquired.append(attr)
+        self._held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    # nested defs share the enclosing method's lock context only if
+    # called inline; treating them as same-context is the useful
+    # approximation for the closure-heavy serve code
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+@dataclass
+class ModuleInfo:
+    path: str            # absolute
+    rel: str             # relative to the scan root's parent
+    tree: ast.Module
+    lines: list[str]
+    imports: dict[str, str]
+    waivers: dict[int, set[str]]
+    classes: list[ClassInfo]
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def resolve(self, node: ast.expr) -> str | None:
+        """Dotted origin of a Name/Attribute chain through the import
+        table: ``np.asarray`` → ``numpy.asarray``; an un-imported bare
+        name resolves to itself."""
+        d = dotted(node)
+        if d is None:
+            return None
+        head, _, rest = d.partition(".")
+        origin = self.imports.get(head, head)
+        return origin + ("." + rest if rest else "")
+
+
+def _imports(tree: ast.Module, modname: str) -> dict[str, str]:
+    table: dict[str, str] = {}
+    pkg_parts = modname.split(".")[:-1] if modname else []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                table[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                mod = ".".join(base + ([node.module]
+                                       if node.module else []))
+            else:
+                mod = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                table[a.asname or a.name] = \
+                    (mod + "." if mod else "") + a.name
+    return table
+
+
+def _classes(tree: ast.Module, module: "ModuleInfo") -> list[ClassInfo]:
+    out: list[ClassInfo] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(node.name, node)
+        fndefs = [n for n in node.body
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+        # pass 1: inferred lock attributes (any method, usually
+        # __init__): self.<x> = threading.Lock()/RLock()/Condition()
+        for fn in fndefs:
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                if not isinstance(sub.value, ast.Call):
+                    continue
+                origin = module.resolve(sub.value.func)
+                if origin not in LOCK_FACTORIES:
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        ci.lock_attrs.add(t.attr)
+        # pass 2: per-method writes/mutations/calls with lock context
+        for fn in fndefs:
+            mi = MethodInfo(fn.name, fn)
+            _MethodScanner(mi, ci.lock_attrs).visit(fn)
+            ci.methods[fn.name] = mi
+        out.append(ci)
+    return out
+
+
+def load_module(path: str, root: str) -> ModuleInfo | None:
+    """Parse one file into a ModuleInfo; None on a syntax error (the
+    engine reports those separately — a lint gate must not crash on
+    the code it guards)."""
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        return None
+    set_parents(tree)
+    base = os.path.dirname(os.path.abspath(root))
+    rel = os.path.relpath(os.path.abspath(path), base) \
+        .replace(os.sep, "/")
+    modname = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+    mod = ModuleInfo(path=os.path.abspath(path), rel=rel, tree=tree,
+                     lines=src.splitlines(), imports={}, waivers={},
+                     classes=[])
+    mod.imports = _imports(tree, modname)
+    mod.waivers = waivers_mod.parse_source(mod.lines)
+    mod.classes = _classes(tree, mod)
+    return mod
+
+
+@dataclass
+class PackageIndex:
+    root: str                      # the scanned package directory
+    modules: list[ModuleInfo]
+    syntax_errors: list[str] = field(default_factory=list)
+
+
+def build_index(root: str, files: list[str] | None = None) \
+        -> PackageIndex:
+    """Index ``root`` (a package directory). ``files`` restricts the
+    set (--changed-only); paths outside root are ignored."""
+    root = os.path.abspath(root)
+    if files is None:
+        files = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            files.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames)
+                         if f.endswith(".py"))
+    else:
+        files = sorted(
+            os.path.abspath(f) for f in files
+            if f.endswith(".py")
+            and os.path.abspath(f).startswith(root + os.sep))
+    modules, bad = [], []
+    for path in files:
+        if not os.path.exists(path):
+            continue  # --changed-only on a deleted file
+        mod = load_module(path, root)
+        if mod is None:
+            bad.append(path)
+        else:
+            modules.append(mod)
+    return PackageIndex(root=root, modules=modules, syntax_errors=bad)

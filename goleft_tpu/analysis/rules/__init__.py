@@ -1,0 +1,42 @@
+"""Rule registry: one instance per rule family.
+
+Adding a rule: write a class with ``id`` (primary), ``ids`` (every id
+it can emit), ``severity``, ``description`` and
+``check(module, index) -> list[Finding]``; append an instance here.
+docs/static-analysis.md documents the process end to end.
+"""
+
+from __future__ import annotations
+
+from .determinism import DeterminismRule
+from .exceptions import ExceptionRule
+from .locks import LockDisciplineRule
+from .plan_boundary import PlanBoundaryRule
+from .tracer import TracerRule
+
+ALL_RULES = (
+    DeterminismRule(),
+    TracerRule(),
+    LockDisciplineRule(),
+    ExceptionRule(),
+    PlanBoundaryRule(),
+)
+
+
+def select(only: list[str] | None):
+    """Rules matching ``only`` (ids or id prefixes, e.g. ``det`` or
+    ``plan-boundary``); all of them when ``only`` is falsy."""
+    if not only:
+        return list(ALL_RULES)
+    sel = []
+    for rule in ALL_RULES:
+        for want in only:
+            if any(rid == want or rid.startswith(want + "-")
+                   for rid in rule.ids):
+                sel.append(rule)
+                break
+    return sel
+
+
+def known_ids() -> list[str]:
+    return sorted(rid for rule in ALL_RULES for rid in rule.ids)

@@ -1,0 +1,178 @@
+"""Determinism rules: byte-identity is the system's headline guarantee.
+
+Everything that feeds output bytes or a content-identity key (cache
+keys, checkpoint keys, batch signatures) must iterate in a defined
+order and derive from the inputs alone. Two rules:
+
+``det-unsorted-iter``
+    A directory listing (``os.listdir`` / ``os.scandir`` /
+    ``glob.glob`` / ``Path.iterdir``) not wrapped in ``sorted()``.
+    Filesystem order is whatever the kernel feels like; any consumer
+    inherits that nondeterminism. Also flags direct iteration over a
+    set — a set literal, ``set(...)`` call, set comprehension, or a
+    local variable bound to one — in ``for`` / comprehensions, where
+    Python's hash randomization makes order vary run to run.
+    Order-independent accumulation (counting bytes, building a dict
+    that is later sorted) earns an inline waiver, not an exemption.
+
+``det-key-entropy``
+    ``time.*`` / ``random.*`` / ``uuid.*`` / ``os.urandom`` reachable
+    from key-construction code (a function whose name contains
+    ``key`` or ``digest``): a content key with wall-clock or entropy
+    in it silently defeats checkpoint resume and cache replay.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex, parents
+
+LISTING_CALLS = {
+    "os.listdir", "os.scandir", "glob.glob", "glob.iglob",
+}
+
+ENTROPY_CALLS_PREFIX = ("random.", "uuid.", "secrets.")
+ENTROPY_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic",
+    "time.monotonic_ns", "time.perf_counter", "os.urandom",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+ID_UNSORTED = "det-unsorted-iter"
+ID_ENTROPY = "det-key-entropy"
+
+
+def _in_sorted(node: ast.AST) -> bool:
+    """Is some ancestor expression a sorted()/sorted-ish call that
+    defines the order (or an order-insensitive reduction)?"""
+    for p in parents(node):
+        if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                and p.func.id in ("sorted", "len", "sum", "set",
+                                  "min", "max", "frozenset", "any",
+                                  "all"):
+            return True
+        if isinstance(p, ast.Compare):
+            return True  # `x in os.listdir(d)` — membership, no order
+        if isinstance(p, ast.stmt):
+            break
+    return False
+
+
+def _set_locals(fn: ast.AST) -> set[str]:
+    """Local names bound to an obvious set in this function body."""
+    names: set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and _is_set_expr(sub.value):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(sub, ast.AnnAssign) \
+                and isinstance(sub.target, ast.Name):
+            ann = sub.annotation
+            if (isinstance(ann, ast.Name) and ann.id == "set") or \
+                    (sub.value is not None
+                     and _is_set_expr(sub.value)):
+                names.add(sub.target.id)
+    return names
+
+
+def _is_set_expr(node: ast.expr) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return isinstance(node, ast.Call) \
+        and isinstance(node.func, ast.Name) \
+        and node.func.id in ("set", "frozenset")
+
+
+class DeterminismRule:
+    id = ID_UNSORTED  # primary id (emits det-key-entropy too)
+    ids = (ID_UNSORTED, ID_ENTROPY)
+    severity = "error"
+    description = ("unsorted filesystem/set iteration, and wall-clock/"
+                   "entropy inside key construction")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        out += self._unsorted_listings(module)
+        out += self._set_iteration(module)
+        out += self._key_entropy(module)
+        return out
+
+    def _unsorted_listings(self, module: ModuleInfo) -> list[Finding]:
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = module.resolve(node.func)
+            if origin not in LISTING_CALLS or _in_sorted(node):
+                continue
+            out.append(Finding(
+                module.rel, node.lineno, ID_UNSORTED,
+                f"{origin}() order is filesystem-dependent — wrap in "
+                "sorted() (or waive if provably order-independent)",
+                snippet=module.snippet(node.lineno)))
+        return out
+
+    def _set_iteration(self, module: ModuleInfo) -> list[Finding]:
+        out = []
+        fns = [n for n in ast.walk(module.tree)
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]
+        for fn in fns:
+            set_names = _set_locals(fn)
+
+            def flag(iter_node, line):
+                is_set = _is_set_expr(iter_node) or (
+                    isinstance(iter_node, ast.Name)
+                    and iter_node.id in set_names)
+                if is_set and not _in_sorted(iter_node):
+                    out.append(Finding(
+                        module.rel, line, ID_UNSORTED,
+                        "iteration over a set is hash-order "
+                        "(randomized per process) — sorted() it "
+                        "before anything that feeds output bytes "
+                        "or keys",
+                        snippet=module.snippet(line)))
+
+            for sub in fn.body:
+                for node in ast.walk(sub):
+                    # skip nested defs: they run their own pass
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and node is not fn:
+                        continue
+                    if isinstance(node, ast.For):
+                        flag(node.iter, node.lineno)
+                    elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                           ast.SetComp, ast.DictComp)):
+                        for gen in node.generators:
+                            flag(gen.iter, node.lineno)
+        return out
+
+    def _key_entropy(self, module: ModuleInfo) -> list[Finding]:
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            lname = node.name.lower()
+            if "key" not in lname and "digest" not in lname:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                origin = module.resolve(sub.func)
+                if origin is None:
+                    continue
+                if origin in ENTROPY_CALLS or \
+                        origin.startswith(ENTROPY_CALLS_PREFIX):
+                    out.append(Finding(
+                        module.rel, sub.lineno, ID_ENTROPY,
+                        f"{origin}() inside key construction "
+                        f"({node.name}): content keys must derive "
+                        "from inputs alone or resume/replay breaks",
+                        snippet=module.snippet(sub.lineno)))
+        return out

@@ -1,0 +1,129 @@
+"""Exception classification: no silent swallowing in the fault layers.
+
+Scoped to the layers whose whole job is principled failure handling
+(``plan/``, ``resilience/``, ``serve/``, ``parallel/``): a broad
+handler (``except Exception:``, ``except BaseException:`` or a bare
+``except:``) must do one of:
+
+  - re-raise (``raise`` anywhere in the handler body),
+  - route through the classification machinery (a call mentioning
+    ``classify`` / ``RetriesExhausted`` / ``maybe_fail`` or a
+    quarantine ``add``), or
+  - at minimum leave evidence (``log.exception`` / ``log.warning`` /
+    a metrics counter ``inc``) — and carry the repo's standing
+    ``# noqa: BLE001`` annotation with its justification.
+
+A handler that does none of these swallows the error class the
+RetryPolicy's transient/permanent split exists to distinguish: a
+transient fault silently eaten here never reaches the retry loop, a
+permanent one never reaches quarantine. ``# noqa: BLE001`` (or
+``# gtlint: ok exc-swallow``) on the ``except`` line waives it, as it
+always has — the rule exists to make NEW swallows a reviewed decision.
+
+``exc-open-nocm`` (same family, package-wide): an ``open()`` whose
+handle is consumed inline — ``json.load(open(p))``, ``sum(1 for _ in
+open(p))`` — with no ``with`` and no name to close. On CPython it
+leaks until a GC cycle runs; under the serve daemon's thread pools
+that is an eventual fd-exhaustion outage. Assigned handles
+(``self._fh = open(...)``) and factory returns are the caller's
+responsibility and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex, parents
+
+ID = "exc-swallow"
+ID_OPEN = "exc-open-nocm"
+
+SCOPED_DIRS = ("/plan/", "/resilience/", "/serve/", "/parallel/")
+
+#: call-name fragments that count as routing/evidence
+ROUTING_MARKERS = (
+    "classify", "maybe_fail", "exception", "warning", "error",
+    "inc", "add", "finish", "put", "quarantine", "record_failure",
+    "settle",
+)
+
+
+class ExceptionRule:
+    id = ID
+    ids = (ID, ID_OPEN)
+    severity = "error"
+    description = ("broad except that swallows without re-raise, "
+                   "classification routing, or logged evidence; "
+                   "inline open() with no context manager")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = self._inline_opens(module)
+        if not any(d in "/" + module.rel for d in SCOPED_DIRS):
+            return out
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(module, node):
+                continue
+            if self._handled(node):
+                continue
+            out.append(Finding(
+                module.rel, node.lineno, ID,
+                "broad except swallows the failure: re-raise, route "
+                "it through RetryPolicy.classify/quarantine, or log "
+                "it (then waive with # noqa: BLE001 and a reason)",
+                snippet=module.snippet(node.lineno)))
+        return out
+
+    @staticmethod
+    def _inline_opens(module: ModuleInfo) -> list[Finding]:
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = module.resolve(node.func)
+            if origin not in ("open", "gzip.open", "bz2.open",
+                              "lzma.open", "io.open"):
+                continue
+            parent = next(parents(node), None)
+            # managed/owned handles are fine: with open(...), x =
+            # open(...), return open(...)  (factories hand ownership
+            # to the caller — utils/xopen.py's whole contract)
+            if isinstance(parent, (ast.withitem, ast.Assign,
+                                   ast.AnnAssign, ast.Return,
+                                   ast.NamedExpr)):
+                continue
+            out.append(Finding(
+                module.rel, node.lineno, ID_OPEN,
+                f"{origin}() consumed inline with no `with` and no "
+                "name to close: the handle leaks until GC — wrap it "
+                "in a context manager",
+                snippet=module.snippet(node.lineno)))
+        return out
+
+    @staticmethod
+    def _broad(module: ModuleInfo, node: ast.ExceptHandler) -> bool:
+        t = node.type
+        if t is None:
+            return True  # bare except:
+        types = t.elts if isinstance(t, ast.Tuple) else [t]
+        for ty in types:
+            origin = module.resolve(ty) or ""
+            if origin.split(".")[-1] in ("Exception", "BaseException"):
+                return True
+        return False
+
+    @staticmethod
+    def _handled(node: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                name = fn.attr if isinstance(fn, ast.Attribute) \
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                if any(m in name for m in ROUTING_MARKERS):
+                    return True
+        return False

@@ -1,0 +1,68 @@
+"""Lock discipline: a static race detector for the threaded classes.
+
+For every class that owns a lock (an attribute assigned
+``threading.Lock()``/``RLock()``/``Condition()``/``Semaphore()``),
+infer the lock-protected state — the attributes mutated at least once
+while holding the lock, outside ``__init__`` — then flag any mutation
+of that state at a point that does NOT hold a lock:
+
+  - ``__init__`` writes are exempt (the object is not shared yet)
+  - a method whose every intra-class call site holds the lock (or is
+    ``__init__`` / another such method) is treated as lock-held — the
+    ``_caller_holds_lock`` helper pattern (``CircuitBreaker._set_state``,
+    ``MicroBatcher._purge_expired``) — via the index's call graph
+  - mutations include in-place method calls (``self._q.append``),
+    subscript stores (``self._completed[k] = v``) and augmented
+    assignment (``self.n += 1``), not just plain assignment
+
+The inverse (reads outside the lock) is deliberately not flagged:
+CPython makes torn reads of a single attribute rare and the
+signal/noise would drown the real races — the write side is where
+lost updates and double-finishes come from.
+"""
+
+from __future__ import annotations
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex
+
+ID = "lck-unguarded-write"
+
+
+class LockDisciplineRule:
+    id = ID
+    ids = (ID,)
+    severity = "error"
+    description = ("write to lock-guarded shared state from a method "
+                   "that does not hold the lock")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        for ci in module.classes:
+            if not ci.lock_attrs:
+                continue
+            guarded = ci.guarded_attrs()
+            if not guarded:
+                continue
+            held_methods = ci.lock_held_methods()
+            for m in ci.methods.values():
+                if m.name == "__init__" or m.name in held_methods:
+                    continue
+                for w in m.writes:
+                    if w.attr in guarded and not w.locks_held:
+                        verb = ("mutation of" if w.kind == "mutate"
+                                else "write to")
+                        out.append(Finding(
+                            module.rel, w.line, ID,
+                            f"{ci.name}.{m.name}: {verb} "
+                            f"lock-guarded attribute {w.attr!r} "
+                            "without holding "
+                            f"{self._locks(ci)} — lost updates / "
+                            "torn state under the serve threads",
+                            snippet=module.snippet(w.line)))
+        return out
+
+    @staticmethod
+    def _locks(ci) -> str:
+        return "/".join(sorted(f"self.{a}" for a in ci.lock_attrs))

@@ -1,0 +1,98 @@
+"""Plan boundary: the dispatch-path-split gate, now alias-proof.
+
+The plan Executor (``goleft_tpu/plan/executor.py``) is the ONE place
+retry/quarantine/checkpoint/faults/spans compose. The grep-era gate
+(``plan/lint.py``) banned the literal tokens ``execute_task(`` and
+``policy.call(`` outside ``goleft_tpu/plan/``; this rule resolves
+names through the import table, so
+
+    from goleft_tpu.plan.executor import execute_task as et
+    et(key, thunk)                      # caught: resolves to the facade
+    p = RetryPolicy(retries=3); p.call  # caught: local RetryPolicy
+    RetryPolicy().call(key, thunk)      # caught: direct construction
+
+cannot dodge it, while a method merely *named* ``call`` on an
+unrelated object no longer false-positives. Modules under the
+package's ``plan/`` directory are exempt (definitions live there);
+``# plan-lint: ok`` on the line is the historical waiver and still
+works (waivers.py maps it onto this rule id).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex
+
+ID = "plan-boundary"
+
+MSG = ("direct retry-layer call outside goleft_tpu/plan/ — lower the "
+       "work into a plan Step (docs/resilience.md)")
+
+
+def _retry_policy_locals(fn: ast.AST, module: ModuleInfo) -> set[str]:
+    """Local names bound to a RetryPolicy(...) instance."""
+    names: set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) \
+                and isinstance(sub.value, ast.Call):
+            origin = module.resolve(sub.value.func) or ""
+            if origin.split(".")[-1] == "RetryPolicy":
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+class PlanBoundaryRule:
+    id = ID
+    ids = (ID,)
+    severity = "error"
+    description = ("execute_task / raw RetryPolicy.call reached from "
+                   "outside the plan layer")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        parts = module.rel.split("/")
+        if "plan" in parts[:-1]:
+            return []  # the plan package itself: definitions exempt
+        policy_names = _retry_policy_locals(module.tree, module)
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._violation(module, node, policy_names)
+            if msg:
+                out.append(Finding(
+                    module.rel, node.lineno, ID, msg,
+                    snippet=module.snippet(node.lineno)))
+        return out
+
+    @staticmethod
+    def _violation(module: ModuleInfo, node: ast.Call,
+                   policy_names: set[str]) -> str | None:
+        fn = node.func
+        origin = module.resolve(fn) or ""
+        # execute_task under any alias/import path (an unresolvable
+        # bare name called execute_task counts: the grep gate did,
+        # and a star-import must not create a hole)
+        if origin.split(".")[-1] == "execute_task":
+            return ("call execute_task via goleft_tpu.plan "
+                    "(Executor/Step); " + MSG)
+        if isinstance(fn, ast.Attribute) and fn.attr == "call":
+            recv = fn.value
+            # RetryPolicy(...).call(...)
+            if isinstance(recv, ast.Call):
+                ro = module.resolve(recv.func) or ""
+                if ro.split(".")[-1] == "RetryPolicy":
+                    return "raw RetryPolicy.call loop; " + MSG
+            if isinstance(recv, ast.Name):
+                rid = recv.id
+                if rid in policy_names or rid == "DEFAULT_POLICY" \
+                        or rid == "policy" or rid.endswith("_policy"):
+                    return "raw RetryPolicy.call loop; " + MSG
+            ro = module.resolve(recv) or ""
+            if ro.split(".")[-1] in ("DEFAULT_POLICY", "RetryPolicy"):
+                return "raw RetryPolicy.call loop; " + MSG
+        return None

@@ -1,0 +1,212 @@
+"""Tracer hygiene: host escapes inside jitted code.
+
+Scoped to the kernel layers (``ops/``, ``parallel/``, ``models/``) —
+the modules whose functions run under ``jax.jit``/``vmap``. Two rules:
+
+``trc-host-call``
+    A host-side call inside a jit-decorated function body: ``.item()``,
+    ``np.asarray``/``np.array`` materialization, ``jax.device_get``,
+    ``print``, ``time.*`` — each forces a blocking device sync (or
+    crashes on a tracer), defeating exactly the async dispatch the
+    kernels are built around. Python ``if`` on a *traced* parameter is
+    flagged too (``static_argnames`` parameters are exempt — branching
+    on those is the point of making them static).
+
+``trc-ambient-dtype``
+    ``jnp.zeros/ones/full/empty/arange/array`` without an explicit
+    dtype in kernel modules: the ambient default flips with the x64
+    flag and the platform, and byte-identity across hosts dies with
+    it. Pass ``dtype=`` (a positional dtype argument counts).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex, dotted
+
+ID_HOST = "trc-host-call"
+ID_DTYPE = "trc-ambient-dtype"
+
+#: module path fragments this rule applies to
+KERNEL_DIRS = ("/ops/", "/parallel/", "/models/")
+
+HOST_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.save", "numpy.concatenate",
+    "jax.device_get", "print", "time.time", "time.monotonic",
+    "time.perf_counter", "time.sleep",
+}
+
+#: jnp allocators that take dtype (positionally after the first arg
+#: for all but ``array``, whose 2nd positional is also dtype)
+ALLOCATORS = {"zeros", "ones", "full", "empty", "arange", "array",
+              "linspace"}
+
+
+def _jit_functions(module: ModuleInfo):
+    """(fn node, static_argnames) for functions decorated with
+    jax.jit / functools.partial(jax.jit, ...) / jax.vmap, plus local
+    defs passed directly to a jax.jit(...) call."""
+    out = []
+    jitted_names: dict[str, tuple] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                statics = _jit_decoration(module, dec)
+                if statics is not None:
+                    out.append((node, statics))
+                    break
+        elif isinstance(node, ast.Call):
+            # f = jax.jit(impl, static_argnames=(...)) — remember the
+            # impl name; resolved against module-level defs below
+            origin = module.resolve(node.func)
+            if origin in ("jax.jit", "jax.vmap") and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                jitted_names[node.args[0].id] = \
+                    _statics_from_call(node)
+    if jitted_names:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node.name in jitted_names:
+                out.append((node, jitted_names[node.name]))
+    return out
+
+
+def _jit_decoration(module: ModuleInfo, dec: ast.expr):
+    """static_argnames tuple if ``dec`` is a jit/vmap decoration,
+    else None."""
+    if isinstance(dec, ast.Call):
+        origin = module.resolve(dec.func)
+        if origin in ("jax.jit", "jax.vmap"):
+            return _statics_from_call(dec)
+        if origin in ("functools.partial", "partial") and dec.args:
+            inner = module.resolve(dec.args[0])
+            if inner in ("jax.jit", "jax.vmap"):
+                return _statics_from_call(dec)
+        return None
+    origin = module.resolve(dec)
+    if origin in ("jax.jit", "jax.vmap", "jit", "vmap"):
+        return ()
+    return None
+
+
+def _statics_from_call(call: ast.Call) -> tuple:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant))
+            if isinstance(v, ast.Constant):
+                return (v.value,)
+    return ()
+
+
+def _params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+class TracerRule:
+    id = ID_HOST
+    ids = (ID_HOST, ID_DTYPE)
+    severity = "error"
+    description = ("host calls / traced-value branching inside jitted "
+                   "bodies; ambient-dtype jnp allocations in kernels")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        if not any(d in "/" + module.rel for d in KERNEL_DIRS):
+            return []
+        out: list[Finding] = []
+        for fn, statics in _jit_functions(module):
+            out += self._host_calls(module, fn, statics)
+        out += self._ambient_dtype(module)
+        return out
+
+    def _host_calls(self, module, fn, statics) -> list[Finding]:
+        out = []
+        traced = {p for p in _params(fn)
+                  if p not in statics and not isinstance(statics, bool)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                origin = module.resolve(node.func)
+                if origin in HOST_CALLS or (
+                        origin is not None
+                        and origin.startswith("numpy.")):
+                    out.append(Finding(
+                        module.rel, node.lineno, ID_HOST,
+                        f"host call {origin}() inside jitted "
+                        f"{fn.name}(): forces a sync or crashes on a "
+                        "tracer — use jnp / move it outside the jit",
+                        snippet=module.snippet(node.lineno)))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    out.append(Finding(
+                        module.rel, node.lineno, ID_HOST,
+                        f".item() inside jitted {fn.name}(): blocking "
+                        "host round-trip — keep the value on device",
+                        snippet=module.snippet(node.lineno)))
+            elif isinstance(node, ast.If):
+                for name in ast.walk(node.test):
+                    if isinstance(name, ast.Name) \
+                            and name.id in traced:
+                        out.append(Finding(
+                            module.rel, node.lineno, ID_HOST,
+                            f"Python `if` on traced parameter "
+                            f"{name.id!r} in jitted {fn.name}(): "
+                            "TracerBoolConversionError at trace time "
+                            "— use jnp.where / make it a "
+                            "static_argname",
+                            snippet=module.snippet(node.lineno)))
+                        break
+        return out
+
+    def _ambient_dtype(self, module) -> list[Finding]:
+        # only true kernel files (ops/): parallel/ and models/ build
+        # host-side scaffolding where numpy defaults are deliberate
+        if "/ops/" not in "/" + module.rel:
+            return []
+        out = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d is None:
+                continue
+            head, _, attr = d.rpartition(".")
+            if module.imports.get(head, head) != "jax.numpy" \
+                    or attr not in ALLOCATORS:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            # positional dtype: zeros/ones/full/empty take it 2nd
+            # (3rd for full), arange accepts it 4th — treat any extra
+            # positional arg that names a dtype as explicit
+            if any(_looks_dtype(module, a) for a in node.args[1:]):
+                continue
+            out.append(Finding(
+                module.rel, node.lineno, ID_DTYPE,
+                f"jnp.{attr}() without an explicit dtype in kernel "
+                "code: the ambient default varies with platform/x64 "
+                "— pass dtype=",
+                snippet=module.snippet(node.lineno)))
+        return out
+
+
+def _looks_dtype(module: ModuleInfo, node: ast.expr) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "dtype":
+        return True  # jnp.zeros(shape, raw.dtype)
+    if isinstance(node, ast.Name) and "dtype" in node.id.lower():
+        return True  # jnp.zeros(r1, dtype) — threaded-through dtype
+    if isinstance(node, ast.Call):
+        # a typed scalar fixes the result dtype: jnp.full(s, jnp.int32(x))
+        origin = module.resolve(node.func) or ""
+        return origin.startswith(("numpy.", "jax.numpy."))
+    d = dotted(node) or ""
+    head = d.split(".")[0] if d else ""
+    origin = module.imports.get(head, head)
+    return origin in ("numpy", "jax.numpy") or d.endswith(".dtype") \
+        or d in ("float", "int", "bool", "complex")

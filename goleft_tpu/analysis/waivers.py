@@ -1,0 +1,74 @@
+"""Per-line waivers: a reviewed decision to keep a flagged line.
+
+Grammar (anywhere in a line's trailing comment):
+
+    # gtlint: ok <rule-id>[, <rule-id>...] — reason
+    # gtlint: ok — reason            (waives every rule on the line)
+
+The reason (after an em-dash, ``--`` or a second ``#``) is for the
+reviewer; the analyzer only parses the ids. Two historical markers are
+honored as aliases so existing annotations keep meaning what they
+always meant:
+
+  - ``# plan-lint: ok``  → waives ``plan-boundary`` (the grep-era
+    dispatch-gate waiver, kept verbatim)
+  - ``# noqa: BLE001``   → waives ``exc-swallow`` (the repo's
+    long-standing broad-except annotation; every deliberate
+    ``except Exception`` already carries one with its justification)
+"""
+
+from __future__ import annotations
+
+import re
+
+_WAIVER = re.compile(r"#\s*gtlint:\s*ok\b([^#]*)")
+_PLAN_OK = re.compile(r"#\s*plan-lint:\s*ok\b")
+_NOQA_BLE = re.compile(r"#\s*noqa:[^#]*\bBLE001\b")
+_ID = re.compile(r"[a-z][a-z0-9\-]*")
+
+
+def parse_line(line: str) -> set[str]:
+    """Rule ids waived on this source line ({"*"} = all rules)."""
+    out: set[str] = set()
+    m = _WAIVER.search(line)
+    if m:
+        # ids run until the reason delimiter (em-dash / -- / end)
+        spec = re.split(r"—|\s--(\s|$)", m.group(1), maxsplit=1)[0]
+        ids = _ID.findall(spec)
+        out |= set(ids) if ids else {"*"}
+    if _PLAN_OK.search(line):
+        out.add("plan-boundary")
+    if _NOQA_BLE.search(line):
+        out.add("exc-swallow")
+    return out
+
+
+def parse_source(lines: list[str]) -> dict[int, set[str]]:
+    """{1-based line number: waived ids} for every line carrying one.
+
+    A waiver on a comment-only line also covers the next code line
+    (the standard shape when the offending line is too long to carry
+    an inline comment) — intervening comment/blank lines are skipped.
+    """
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        if "#" not in line:
+            continue
+        ids = parse_line(line)
+        if not ids:
+            continue
+        out.setdefault(i, set()).update(ids)
+        if line.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and (
+                    not lines[j - 1].strip()
+                    or lines[j - 1].lstrip().startswith("#")):
+                j += 1
+            if j <= len(lines):
+                out.setdefault(j, set()).update(ids)
+    return out
+
+
+def waives(waivers: dict[int, set[str]], line: int, rule: str) -> bool:
+    ids = waivers.get(line)
+    return bool(ids) and ("*" in ids or rule in ids)

@@ -79,6 +79,8 @@ PROGS = {
                   _lazy(".commands.anonymize"), False),
     "perf": ("perf ledger: ingest bench history, trend report, "
              "regression gate", _lazy(".commands.perf"), False),
+    "lint": ("AST invariant analyzer: determinism, tracer hygiene, "
+             "lock discipline", _lazy(".analysis.cli"), False),
     "cohortdepth": ("depth matrix for many bams in one device pass",
                     _lazy(".commands.cohortdepth"), True),
     "cnv": ("CNV calls straight from bams (cohort depth + EM)",

@@ -146,7 +146,7 @@ def main(argv=None) -> int:
     t.start()
     stop.wait()
     print("goleft-tpu serve: draining", file=sys.stderr, flush=True)
-    app.draining = True
+    app.begin_drain()
     httpd.shutdown()      # stop accepting; serve_forever returns
     t.join()
     httpd.server_close()  # joins in-flight handler threads

@@ -212,7 +212,8 @@ def normalize_across_samples(
         return new_carry, out
 
     init = jnp.zeros((n_samples, 3), raw.dtype)
-    _, cols = jax.lax.scan(step, init, jnp.arange(n_bins))
+    _, cols = jax.lax.scan(step, init,
+                           jnp.arange(n_bins, dtype=jnp.int32))
     return cols.T  # (n_samples, n_bins)
 
 

@@ -145,6 +145,9 @@ class ResultCache:
     def _evict(self) -> None:
         entries = []
         try:
+            # gtlint: ok det-unsorted-iter — eviction order comes from
+            # sorted(entries) by (mtime, size, name) below, not from
+            # the scan order
             names = os.listdir(self.dir)
         except OSError:
             return
@@ -174,6 +177,8 @@ class ResultCache:
         the directory; cheap at cache-bound entry counts)."""
         n = b = 0
         try:
+            # gtlint: ok det-unsorted-iter — pure accumulation (count
+            # + byte total); no order reaches output or keys
             for name in os.listdir(self.dir):
                 if not name.endswith(".pkl"):
                     continue

@@ -1,71 +1,39 @@
-"""plan-lint: the dispatch-path-split regression gate.
+"""plan-lint: the dispatch-path-split gate (now a shim).
 
-The tentpole refactor's value is that there is ONE place retry/
-checkpoint/quarantine compose (plan/executor.py). This check fails CI
-(``make plan-lint``) when any module outside ``goleft_tpu/plan/``
-grows a direct call to the retry machinery again:
+The original grep implementation lived here through PR 7; the check is
+now the ``plan-boundary`` rule of the AST analyzer
+(:mod:`goleft_tpu.analysis.rules.plan_boundary`), which resolves call
+names through each module's import table — ``from goleft_tpu.plan
+.executor import execute_task as et`` can no longer dodge the gate,
+and a method merely *named* ``call`` no longer false-positives.
 
-  - ``execute_task(...)`` — the scheduler facade must be reached
-    through the plan package
-  - ``<policy>.call(...)`` — a raw RetryPolicy attempt loop
-  - ``RetriesExhausted`` handling paired with a hand-rolled retry
-    ``while True:`` loop is caught by the two patterns above (the loop
-    needs one of them to retry)
+This module keeps the two public contracts:
 
-Definitions inside ``goleft_tpu/plan/`` and the test tree are exempt;
-``# plan-lint: ok`` on the offending line grants an explicit waiver
-(none exist today — a waiver should be a reviewed decision).
+  - ``python -m goleft_tpu.plan.lint [root]`` — same exit codes and
+    one-violation-per-line stderr report (``make plan-lint`` is now
+    ``goleft-tpu lint --only plan-boundary``, the same rule)
+  - ``check_tree(root) -> [str]`` — the API tests/test_plan.py pins
 
-Run: ``python -m goleft_tpu.plan.lint [root]`` — exits 1 with one
-line per violation.
+``# plan-lint: ok`` on a line still waives it (waivers.py maps the
+historical marker onto the ``plan-boundary`` rule id).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-
-#: pattern → why it is banned outside goleft_tpu/plan/
-BANNED = [
-    (re.compile(r"\bexecute_task\s*\("),
-     "call execute_task via goleft_tpu.plan (Executor/Step)"),
-    (re.compile(r"\bpolicy\s*\.\s*call\s*\("),
-     "raw RetryPolicy.call loop — lower the work into a plan Step"),
-    (re.compile(r"\bRetryPolicy\s*\([^)]*\)\s*\.\s*call\s*\("),
-     "raw RetryPolicy.call loop — lower the work into a plan Step"),
-]
-
-WAIVER = "# plan-lint: ok"
 
 
 def check_tree(root: str) -> list[str]:
     """Return one 'path:line: message' string per violation under
     ``root`` (the goleft_tpu package directory)."""
-    violations: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("__pycache__", "plan")]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, encoding="utf-8") as fh:
-                for lineno, line in enumerate(fh, 1):
-                    if WAIVER in line:
-                        continue
-                    stripped = line.lstrip()
-                    if stripped.startswith("#"):
-                        continue
-                    for patt, why in BANNED:
-                        if patt.search(line):
-                            rel = os.path.relpath(path,
-                                                  os.path.dirname(root))
-                            violations.append(
-                                f"{rel}:{lineno}: {why}\n"
-                                f"    {line.rstrip()}")
-                            break
-    return violations
+    from ..analysis.engine import run_analysis
+
+    result = run_analysis(root, only=["plan-boundary"])
+    out = []
+    for f in result.findings:
+        out.append(f"{f.path}:{f.line}: {f.message}\n    {f.snippet}")
+    return out
 
 
 def main(argv=None) -> int:

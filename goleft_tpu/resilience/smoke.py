@@ -280,7 +280,8 @@ def _serve_checkpoint_leg(d, bams, fai, bed, env, verbose):
     finally:
         _stop_daemon(child)
     journal = os.path.join(ckroot, "cohortdepth", "journal.jsonl")
-    committed = sum(1 for _ in open(journal))
+    with open(journal) as fh:
+        committed = sum(1 for _ in fh)
     if committed <= 0:
         raise RuntimeError("no shards committed before the kill")
 
@@ -338,7 +339,8 @@ def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
                 "injected kill did not kill: rc="
                 f"{kill.returncode}\n{kill.stderr.decode()}")
         journal = os.path.join(ck, "journal.jsonl")
-        committed = sum(1 for _ in open(journal))
+        with open(journal) as fh:
+            committed = sum(1 for _ in fh)
         if not 0 < committed < 6 * len(bams):
             raise RuntimeError(
                 f"expected a committed prefix, journal has "
@@ -360,7 +362,8 @@ def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
         if res.stdout != cold.stdout:
             raise RuntimeError(
                 "resumed output is NOT byte-identical to the cold run")
-        man = json.load(open(manifest_p))
+        with open(manifest_p) as fh:
+            man = json.load(fh)
         counters = man["metrics"]["counters"]
         resumed = counters.get("checkpoint.shards_resumed_total", 0)
         if resumed != committed:
@@ -392,7 +395,8 @@ def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
                 "partial cohort is not byte-identical to a cold run "
                 "over the healthy samples")
         qman_p = os.path.join(ck2, "quarantine.json")
-        qman = json.load(open(qman_p))
+        with open(qman_p) as fh:
+            qman = json.load(fh)
         q_sources = [e["source"] for e in qman["quarantined"]]
         if q_sources != [bams[1]]:
             raise RuntimeError(

@@ -250,16 +250,24 @@ class MicroBatcher:
         outcomes = self._run_tree(key, items, abandoned)
         n_ok = sum(1 for _, _, is_err in outcomes if not is_err)
         for it, val, is_err in outcomes:
-            if abandoned is not None and abandoned.is_set():
-                return  # the watchdog owns these items now
-            if not is_err:
-                it.finish(result=val)
-            elif n_ok > 0 and self._classify(val) == "permanent":
-                if self.metrics is not None:
-                    self.metrics.inc("poison_total")
-                it.finish(error=PoisonRequest(val))
-            else:
-                it.finish(error=val)
+            # finishing must be atomic with the watchdog's
+            # abandon+requeue decision (which holds the cond): an item
+            # is either finished HERE or re-queued THERE, never both.
+            # An unlocked abandoned-check raced the watchdog — the
+            # worker could finish an item the watchdog had already
+            # re-queued, double-dispatching it (one wasted device
+            # pass, and the late pass overwrote the waiter's result).
+            with self._cond:
+                if abandoned is not None and abandoned.is_set():
+                    return  # the watchdog owns these items now
+                if not is_err:
+                    it.finish(result=val)
+                elif n_ok > 0 and self._classify(val) == "permanent":
+                    if self.metrics is not None:
+                        self.metrics.inc("poison_total")
+                    it.finish(error=PoisonRequest(val))
+                else:
+                    it.finish(error=val)
 
     def _loop(self) -> None:
         while True:
@@ -284,10 +292,13 @@ class MicroBatcher:
             worker.join(self.watchdog_s)
             if not worker.is_alive():
                 continue
-            abandoned.set()
             if self.metrics is not None:
                 self.metrics.inc("watchdog_requeues_total")
             with self._cond:
+                # the abandon flag flips under the SAME cond the
+                # worker finishes under: after this block no straggler
+                # can deliver into a re-queued item
+                abandoned.set()
                 for it in reversed(batch):
                     if it.done.is_set():
                         continue  # finished before the abandon flag

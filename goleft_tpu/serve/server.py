@@ -141,7 +141,12 @@ class ServeApp:
                                     bisect_isolation=bisect_isolation,
                                     watchdog_s=watchdog_s,
                                     max_requeues=watchdog_requeues)
-        self.draining = False
+        # lifecycle flags cross threads: the signal handler / CLI
+        # main thread flips draining while every HTTP handler thread
+        # reads it, and SIGTERM can race atexit (or a test fixture)
+        # into close() — both go through _state_lock
+        self._state_lock = threading.Lock()
+        self._draining = False
         self._closed = False
 
     def _run_batch(self, key, payloads):
@@ -299,15 +304,32 @@ class ServeApp:
             i32(4), i32(0), length=256, window=256))
         return time.perf_counter() - t0
 
+    # ---- lifecycle (cross-thread: lock-guarded) ----
+
+    @property
+    def draining(self) -> bool:
+        with self._state_lock:
+            return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admitting new requests (healthz goes 503, POSTs shed);
+        in-flight work keeps running until close()."""
+        with self._state_lock:
+            self._draining = True
+
     def close(self, drain: bool = True) -> None:
-        """Idempotent: SIGTERM racing atexit (or a test fixture racing
-        ServerThread.__exit__) may close twice — the second call is a
-        no-op, and the span-listener detach itself tolerates an
-        already-detached listener."""
-        self.draining = True
-        if self._closed:
-            return
-        self._closed = True
+        """Idempotent UNDER CONCURRENCY: SIGTERM racing atexit (or a
+        test fixture racing ServerThread.__exit__) may close twice —
+        the _state_lock check-then-act guarantees exactly one caller
+        runs the close body (an unguarded `if self._closed` let both
+        through). The batcher close/join happens outside the lock: it
+        blocks on the dispatcher thread, which must stay free to
+        finish items."""
+        with self._state_lock:
+            self._draining = True
+            if self._closed:
+                return
+            self._closed = True
         self.batcher.close(drain=drain)
         self._tracer.remove_listener(self.flight.on_span)
 

@@ -1,0 +1,432 @@
+"""The AST invariant analyzer (goleft_tpu/analysis/, PR 8).
+
+Per-rule fixture snippets (each rule catches its seeded violation and
+stays quiet on the clean twin), waiver suppression (inline, comment
+line above, and the two historical markers), baseline round-trip,
+stable JSON schema, and the end-to-end gate: ``goleft-tpu lint`` exits
+0 on the committed tree and 1 once a violation fixture is injected.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import goleft_tpu
+from goleft_tpu.analysis import run_analysis
+from goleft_tpu.analysis import baseline as baseline_mod
+from goleft_tpu.analysis.cli import main as lint_main
+from goleft_tpu.analysis.findings import Finding, to_json
+
+
+_N = iter(range(10_000))
+
+
+def _pkg(tmp_path, files: dict) -> str:
+    """Materialize {relpath: source} under a FRESH tmp package root
+    (two fixtures in one test must not see each other's files)."""
+    root = tmp_path / f"fix{next(_N)}" / "goleft_tpu"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(root)
+
+
+def _rules(tmp_path, files, only=None):
+    res = run_analysis(_pkg(tmp_path, files), only=only)
+    return [f.rule for f in res.findings], res
+
+
+# ---------------- determinism ----------------
+
+
+def test_det_unsorted_listdir_and_set_iter(tmp_path):
+    rules, _ = _rules(tmp_path, {"out.py": (
+        "import os\n"
+        "def emit(d, items):\n"
+        "    for name in os.listdir(d):\n"
+        "        print(name)\n"
+        "    seen = set(items)\n"
+        "    for x in seen:\n"
+        "        print(x)\n"
+        "    for y in {1, 2}:\n"
+        "        print(y)\n"
+    )})
+    assert rules == ["det-unsorted-iter"] * 3
+
+
+def test_det_sorted_and_reductions_are_clean(tmp_path):
+    rules, _ = _rules(tmp_path, {"out.py": (
+        "import os\n"
+        "def emit(d, items):\n"
+        "    for name in sorted(os.listdir(d)):\n"
+        "        print(name)\n"
+        "    n = len(os.listdir(d))\n"
+        "    rounds = sorted(os.path.join(d, f)\n"
+        "                    for f in os.listdir(d) if f)\n"
+        "    for x in sorted(set(items)):\n"
+        "        print(x)\n"
+        "    return n, rounds\n"
+    )})
+    assert rules == []
+
+
+def test_det_entropy_in_key_construction(tmp_path):
+    rules, _ = _rules(tmp_path, {"k.py": (
+        "import time, random\n"
+        "def cache_key(path):\n"
+        "    return (path, time.time(), random.random())\n"
+        "def not_about_that(path):\n"
+        "    return time.time()\n"
+    )})
+    assert rules == ["det-key-entropy"] * 2  # only inside cache_key
+
+
+# ---------------- tracer hygiene (ops/ + parallel/) ----------------
+
+
+def test_trc_host_calls_inside_jit(tmp_path):
+    rules, _ = _rules(tmp_path, {"ops/k.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def bad(x):\n"
+        "    v = np.asarray(x)\n"
+        "    s = x.sum().item()\n"
+        "    if x > 0:\n"
+        "        return v + s\n"
+        "    return v\n"
+        "def host_is_fine(x):\n"
+        "    return np.asarray(x).item()\n"
+    )})
+    assert rules == ["trc-host-call"] * 3  # np call, .item(), if-on-tracer
+
+
+def test_trc_static_argnames_exempt_from_if_check(tmp_path):
+    rules, _ = _rules(tmp_path, {"ops/k.py": (
+        "import functools\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@functools.partial(jax.jit, static_argnames=('window',))\n"
+        "def ok(x, window):\n"
+        "    if window > 1:\n"
+        "        return x * window\n"
+        "    return x\n"
+    )})
+    assert rules == []
+
+
+def test_trc_ambient_dtype_in_kernel_code(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "def alloc(n, dtype):\n"
+        "    a = jnp.zeros(n)\n"
+        "    b = jnp.zeros(n, dtype)\n"
+        "    c = jnp.arange(n, dtype=jnp.int32)\n"
+        "    d = jnp.full((n,), jnp.int32(4))\n"
+        "    return a, b, c, d\n"
+    )
+    rules, _ = _rules(tmp_path, {"ops/k.py": src})
+    assert rules == ["trc-ambient-dtype"]  # only the bare jnp.zeros(n)
+    # same file outside ops/: kernel-only rule stays quiet
+    rules2, _ = _rules(tmp_path, {"io/k.py": src})
+    assert rules2 == []
+
+
+# ---------------- lock discipline ----------------
+
+_RACY = """
+import threading
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0          # __init__ writes exempt
+        self.ring = []
+
+    def guarded(self):
+        with self._lock:
+            self.n += 1
+            self.ring.append(1)
+
+    def _bump(self):
+        self.n += 1         # every call site holds the lock
+
+    def also_guarded(self):
+        with self._lock:
+            self._bump()
+
+    def unguarded(self):
+        self.n = 5          # flagged: plain write
+        self.ring.append(2) # flagged: in-place mutation
+"""
+
+
+def test_lck_unguarded_writes_flagged(tmp_path):
+    rules, res = _rules(tmp_path, {"serve/r.py": _RACY})
+    assert rules == ["lck-unguarded-write"] * 2
+    lines = {f.line for f in res.findings}
+    src_lines = _RACY.splitlines()
+    assert all("flagged" in src_lines[ln - 1] for ln in lines)
+
+
+def test_lck_call_graph_spares_caller_holds_lock_helpers(tmp_path):
+    clean = _RACY.replace(
+        "    def unguarded(self):\n"
+        "        self.n = 5          # flagged: plain write\n"
+        "        self.ring.append(2) # flagged: in-place mutation\n",
+        "")
+    rules, _ = _rules(tmp_path, {"serve/r.py": clean})
+    assert rules == []  # _bump is lock-held via its call sites
+
+
+def test_lck_lockless_class_is_out_of_scope(tmp_path):
+    rules, _ = _rules(tmp_path, {"serve/r.py": (
+        "class Plain:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n"
+    )})
+    assert rules == []
+
+
+# ---------------- exception classification ----------------
+
+
+def test_exc_swallow_flagged_only_in_fault_layers(tmp_path):
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    rules, _ = _rules(tmp_path, {"resilience/x.py": bad})
+    assert rules == ["exc-swallow"]
+    rules2, _ = _rules(tmp_path, {"io/x.py": bad})
+    assert rules2 == []  # io parsers are out of this rule's scope
+
+
+def test_exc_reraise_and_routing_are_clean(tmp_path):
+    rules, _ = _rules(tmp_path, {"serve/x.py": (
+        "def f(log, policy):\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        log.exception('boom: %r', e)\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        policy.classify(e)\n"
+    )})
+    assert rules == []
+
+
+def test_exc_inline_open_without_cm(tmp_path):
+    rules, _ = _rules(tmp_path, {"io/x.py": (
+        "import json\n"
+        "def f(p):\n"
+        "    n = sum(1 for _ in open(p))\n"
+        "    doc = json.load(open(p))\n"
+        "    with open(p) as fh:\n"
+        "        ok = fh.read()\n"
+        "    owned = open(p, 'rb')\n"
+        "    return n, doc, ok, owned\n"
+    )})
+    assert rules == ["exc-open-nocm"] * 2
+
+
+# ---------------- plan boundary ----------------
+
+
+def test_plan_boundary_resolves_aliases(tmp_path):
+    rules, res = _rules(tmp_path, {
+        "sub/bad.py": (
+            "from goleft_tpu.plan.executor import execute_task as et\n"
+            "from goleft_tpu.resilience.policy import RetryPolicy\n"
+            "def f(key, thunk):\n"
+            "    r = et(key, thunk)\n"
+            "    v, _ = RetryPolicy(retries=3).call(key, thunk)\n"
+            "    p = RetryPolicy()\n"
+            "    w, _ = p.call(key, thunk)\n"
+            "    return r, v, w\n"
+        ),
+        "plan/ok.py": (
+            "def g(key, thunk, policy):\n"
+            "    return execute_task(key, thunk), policy.call(key, thunk)\n"
+        ),
+    })
+    assert rules == ["plan-boundary"] * 3
+    assert all("bad.py" in f.path for f in res.findings)
+
+
+def test_plan_boundary_unrelated_call_method_is_clean(tmp_path):
+    rules, _ = _rules(tmp_path, {"sub/ok.py": (
+        "def f(client, key):\n"
+        "    return client.call(key)\n"  # grep-era false positive shape
+    )})
+    assert rules == []
+
+
+# ---------------- waivers ----------------
+
+
+def test_waivers_inline_and_comment_line_above(tmp_path):
+    rules, res = _rules(tmp_path, {"out.py": (
+        "import os\n"
+        "def f(d):\n"
+        "    for n in os.listdir(d):  # gtlint: ok det-unsorted-iter — counted\n"
+        "        pass\n"
+        "    # gtlint: ok det-unsorted-iter — also counted\n"
+        "    for n in os.listdir(d):\n"
+        "        pass\n"
+        "    for n in os.listdir(d):  # gtlint: ok lck-unguarded-write\n"
+        "        pass\n"
+    )})
+    assert rules == ["det-unsorted-iter"]  # wrong-id waiver doesn't stick
+    assert res.waived == 2
+
+
+def test_historical_markers_map_to_rule_ids(tmp_path):
+    rules, res = _rules(tmp_path, {
+        "sub/a.py": (
+            "def f(key, thunk):\n"
+            "    return execute_task(key, thunk)  # plan-lint: ok\n"
+        ),
+        "serve/b.py": (
+            "def g():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:  # noqa: BLE001 — reviewed\n"
+            "        pass\n"
+        ),
+    })
+    assert rules == [] and res.waived == 2
+
+
+# ---------------- baseline ----------------
+
+
+def test_baseline_round_trip_suppresses_then_resurfaces(tmp_path):
+    root = _pkg(tmp_path, {"out.py": (
+        "import os\n"
+        "def f(d):\n"
+        "    for n in os.listdir(d):\n"
+        "        pass\n"
+    )})
+    res = run_analysis(root)
+    assert len(res.findings) == 1
+    bl = str(tmp_path / "bl.json")
+    baseline_mod.save(bl, res.findings, reason="risky to fix")
+    entries = baseline_mod.load(bl)
+    assert entries[0]["reason"] == "risky to fix"
+    live, suppressed = baseline_mod.split(res.findings, entries)
+    assert live == [] and len(suppressed) == 1
+    # the entry is snippet-keyed: editing the offending line resurfaces it
+    edited = Finding(res.findings[0].path, 3, "det-unsorted-iter",
+                     "m", snippet="for n in os.listdir(d, x):")
+    live2, _ = baseline_mod.split([edited], entries)
+    assert len(live2) == 1
+
+
+def test_baseline_rejects_foreign_json(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text('{"not": "a baseline"}')
+    try:
+        baseline_mod.load(str(p))
+    except ValueError as e:
+        assert "baseline" in str(e)
+    else:
+        raise AssertionError("foreign JSON accepted as baseline")
+
+
+# ---------------- output schemas ----------------
+
+
+def test_json_schema_is_stable():
+    f = Finding("p/a.py", 3, "det-unsorted-iter", "msg",
+                snippet="for x in s:")
+    doc = json.loads(to_json([f], baselined=1, waived=2,
+                             rules=["det-unsorted-iter"]))
+    assert set(doc) == {"version", "findings", "counts", "baselined",
+                       "waived", "rules"}
+    assert doc["version"] == 1
+    assert doc["findings"][0] == {
+        "path": "p/a.py", "line": 3, "rule": "det-unsorted-iter",
+        "message": "msg", "severity": "error",
+        "snippet": "for x in s:"}
+    assert doc["counts"] == {"det-unsorted-iter": 1}
+    assert doc["baselined"] == 1 and doc["waived"] == 2
+
+
+def test_cli_json_and_only_filter(tmp_path, capsys):
+    root = _pkg(tmp_path, {"serve/r.py": _RACY})
+    rc = lint_main([root, "--json", "--no-baseline"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["counts"] == {"lck-unguarded-write": 2}
+    rc0 = lint_main([root, "--only", "plan-boundary", "--no-baseline"])
+    assert rc0 == 0
+    rc2 = lint_main([root, "--only", "nonsense"])
+    assert rc2 == 2  # unknown rule id is a usage error, not a pass
+
+
+def test_findings_sorted_deterministically(tmp_path):
+    root = _pkg(tmp_path, {
+        "b.py": "import os\nx = [n for n in os.listdir('.')]\n",
+        "a.py": "import os\ny = [n for n in os.listdir('.')]\n",
+    })
+    res = run_analysis(root)
+    assert [f.path for f in res.findings] == sorted(
+        f.path for f in res.findings)
+
+
+# ---------------- the e2e gate ----------------
+
+
+def _run_lint(*args, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "goleft_tpu", "lint", *args],
+        capture_output=True, text=True, timeout=300, cwd=cwd,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_e2e_committed_tree_is_clean():
+    """Acceptance: `goleft-tpu lint` exits 0 over the shipped package
+    with the committed baseline."""
+    r = _run_lint()
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stdout
+
+
+def test_e2e_injected_violation_flips_the_gate(tmp_path):
+    """Acceptance: any one of the rule families' fixture violations
+    flips `goleft-tpu lint` to exit 1."""
+    pkg_dir = os.path.dirname(os.path.abspath(goleft_tpu.__file__))
+    probe = os.path.join(pkg_dir, "serve", "_gtlint_probe_e2e.py")
+    try:
+        with open(probe, "w") as fh:
+            fh.write("import os\n"
+                     "def f(d):\n"
+                     "    for n in os.listdir(d):\n"
+                     "        pass\n")
+        r = _run_lint()
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "det-unsorted-iter" in r.stderr
+    finally:
+        os.remove(probe)
+
+
+def test_plan_lint_shim_still_works():
+    r = subprocess.run(
+        [sys.executable, "-m", "goleft_tpu.plan.lint"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "plan-lint: ok" in r.stdout

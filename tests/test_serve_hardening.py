@@ -406,3 +406,125 @@ def test_serve_cohortdepth_checkpoint_resumes_across_apps(
             == resumed_before + committed
     finally:
         app2.close()
+
+
+# ---------------- lock-discipline regressions (gtlint audit) ----------------
+# Two races surfaced auditing the threaded modules with the
+# lck-unguarded-write rule (PR 8): the dispatcher's finish path ran
+# outside the cond the watchdog requeues under, and ServeApp's
+# close/draining flags were bare check-then-act across threads.
+
+
+def test_dispatch_finish_holds_the_cond():
+    """Regression (batcher): finishing an item must happen under
+    ``_cond`` — the same lock the watchdog's abandon+requeue holds —
+    so an item can never be finished AND re-queued. Holding the cond
+    from the test must visibly block delivery."""
+    entered, release = threading.Event(), threading.Event()
+
+    def run_batch(key, payloads):
+        entered.set()
+        release.wait(timeout=30)
+        return ["ok"] * len(payloads)
+
+    mb = MicroBatcher(run_batch, window_s=0.0, max_batch=1)
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.setdefault("r", mb.submit("k", "p")))
+    t.start()
+    try:
+        assert entered.wait(5)
+        assert mb._cond.acquire(timeout=5)
+        try:
+            release.set()
+            time.sleep(0.25)
+            # pre-fix: the finish ran lock-free and this was already
+            # delivered while we held the cond
+            assert "r" not in got
+        finally:
+            mb._cond.release()
+        t.join(timeout=10)
+        assert got.get("r") == "ok"
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_abandoned_pass_never_double_delivers():
+    """Regression (batcher): a watchdog-abandoned pass that completes
+    AFTER its items were re-queued must not overwrite the re-queued
+    run's result or put the item back in play — exactly two
+    executions, the second one's result delivered, queue empty."""
+    g1 = threading.Event()
+    calls = []
+    lock = threading.Lock()
+
+    def run_batch(key, payloads):
+        with lock:
+            i = len(calls)
+            calls.append(list(payloads))
+        if i == 0:
+            g1.wait(timeout=30)  # first pass hangs past the watchdog
+            return ["first"] * len(payloads)
+        return ["second"] * len(payloads)
+
+    mb = MicroBatcher(run_batch, window_s=0.0, max_batch=1,
+                      watchdog_s=0.25, max_requeues=1)
+    try:
+        assert mb.submit("k", "p0") == "second"
+        g1.set()  # release the abandoned straggler
+        deadline = time.monotonic() + 0.8
+        while time.monotonic() < deadline:
+            assert len(calls) == 2  # no third dispatch, ever
+            assert mb.queue_depth() == 0
+            time.sleep(0.05)
+    finally:
+        g1.set()
+        mb.close()
+
+
+def test_concurrent_close_runs_close_body_once():
+    """Regression (ServeApp): SIGTERM racing atexit racing a test
+    fixture — N concurrent close() calls must run the close body
+    (batcher drain/join, listener detach) exactly once; the bare
+    ``if self._closed`` check-then-act let several through."""
+    app = ServeApp(batch_window_s=0.0, watchdog_s=None)
+    closes = {"n": 0}
+    real_close = app.batcher.close
+
+    def counting_close(drain=True):
+        closes["n"] += 1
+        time.sleep(0.05)  # widen the pre-fix window
+        real_close(drain=drain)
+
+    app.batcher.close = counting_close
+    barrier = threading.Barrier(8)
+
+    def closer():
+        barrier.wait(timeout=10)
+        app.close()
+
+    ts = [threading.Thread(target=closer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert closes["n"] == 1
+    assert app.draining
+
+
+def test_begin_drain_is_the_cross_thread_drain_signal():
+    app = ServeApp(batch_window_s=0.0, watchdog_s=None)
+    try:
+        assert not app.draining
+        seen = {}
+        t = threading.Thread(
+            target=lambda: seen.setdefault("v", app.draining))
+        app.begin_drain()
+        t.start()
+        t.join(timeout=10)
+        assert seen["v"] is True and app.draining
+        code, body = app.healthz()
+        assert code == 503 and body["status"] == "draining"
+    finally:
+        app.close()
