@@ -6,8 +6,8 @@ CXX ?= g++
 SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
-        serve-smoke obs-smoke chaos-smoke pairhmm-smoke perf-gate \
-        lint lint-changed plan-lint check clean
+        serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
+        perf-gate lint lint-changed plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -93,9 +93,22 @@ lint-changed:
 plan-lint:
 	python -m goleft_tpu lint --only plan-boundary
 
+# fleet end-to-end, all real subprocess daemons: (a) continuous
+# batcher byte-identical to the window batcher and to the one-shot
+# CLIs for depth/indexcov/cohortdepth/pairhmm; (b) two concurrent
+# identical requests -> ONE device pass (cross-request step dedup,
+# plan_steps_deduped_total) and two byte-identical 200s; (c) a worker
+# SIGKILLed mid-flight -> router-level retry on the sibling ->
+# byte-identical 200; (d) a tripped per-site breaker sheds only its
+# own endpoint's traffic; (e) per-tenant quota exhaustion -> 429 with
+# retry_after_s while other tenants are unaffected (and the
+# retry-aware client honors the hint). Host-pinned like the others.
+fleet-smoke:
+	python -m goleft_tpu.fleet.smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
-# the test suite
-check: lint plan-lint test
+# the test suite, then the fleet end-to-end proof
+check: lint plan-lint test fleet-smoke
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -1458,6 +1458,10 @@ def host_suite(quick: bool, emit=None) -> dict:
     except Exception as e:  # noqa: BLE001
         _put("serve_throughput", {"error": repr(e)})
     try:
+        _put("fleet_throughput", _fleet_throughput_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("fleet_throughput", {"error": repr(e)})
+    try:
         _put("cohort_resume_overhead", _resume_overhead_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("cohort_resume_overhead", {"error": repr(e)})
@@ -1616,6 +1620,120 @@ def _serve_throughput_entry(quick: bool) -> dict:
             # daemon's /metrics serves
             "latency_s": percentiles(lat[phase]),
         }
+    return out
+
+
+def _fleet_throughput_entry(quick: bool) -> dict:
+    """The fleet router + 2 workers vs one single daemon on the same
+    concurrent depth load (all in-process: real HTTP loopback, real
+    routing, shared jit cache). Records req/s and p50/p99 latency per
+    topology plus the router's affinity evidence. NOTE the honest
+    caveat baked into the note: in-process "workers" share one GIL
+    and one device, so this measures ROUTER OVERHEAD and affinity
+    behavior, not horizontal compute scaling — the number to watch is
+    how little the fleet column trails the single column."""
+    import shutil
+    import threading
+
+    import jax as _jax
+
+    from goleft_tpu.fleet.router import RouterApp, RouterThread
+    from goleft_tpu.serve.client import ServeClient
+    from goleft_tpu.serve.server import ServeApp, ServerThread
+    from goleft_tpu.utils.profiling import percentiles
+
+    n_clients = 4 if quick else 8
+    n_requests = 16 if quick else 48
+    ref_len = 200_000 if quick else 1_000_000
+    d, bams, fai, _ = _build_cohort_fixture(
+        min(n_requests, 8), ref_len, 4)
+
+    def burst(url, times):
+        lock = threading.Lock()
+        todo = list(range(n_requests))
+
+        def worker():
+            client = ServeClient(url, timeout_s=300.0)
+            while True:
+                with lock:
+                    if not todo:
+                        return
+                    i = todo.pop()
+                t0 = time.perf_counter()
+                r = client.depth(bams[i % len(bams)], fai=fai,
+                                 cache_buster=i)
+                assert r["depth_bed"]
+                with lock:
+                    times.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    out = {
+        "platform": _jax.default_backend(),
+        "clients": n_clients, "requests_per_phase": n_requests,
+        "workers": 2, "ref_bp": ref_len,
+        "note": "in-process router + 2 workers vs single daemon, "
+                "real HTTP loopback; same-process workers share one "
+                "GIL/device, so this is router overhead + affinity "
+                "evidence, not horizontal scaling",
+    }
+    try:
+        # single daemon (continuous batching, no cache: every request
+        # computes)
+        app = ServeApp(max_batch=n_clients, max_queue=4 * n_requests)
+        lat_single: list = []
+        with ServerThread(app) as url:
+            ServeClient(url, timeout_s=300.0).depth(bams[0], fai=fai)
+            wall = burst(url, lat_single)
+        app.close()
+        out["single"] = {
+            "req_per_sec": round(n_requests / wall, 2),
+            "latency_s": percentiles(lat_single),
+        }
+
+        # router + 2 workers (jit cache already warm — shared
+        # process — so both topologies run warm, apples to apples)
+        w_apps = [ServeApp(max_batch=n_clients,
+                           max_queue=4 * n_requests)
+                  for _ in range(2)]
+        w_threads = [ServerThread(wa) for wa in w_apps]
+        w_urls = [st.__enter__() for st in w_threads]
+        lat_fleet: list = []
+        try:
+            router = RouterApp(w_urls, poll_interval_s=1.0,
+                               max_inflight=2 * n_clients)
+            with RouterThread(router) as rurl:
+                ServeClient(rurl, timeout_s=300.0).depth(bams[0],
+                                                         fai=fai)
+                wall = burst(rurl, lat_fleet)
+                rm = router.metrics_snapshot()
+        finally:
+            for st, wa in zip(w_threads, w_apps):
+                st.__exit__(None, None, None)
+                wa.close()
+        routed = {k.rsplit(".", 2)[-2]: v
+                  for k, v in rm["counters"].items()
+                  if k.startswith("fleet.routed_total.")}
+        out["fleet"] = {
+            "req_per_sec": round(n_requests / wall, 2),
+            "latency_s": percentiles(lat_fleet),
+            "routed_per_worker": routed,
+            "affinity_hits": rm["counters"].get(
+                "fleet.affinity_hits_total.depth", 0),
+            "retries": rm["counters"].get("fleet.retries_total", 0),
+        }
+        out["router_overhead_frac"] = round(
+            1.0 - out["fleet"]["req_per_sec"]
+            / out["single"]["req_per_sec"], 4)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     return out
 
 

@@ -87,6 +87,11 @@ PROGS = {
             _lazy(".commands.cnv"), True),
     "serve": ("warm-mesh coverage daemon with request micro-batching",
               _lazy(".commands.serve"), True),
+    # the router never touches a device: it spawns/fronts serve
+    # workers (which bring up their OWN backends) and must not pay —
+    # or hang on — backend bring-up itself
+    "fleet": ("multi-worker serve fleet behind a file-affinity router",
+              _lazy(".commands.fleet"), False),
 }
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",

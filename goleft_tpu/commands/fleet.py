@@ -1,0 +1,163 @@
+"""fleet: N serve workers behind the file-affinity router.
+
+Two shapes:
+
+  - ``goleft-tpu fleet --workers N [...]``: spawn N ``goleft-tpu
+    serve`` subprocesses on ephemeral ports (scraping their listen
+    lines), then run the router in front of them. SIGTERM drains the
+    router first, then the workers.
+  - ``goleft-tpu fleet --worker URL --worker URL [...]``: front
+    already-running daemons (workers you manage yourself — other
+    hosts, containers, a mixed fleet).
+
+Lifecycle mirrors the serve daemon: one ``listening on http://...``
+line on stdout once the router socket is bound (plus one ``worker N
+at URL`` line per spawned worker), then block until SIGTERM/SIGINT.
+The router process never imports jax — it stays a cheap, boring
+forwarder no matter what the workers are chewing on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+
+
+def _spawn_worker(extra_args: list[str], env: dict):
+    """One serve child on an ephemeral port; returns (proc, url)."""
+    child = subprocess.Popen(
+        [sys.executable, "-m", "goleft_tpu", "serve", "--port", "0",
+         *extra_args],
+        stdout=subprocess.PIPE, text=True, env=env)
+    line = child.stdout.readline()
+    if "listening on " not in line:
+        child.kill()
+        raise RuntimeError(
+            f"worker did not announce its port: {line!r}")
+    return child, line.rsplit("listening on ", 1)[1].strip()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "goleft-tpu fleet",
+        description="multi-worker serve fleet behind a file-affinity "
+                    "router with admission control",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8090,
+                   help="router port; 0 = ephemeral (printed)")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--workers", type=int, default=0,
+                   help="spawn this many goleft-tpu serve workers on "
+                        "ephemeral ports")
+    g.add_argument("--worker", action="append", default=[],
+                   metavar="URL",
+                   help="front an already-running serve daemon "
+                        "(repeatable)")
+    p.add_argument("--worker-args", default="",
+                   help="extra flags passed through to each SPAWNED "
+                        "worker (one shell-quoted string, e.g. "
+                        "--worker-args '--cache /tmp/c -p 2')")
+    p.add_argument("--quota", action="append", default=[],
+                   metavar="TENANT=RATE[:BURST]",
+                   help="per-tenant token-bucket quota in requests/s "
+                        "(repeatable; '*' sets the default every "
+                        "unlisted tenant gets its own bucket from; "
+                        "unlisted tenants are unmetered without it)")
+    p.add_argument("--max-inflight", type=int, default=16,
+                   help="concurrent forwards; excess requests wait in "
+                        "the fair scheduler (priority + aging, "
+                        "deadline-aware)")
+    p.add_argument("--aging-rate", type=float, default=0.5,
+                   help="priority points a waiting request gains per "
+                        "queued second (starvation-freedom knob)")
+    p.add_argument("--timeout-s", type=float, default=120.0,
+                   help="default end-to-end request budget (requests "
+                        "can override with timeout_s)")
+    p.add_argument("--poll-interval-s", type=float, default=2.0,
+                   help="worker /healthz + /metrics poll cadence "
+                        "(health, breaker import, SLO shed signal)")
+    p.add_argument("--down-after", type=int, default=2,
+                   help="consecutive failed polls before a worker is "
+                        "taken out of rotation")
+    p.add_argument("--shed-below", type=float, default=0.0,
+                   help="shed best-effort traffic (priority > 0) with "
+                        "503 while polled fleet availability is below "
+                        "this (0 disables)")
+    p.add_argument("--redirect", action="store_true",
+                   help="answer 307 with the affinity worker's URL "
+                        "instead of proxying the body (clients must "
+                        "follow redirects; serve/client.py does)")
+    p.add_argument("--vnodes", type=int, default=64,
+                   help="virtual nodes per worker on the hash ring")
+    a = p.parse_args(argv)
+
+    if a.workers <= 0 and not a.worker:
+        p.error("need --workers N or at least one --worker URL")
+
+    from ..fleet.router import RouterApp, make_router_server
+
+    children: list = []
+    urls = [u for u in a.worker]
+    if a.workers > 0:
+        worker_extra = shlex.split(a.worker_args)
+        env = dict(os.environ)
+        for i in range(a.workers):
+            child, url = _spawn_worker(worker_extra, env)
+            children.append(child)
+            urls.append(url)
+            print(f"goleft-tpu fleet: worker {i} at {url}",
+                  file=sys.stderr, flush=True)
+
+    app = RouterApp(urls, quotas=a.quota,
+                    max_inflight=a.max_inflight,
+                    aging_rate=a.aging_rate,
+                    default_timeout_s=a.timeout_s,
+                    poll_interval_s=a.poll_interval_s,
+                    down_after=a.down_after,
+                    shed_below=a.shed_below,
+                    redirect=a.redirect,
+                    vnodes=a.vnodes)
+    app.start()
+    httpd = make_router_server(app, a.host, a.port)
+    host, port = httpd.server_address[:2]
+    print(f"goleft-tpu fleet: listening on http://{host}:{port}",
+          flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    t = threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.1},
+                         name="goleft-fleet-http")
+    t.start()
+    stop.wait()
+    print("goleft-tpu fleet: draining", file=sys.stderr, flush=True)
+    httpd.shutdown()
+    t.join()
+    httpd.server_close()
+    app.close()
+    rc = 0
+    for child in children:
+        if child.poll() is None:
+            child.send_signal(signal.SIGTERM)
+    for child in children:
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            rc = 1
+        if child.stdout is not None:
+            child.stdout.close()
+    print("goleft-tpu fleet: drained, bye", file=sys.stderr,
+          flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,9 +31,17 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080,
                    help="0 = ephemeral (actual port is printed)")
+    p.add_argument("--batch-mode", choices=("continuous", "window"),
+                   default="continuous",
+                   help="continuous (default): every dispatch admits "
+                        "whatever compatible work is queued, no fixed "
+                        "wait — the in-flight pass is the coalescing "
+                        "horizon; window: the fixed --batch-window-ms "
+                        "coalescing of PR 2 (the byte-identity "
+                        "reference)")
     p.add_argument("--batch-window-ms", type=float, default=10.0,
-                   help="how long a batch anchor waits for compatible "
-                        "requests to coalesce")
+                   help="window mode only: how long a batch anchor "
+                        "waits for compatible requests to coalesce")
     p.add_argument("--max-batch", type=int, default=16,
                    help="max requests per coalesced device pass")
     p.add_argument("--max-queue", type=int, default=64,
@@ -114,7 +122,8 @@ def main(argv=None) -> int:
                    watchdog_requeues=a.watchdog_requeues,
                    breaker_threshold=a.breaker_threshold,
                    breaker_cooldown_s=a.breaker_cooldown_s,
-                   checkpoint_root=a.checkpoint_root)
+                   checkpoint_root=a.checkpoint_root,
+                   batch_mode=a.batch_mode)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)

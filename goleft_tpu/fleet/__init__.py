@@ -1,0 +1,33 @@
+"""Multi-worker serve fleet: affinity router + admission control.
+
+The serve daemon (goleft_tpu/serve/) is one process — correct and
+hardened, but structurally capped at single-process throughput. The
+fleet layer scales it horizontally without touching the workers:
+
+  - :mod:`~goleft_tpu.fleet.router`: a thin stdlib HTTP router in
+    front of N ``goleft-tpu serve`` workers. Requests route by
+    file-identity affinity (consistent hash on the inputs' ``file_key``)
+    so each worker's ResultCache and warm jit programs keep seeing the
+    same files; workers are health-checked via ``/healthz`` and their
+    per-endpoint circuit-breaker state is imported from ``/metrics``,
+    so a worker with (say) ``pairhmm`` tripped sheds only pairhmm
+    traffic while its depth traffic keeps landing there.
+  - :mod:`~goleft_tpu.fleet.admission`: admission control in front of
+    the workers' 429 cliff — per-tenant token-bucket quotas (429 +
+    ``retry_after_s`` on exhaustion) and deadline-aware, starvation-free
+    priority/fairness scheduling of the forwarding slots.
+  - :mod:`~goleft_tpu.fleet.smoke`: the ``make fleet-smoke`` body —
+    real subprocess daemons proving byte identity (continuous vs
+    window batching vs the one-shot CLIs), cross-request step dedup,
+    router-level retry across a SIGKILLed worker, and per-tenant quota
+    isolation.
+
+``goleft-tpu fleet`` (commands/fleet.py) spawns the workers and runs
+the router; see docs/fleet.md.
+"""
+
+from .admission import (  # noqa: F401
+    FairScheduler, QuotaExceeded, QuotaTable, SchedulerTimeout,
+    TokenBucket,
+)
+from .router import HashRing, RouterApp, WorkerPool  # noqa: F401

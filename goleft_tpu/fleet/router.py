@@ -1,0 +1,600 @@
+"""File-affinity router: one thin process in front of N serve workers.
+
+Pure stdlib (urllib + http.server), deliberately ignorant of jax and
+the workloads — the router never decodes an input or touches a device,
+so it stays cheap enough to front many workers. What it DOES know:
+
+  - **affinity** (:class:`HashRing`): requests route by consistent
+    hash of their input files' ``file_key`` (path + size + mtime_ns),
+    so the same file keeps landing on the same worker — that worker's
+    ResultCache replays it and its jitted programs stay warm for the
+    geometries that file produces. Consistent hashing means adding or
+    losing a worker remaps only the keys that worker owned, not the
+    whole fleet's cache locality.
+  - **health**: a background poller hits each worker's ``/healthz``
+    (and ``/metrics``) every ``poll_interval_s``; a worker that fails
+    ``down_after`` consecutive polls (or reports draining) stops
+    receiving traffic until it recovers.
+  - **per-site breaker import**: the poller reads each worker's
+    ``breakers`` block from ``/metrics``. A worker whose ``pairhmm``
+    breaker is open is excluded from pairhmm candidates ONLY — its
+    depth/indexcov/cohortdepth traffic keeps landing there. The same
+    worker 503 (a breaker answer carrying ``retry_after_s``) is also
+    handled reactively: the request is re-routed to the next ring
+    candidate immediately, before the next poll could notice.
+  - **retry on worker death**: a connection-level failure (refused,
+    reset mid-flight — a SIGKILLed worker) marks the worker down and
+    retries the request on the next ring candidate
+    (``fleet.retries_total``). Safe because every workload here is a
+    deterministic read-only computation; the worker answers or it
+    doesn't.
+  - **admission** (:mod:`~goleft_tpu.fleet.admission`): per-tenant
+    token-bucket quotas (429 + ``retry_after_s``) and fair,
+    deadline-aware forwarding slots run BEFORE any bytes are
+    forwarded. An optional availability shed (``shed_below``) drops
+    best-effort traffic (priority > 0) with 503 while the fleet's
+    polled SLO availability is under the threshold.
+
+``redirect=True`` answers ``307 Temporary Redirect`` with the chosen
+worker's URL instead of proxying the body — for clients that can
+follow redirects (serve/client.py does), this takes the router out of
+the data path entirely.
+
+Routes: ``POST /v1/<kind>`` (proxied), ``GET /healthz`` (fleet
+summary), ``GET /metrics`` (router registry snapshot + per-worker
+state), ``POST /fleet/plan`` (debug: the candidate order a body would
+route to, no forwarding).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from bisect import bisect_right
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..obs.logging import get_logger
+from ..obs.metrics import MetricsRegistry
+from .admission import (
+    FairScheduler, QuotaExceeded, QuotaTable, SchedulerTimeout,
+)
+
+log = get_logger("fleet.router")
+
+def _file_key(path: str) -> tuple:
+    """(abspath, size, mtime_ns) — the SAME definition as
+    ``parallel.scheduler.file_key`` (pinned by tests/test_fleet.py),
+    duplicated here because importing it drags the whole
+    ``goleft_tpu.parallel`` package — and jax — into the router
+    process, whose entire point is staying a cheap jax-free
+    forwarder."""
+    import os
+
+    st = os.stat(path)
+    return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
+
+
+#: request field naming the files whose identity is the affinity key
+AFFINITY_FIELDS = {
+    "depth": ("bam",),
+    "indexcov": ("bams",),
+    "cohortdepth": ("bams",),
+    "pairhmm": ("input",),
+}
+
+
+class HashRing:
+    """Consistent hash ring with virtual nodes.
+
+    ``candidates(key)`` returns EVERY node, ordered by ring walk from
+    the key's position — element 0 is the affinity home, the rest are
+    the deterministic failover order. Adding/removing a node moves
+    only ~1/N of the keyspace (the property that keeps worker caches
+    warm across fleet resizes).
+    """
+
+    def __init__(self, nodes: list[str], vnodes: int = 64):
+        if not nodes:
+            raise ValueError("HashRing needs at least one node")
+        self.nodes = list(nodes)
+        self._points: list[tuple[int, str]] = sorted(
+            (self._hash(f"{node}#{i}"), node)
+            for node in nodes for i in range(vnodes))
+        self._keys = [p for p, _ in self._points]
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(s.encode()).digest()[:8], "big")
+
+    def candidates(self, key: str) -> list[str]:
+        start = bisect_right(self._keys, self._hash(key))
+        seen: list[str] = []
+        n = len(self._points)
+        for i in range(n):
+            node = self._points[(start + i) % n][1]
+            if node not in seen:
+                seen.append(node)
+                if len(seen) == len(self.nodes):
+                    break
+        return seen
+
+
+class _Worker:
+    """Mutable polled state for one worker (lock: the pool's)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.healthy = True      # optimistic until a poll says otherwise
+        self.draining = False
+        self.consecutive_fails = 0
+        self.open_breakers: frozenset[str] = frozenset()
+        self.availability: float | None = None
+        self.last_poll_s: float | None = None
+
+
+class WorkerPool:
+    """Polled worker state + the poller thread."""
+
+    def __init__(self, urls: list[str], poll_interval_s: float = 2.0,
+                 down_after: int = 2, timeout_s: float = 5.0,
+                 registry: MetricsRegistry | None = None):
+        self.workers = {u.rstrip("/"): _Worker(u) for u in urls}
+        self.poll_interval_s = poll_interval_s
+        self.down_after = down_after
+        self.timeout_s = timeout_s
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True,
+            name="goleft-fleet-poller")
+
+    def start(self) -> "WorkerPool":
+        self.poll_all()  # synchronous first poll: route on real state
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+
+    # ---- polling ----
+
+    def _fetch_json(self, url: str) -> dict:
+        req = urllib.request.Request(
+            url, headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req,
+                                    timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
+    def _poll_one(self, w: _Worker) -> None:
+        try:
+            h = self._fetch_json(w.url + "/healthz")
+            m = self._fetch_json(w.url + "/metrics")
+        except Exception as e:  # noqa: BLE001 — any poll failure = a miss
+            with self._lock:
+                w.consecutive_fails += 1
+                w.last_poll_s = time.monotonic()
+                if w.consecutive_fails >= self.down_after \
+                        and w.healthy:
+                    w.healthy = False
+                    log.warning("fleet: worker %s marked DOWN (%r)",
+                                w.url, e)
+                    self.registry.counter(
+                        "fleet.worker_down_total").inc()
+            return
+        from ..resilience.breaker import is_shedding
+
+        breakers = frozenset(
+            kind for kind, state in (m.get("breakers") or {}).items()
+            if is_shedding(state))
+        slo = m.get("slo") or {}
+        with self._lock:
+            if not w.healthy:
+                log.warning("fleet: worker %s recovered", w.url)
+            w.consecutive_fails = 0
+            w.healthy = h.get("status") == "ok"
+            w.draining = h.get("status") == "draining"
+            w.open_breakers = breakers
+            w.availability = slo.get("availability")
+            w.last_poll_s = time.monotonic()
+
+    def poll_all(self) -> None:
+        for w in list(self.workers.values()):
+            self._poll_one(w)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_all()
+
+    # ---- routing state ----
+
+    def mark_failed(self, url: str) -> None:
+        """A forward to this worker died at the connection level: take
+        it out of rotation NOW (the poller re-admits it when /healthz
+        answers again)."""
+        w = self.workers.get(url.rstrip("/"))
+        if w is None:
+            return
+        with self._lock:
+            if w.healthy:
+                log.warning("fleet: worker %s marked DOWN "
+                            "(connection failure mid-request)", w.url)
+                self.registry.counter("fleet.worker_down_total").inc()
+            w.healthy = False
+            w.consecutive_fails = max(w.consecutive_fails,
+                                      self.down_after)
+
+    def eligible(self, kind: str) -> set[str]:
+        """Workers that may serve ``kind`` right now: healthy, not
+        draining, and without an open breaker for that endpoint."""
+        with self._lock:
+            return {
+                u for u, w in self.workers.items()
+                if w.healthy and not w.draining
+                and kind not in w.open_breakers
+            }
+
+    def fleet_availability(self) -> float | None:
+        """Mean polled SLO availability over healthy workers (None
+        until any worker reported one) — the admission shed signal."""
+        with self._lock:
+            vals = [w.availability for w in self.workers.values()
+                    if w.healthy and w.availability is not None]
+        return sum(vals) / len(vals) if vals else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                u: {
+                    "healthy": w.healthy,
+                    "draining": w.draining,
+                    "consecutive_fails": w.consecutive_fails,
+                    "open_breakers": sorted(w.open_breakers),
+                    "availability": w.availability,
+                }
+                for u, w in sorted(self.workers.items())
+            }
+
+
+class RouterApp:
+    """Routing + admission logic, independent of any socket (tests and
+    the bench drive it in-process, commands/fleet.py serves it)."""
+
+    def __init__(self, worker_urls: list[str],
+                 quotas: list[str] | None = None,
+                 max_inflight: int = 16,
+                 aging_rate: float = 0.5,
+                 default_timeout_s: float = 120.0,
+                 poll_interval_s: float = 2.0,
+                 down_after: int = 2,
+                 shed_below: float = 0.0,
+                 redirect: bool = False,
+                 vnodes: int = 64,
+                 registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.ring = HashRing(worker_urls, vnodes=vnodes)
+        self.pool = WorkerPool(worker_urls,
+                               poll_interval_s=poll_interval_s,
+                               down_after=down_after,
+                               registry=self.registry)
+        self.quotas = QuotaTable(quotas)
+        self.scheduler = FairScheduler(max_inflight=max_inflight,
+                                       aging_rate=aging_rate)
+        self.default_timeout_s = default_timeout_s
+        self.shed_below = shed_below
+        self.redirect = redirect
+        self.started = time.time()
+
+    def start(self) -> "RouterApp":
+        self.pool.start()
+        return self
+
+    def close(self) -> None:
+        self.pool.close()
+
+    # ---- routing ----
+
+    def affinity_key(self, kind: str, req: dict) -> str:
+        """The ring key: every input file's content identity, in
+        order. Falls back to the raw path when the file cannot be
+        stat'd (routing must not 500 a request validation will 400)
+        and to the canonical body when the request names no file."""
+        paths: list[str] = []
+        for field in AFFINITY_FIELDS.get(kind, ()):
+            v = req.get(field)
+            if isinstance(v, str):
+                paths.append(v)
+            elif isinstance(v, (list, tuple)):
+                paths.extend(p for p in v if isinstance(p, str))
+        if not paths:
+            return kind + ":" + json.dumps(
+                {k: v for k, v in sorted(req.items())
+                 if k not in ("tenant", "priority", "timeout_s")},
+                sort_keys=True, default=str)
+        parts = []
+        for p in paths:
+            try:
+                parts.append(repr(_file_key(p)))
+            except OSError:
+                parts.append(p)
+        return "|".join(parts)
+
+    def plan(self, kind: str, req: dict) -> list[str]:
+        """Candidate worker order for this request: the ring walk from
+        its affinity key, eligible workers first (affinity preserved
+        within each class)."""
+        order = self.ring.candidates(self.affinity_key(kind, req))
+        ok = self.pool.eligible(kind)
+        return [u for u in order if u in ok] \
+            + [u for u in order if u not in ok]
+
+    def _forward(self, url: str, kind: str, body: bytes,
+                 timeout_s: float) -> tuple[int, bytes]:
+        req = urllib.request.Request(
+            url + "/v1/" + kind, data=body,
+            headers={"Content-Type": "application/json",
+                     "Accept": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def handle(self, kind: str, body: bytes) -> tuple[int, dict | bytes]:
+        """One routed request → (status, response bytes-or-dict)."""
+        try:
+            req = json.loads(body or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+        except ValueError as e:
+            return 400, {"error": f"bad JSON body: {e}"}
+        tenant = str(req.get("tenant") or "default")
+        priority = int(req.get("priority", 0))
+        timeout_s = float(req.get("timeout_s", self.default_timeout_s))
+        c = self.registry.counter
+        c(f"fleet.requests_total.{kind}").inc()
+
+        # gate 1: per-tenant quota — one tenant's flood 429s only
+        # itself, with an honest refill hint
+        try:
+            self.quotas.check(tenant)
+        except QuotaExceeded as e:
+            c(f"fleet.quota_rejected_total.{tenant}").inc()
+            return 429, {"error": str(e),
+                         "retry_after_s": round(e.retry_after_s, 3),
+                         "tenant": tenant}
+
+        # gate 2: availability shed — while the fleet is failing its
+        # SLO, best-effort traffic (priority > 0) is shed so the
+        # remaining capacity serves the interactive class
+        if self.shed_below > 0 and priority > 0:
+            avail = self.pool.fleet_availability()
+            if avail is not None and avail < self.shed_below:
+                c("fleet.shed_total").inc()
+                return 503, {
+                    "error": f"fleet availability {avail:.3f} below "
+                             f"{self.shed_below:g}; best-effort "
+                             "traffic shed",
+                    "retry_after_s": self.pool.poll_interval_s}
+
+        # gate 3: a fair forwarding slot (deadline-aware, aged)
+        try:
+            waited = self.scheduler.acquire(tenant, priority,
+                                            timeout_s=timeout_s)
+        except SchedulerTimeout as e:
+            c("fleet.scheduler_timeouts_total").inc()
+            return 504, {"error": str(e)}
+        self.registry.histogram("fleet.queue_wait_s").observe(waited)
+        try:
+            return self._route(kind, req, body, timeout_s)
+        finally:
+            self.scheduler.release()
+
+    def _route(self, kind: str, req: dict, body: bytes,
+               timeout_s: float) -> tuple[int, dict | bytes]:
+        candidates = self.plan(kind, req)
+        eligible = self.pool.eligible(kind)
+        live = [u for u in candidates if u in eligible]
+        if not live:
+            self.registry.counter("fleet.no_worker_total").inc()
+            return 503, {
+                "error": f"no healthy worker for {kind!r} "
+                         f"({len(candidates)} known, 0 eligible)",
+                "retry_after_s": self.pool.poll_interval_s}
+        if self.redirect:
+            # hand the client the home worker and get out of the way
+            self.registry.counter(
+                f"fleet.redirects_total.{kind}").inc()
+            return 307, {"location": live[0] + "/v1/" + kind}
+        last_err: dict | None = None
+        for i, url in enumerate(live):
+            if i > 0:
+                self.registry.counter("fleet.retries_total").inc()
+            wk = url.rsplit(":", 1)[-1]  # port: the stable short label
+            try:
+                status, payload = self._forward(url, kind, body,
+                                                timeout_s)
+            except Exception as e:  # noqa: BLE001 — connection-level
+                # death (refused/reset/timeout): the worker, not the
+                # request — eject it and try the next ring candidate
+                self.pool.mark_failed(url)
+                self.registry.counter(
+                    f"fleet.worker_errors_total.{wk}").inc()
+                last_err = {"error": f"worker {url} unreachable: "
+                                     f"{e!r}"}
+                continue
+            if status == 503:
+                # the worker is shedding (breaker open / draining):
+                # re-route reactively instead of bouncing the client —
+                # the poller will import the breaker state for next
+                # time
+                self.registry.counter(
+                    f"fleet.worker_shed_total.{wk}").inc()
+                try:
+                    last_err = json.loads(payload.decode())
+                except ValueError:
+                    last_err = {"error": f"worker {url} shed (503)"}
+                continue
+            self.registry.counter(
+                f"fleet.routed_total.{wk}.{kind}").inc()
+            if i == 0:
+                self.registry.counter(
+                    f"fleet.affinity_hits_total.{kind}").inc()
+            return status, payload
+        return 503, {**(last_err or {"error": "all workers failed"}),
+                     "retry_after_s": self.pool.poll_interval_s}
+
+    # ---- operability ----
+
+    def healthz(self) -> tuple[int, dict]:
+        snap = self.pool.snapshot()
+        n_up = sum(1 for w in snap.values() if w["healthy"])
+        return (200 if n_up else 503), {
+            "status": "ok" if n_up else "degraded",
+            "workers": len(snap), "healthy": n_up,
+            "uptime_s": round(time.time() - self.started, 1),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        g = self.registry.gauge
+        g("fleet.queue_depth").set(self.scheduler.queue_depth())
+        g("fleet.queue_age_s").set(
+            round(self.scheduler.queue_age_s(), 4))
+        g("fleet.inflight").set(self.scheduler.inflight())
+        avail = self.pool.fleet_availability()
+        if avail is not None:
+            g("fleet.availability").set(round(avail, 6))
+        snap = self.registry.snapshot()
+        return {
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "histograms": snap.get("histograms", {}),
+            "workers": self.pool.snapshot(),
+        }
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    @property
+    def app(self) -> RouterApp:
+        return self.server.app
+
+    def _respond_json(self, code: int, body: dict,
+                      extra_headers: dict | None = None) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+        self.close_connection = True
+
+    def _respond_raw(self, code: int, data: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+        self.close_connection = True
+
+    def do_GET(self):  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            code, body = self.app.healthz()
+            self._respond_json(code, body)
+        elif self.path.startswith("/metrics"):
+            self._respond_json(200, self.app.metrics_snapshot())
+        else:
+            self._respond_json(404,
+                               {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — http.server contract
+        n = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(n)
+        if self.path == "/fleet/plan":
+            try:
+                req = json.loads(body or b"{}")
+                kind = req.pop("kind")
+            except (ValueError, KeyError):
+                self._respond_json(
+                    400, {"error": "want a JSON object with 'kind'"})
+                return
+            self._respond_json(
+                200, {"candidates": self.app.plan(kind, req)})
+            return
+        if not self.path.startswith("/v1/"):
+            self._respond_json(404,
+                               {"error": f"no route {self.path}"})
+            return
+        kind = self.path[len("/v1/"):].strip("/")
+        code, payload = self.app.handle(kind, body)
+        if code == 307:
+            # redirect mode: Location + a JSON body naming it (for
+            # clients that refuse to follow)
+            self._respond_json(code, payload,
+                               extra_headers={
+                                   "Location": payload["location"]})
+        elif isinstance(payload, bytes):
+            self._respond_raw(code, payload)
+        else:
+            self._respond_json(code, payload)
+
+
+class _RouterServer(ThreadingHTTPServer):
+    daemon_threads = False
+    block_on_close = True
+    allow_reuse_address = True
+
+
+def make_router_server(app: RouterApp, host: str = "127.0.0.1",
+                       port: int = 0) -> ThreadingHTTPServer:
+    srv = _RouterServer((host, port), _RouterHandler)
+    srv.app = app
+    return srv
+
+
+class RouterThread:
+    """In-process router harness (tests, the bench):
+    ``with RouterThread(app) as url: ...``"""
+
+    def __init__(self, app: RouterApp, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.app = app
+        self.httpd = make_router_server(app, host, port)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            daemon=True, name="goleft-fleet-http")
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> str:
+        self.app.start()
+        self._thread.start()
+        return self.base_url
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self._thread.join(timeout=30.0)
+        self.httpd.server_close()
+        self.app.close()
+        return False

@@ -1,0 +1,427 @@
+"""End-to-end fleet smoke: the ``make fleet-smoke`` body.
+
+Real subprocess daemons all the way down (the acceptance contract):
+
+  1. **byte identity**: a continuous-batching daemon and a
+     window-batching daemon answer depth / indexcov / cohortdepth /
+     pairhmm identically, and the payloads that ARE one-shot-CLI bytes
+     (depth beds, the cohortdepth matrix, the pairhmm table) equal the
+     CLI bodies run in-process on the same fixtures. (The indexcov
+     serve response has been a JSON summary — not CLI file bytes —
+     since PR 2; it is pinned continuous == window.)
+  2. **cross-request step dedup**: two concurrent identical depth
+     requests against a daemon whose first device pass is held open by
+     an injected ``hang`` fault produce ONE device pass
+     (``serve_device_passes_total == 1``,
+     ``plan_steps_deduped_total >= 1``) and two byte-identical 200s.
+  3. **router retry across worker death**: a depth request is routed
+     to its affinity home, the home worker is SIGKILLed mid-flight,
+     and the router retries on the sibling — the client sees one
+     byte-identical 200 (``fleet.retries_total`` incremented).
+  4. **per-site breaker shed**: a worker whose ``pairhmm`` breaker is
+     tripped (injected permanent faults) loses only its pairhmm
+     traffic after the router imports its breaker state; depth
+     traffic with affinity to that worker keeps landing on it.
+  5. **per-tenant quotas**: a tenant exhausting its token bucket gets
+     429 + ``retry_after_s`` while another tenant's requests sail
+     through; a retry-aware client (serve/client.py ``retries=1``)
+     honors the hint and lands the follow-up 200.
+
+Run directly::
+
+    python -m goleft_tpu.fleet.smoke
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..resilience.smoke import _make_cohort, _stop_daemon
+
+
+def _spawn(args, env):
+    """A goleft-tpu child announcing ``listening on URL``; returns
+    (child, url)."""
+    child = subprocess.Popen(
+        [sys.executable, "-m", "goleft_tpu", *args],
+        stdout=subprocess.PIPE, text=True, env=env)
+    line = child.stdout.readline()
+    if "listening on " not in line:
+        child.kill()
+        raise RuntimeError(f"child did not announce its port: "
+                           f"{line!r} (args {args})")
+    return child, line.rsplit("listening on ", 1)[1].strip()
+
+
+def _spawn_worker(env, *extra):
+    return _spawn(["serve", "--port", "0", "--no-warmup", *extra],
+                  env)
+
+
+def _spawn_router(env, worker_urls, *extra):
+    args = ["fleet", "--port", "0", "--poll-interval-s", "0.3",
+            "--down-after", "1"]
+    for u in worker_urls:
+        args += ["--worker", u]
+    return _spawn(args + list(extra), env)
+
+
+def _write_windows(d: str) -> str:
+    """The pairhmm fixture (the pairhmm smoke's shape: one informative
+    window, one far-away window)."""
+    import numpy as np
+
+    rng = np.random.default_rng(6)
+    bases = list("ACGT")
+    ref = "".join(rng.choice(bases, 60))
+    alt = ref[:29] + ("A" if ref[29] != "A" else "C") + ref[30:]
+    reads = [{"seq": (ref if i % 2 else alt)[s:s + 40], "quals": 35}
+             for i, s in ((i, int(rng.integers(0, 10)))
+                          for i in range(8))]
+    doc = {"schema": "goleft-tpu.pairhmm-windows/1",
+           "windows": [
+               {"chrom": "chr1", "start": 100, "end": 400,
+                "haplotypes": [ref, alt], "reads": reads},
+               {"chrom": "chr1", "start": 4000, "end": 4100,
+                "haplotypes": [ref], "reads": reads[:2]},
+           ]}
+    path = os.path.join(d, "windows.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return path
+
+
+def _prom_counter(prom: str, name: str) -> int:
+    import re
+
+    m = re.search(rf"^{re.escape(name)} (\d+)", prom, re.M)
+    return int(m.group(1)) if m else 0
+
+
+def _leg_byte_identity(d, bams, fai, windows, env, verbose):
+    """Leg 1: continuous == window == one-shot CLI bytes."""
+    from ..commands.cohortdepth import run_cohortdepth
+    from ..commands.depth import run_depth
+    from ..commands.pairhmm_cmd import run_pairhmm
+    from ..serve.client import ServeClient
+
+    # in-process one-shot CLI references (run_* ARE the CLI bodies)
+    dp, cp = run_depth(bams[0], os.path.join(d, "ref-depth"),
+                       fai=fai, window=200)
+    with open(dp) as fh:
+        ref_depth = fh.read()
+    with open(cp) as fh:
+        ref_callable = fh.read()
+    buf = io.StringIO()
+    assert run_cohortdepth(bams, fai=fai, window=200, out=buf,
+                           processes=2) == 0
+    ref_matrix = buf.getvalue()
+    buf = io.StringIO()
+    assert run_pairhmm(windows, out=buf) == 0
+    ref_table = buf.getvalue()
+
+    responses = {}
+    for mode in ("continuous", "window"):
+        child, url = _spawn_worker(env, "--batch-mode", mode)
+        try:
+            client = ServeClient(url, timeout_s=120.0)
+            responses[mode] = {
+                "depth": client.depth(bams[0], fai=fai, window=200),
+                "indexcov": client.indexcov(bams, fai),
+                "cohortdepth": client.cohortdepth(bams, fai=fai,
+                                                  window=200),
+                "pairhmm": client.pairhmm(windows),
+            }
+        finally:
+            _stop_daemon(child)
+    cont, win = responses["continuous"], responses["window"]
+    for kind in ("depth", "indexcov", "cohortdepth", "pairhmm"):
+        if cont[kind] != win[kind]:
+            raise RuntimeError(
+                f"continuous vs window responses differ for {kind}")
+    if cont["depth"]["depth_bed"] != ref_depth \
+            or cont["depth"]["callable_bed"] != ref_callable:
+        raise RuntimeError("serve depth != one-shot CLI bytes")
+    if cont["cohortdepth"]["matrix_tsv"] != ref_matrix:
+        raise RuntimeError("serve cohortdepth != one-shot CLI bytes")
+    if cont["pairhmm"]["likelihoods_tsv"] != ref_table:
+        raise RuntimeError("serve pairhmm != one-shot CLI bytes")
+    if verbose:
+        print("fleet-smoke: continuous == window == one-shot CLI "
+              "bytes (depth/indexcov/cohortdepth/pairhmm)")
+
+
+def _leg_dedup(d, bams, fai, env, verbose):
+    """Leg 2: two concurrent identical requests → one device pass."""
+    from ..serve.client import ServeClient
+
+    # hold the FIRST device pass open 1.5s so the second (identical)
+    # request provably arrives while the leader is in flight
+    env = dict(env, GOLEFT_TPU_FAULTS="device:after=1:hang=1.5")
+    child, url = _spawn_worker(env)
+    try:
+        client = ServeClient(url, timeout_s=120.0)
+        out = [None, None]
+        errs = []
+
+        def fire(i):
+            try:
+                out[i] = client.depth(bams[0], fai=fai, window=180)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        t0 = threading.Thread(target=fire, args=(0,))
+        t0.start()
+        time.sleep(0.6)  # leader is inside the 1.5s hang
+        t1 = threading.Thread(target=fire, args=(1,))
+        t1.start()
+        for t in (t0, t1):
+            t.join(timeout=120)
+        if errs:
+            raise RuntimeError(f"dedup leg request failed: {errs}")
+        if out[0] != out[1] or not out[0]["depth_bed"]:
+            raise RuntimeError("deduped responses are not "
+                               "byte-identical")
+        prom = client.metrics_prometheus()
+        passes = _prom_counter(prom, "serve_device_passes_total")
+        deduped = _prom_counter(prom, "plan_steps_deduped_total")
+        req_dedup = _prom_counter(prom,
+                                  "serve_request_deduped_total_depth")
+        if passes != 1:
+            raise RuntimeError(
+                f"two identical concurrent requests cost {passes} "
+                "device pass(es), want exactly 1")
+        if deduped < 1 or req_dedup != 1:
+            raise RuntimeError(
+                f"dedup counters wrong: plan={deduped}, "
+                f"request={req_dedup}")
+        if verbose:
+            print("fleet-smoke: concurrent identical requests "
+                  f"deduped (1 device pass, {deduped} plan-level "
+                  "join(s), byte-identical 200s)")
+    finally:
+        _stop_daemon(child)
+
+
+def _leg_router_sigkill_retry(d, bams, fai, env, verbose):
+    """Leg 3: SIGKILL the affinity home mid-flight → router retries
+    on the sibling → byte-identical 200."""
+    from ..commands.depth import run_depth
+    from ..serve.client import ServeClient
+
+    dp, _ = run_depth(bams[1], os.path.join(d, "ref-kill"),
+                      fai=fai, window=175)
+    with open(dp) as fh:
+        ref_bed = fh.read()
+    # every device pass hangs 2s (twice): the mid-flight window we
+    # kill into, on whichever worker gets the request
+    wenv = dict(env, GOLEFT_TPU_FAULTS="device:every=1:hang=2:times=2")
+    w0, u0 = _spawn_worker(wenv)
+    w1, u1 = _spawn_worker(wenv)
+    router = None
+    try:
+        router, rurl = _spawn_router(env, [u0, u1])
+        client = ServeClient(rurl, timeout_s=120.0)
+        home = client.route_plan("depth", bam=bams[1])[0]
+        victim = w0 if home == u0 else w1
+        out = {}
+        errs = []
+
+        def fire():
+            try:
+                out["r"] = client.depth(bams[1], fai=fai, window=175)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.9)  # forwarded; home is inside its 2s hang
+        victim.kill()    # SIGKILL, not SIGTERM: no drain, no goodbye
+        victim.wait(timeout=10)
+        t.join(timeout=120)
+        if errs:
+            raise RuntimeError(
+                f"request did not survive the worker kill: {errs}")
+        if out["r"]["depth_bed"] != ref_bed:
+            raise RuntimeError(
+                "post-retry response is not byte-identical to the "
+                "one-shot CLI")
+        m = client.metrics()
+        if m["counters"].get("fleet.retries_total", 0) < 1:
+            raise RuntimeError("router did not count the retry")
+        if m["workers"][home]["healthy"]:
+            raise RuntimeError("dead worker still marked healthy")
+        if verbose:
+            print("fleet-smoke: SIGKILLed the affinity home "
+                  "mid-flight; router retried on the sibling "
+                  "(byte-identical 200, retries_total="
+                  f"{m['counters']['fleet.retries_total']})")
+    finally:
+        if router is not None:
+            _stop_daemon(router)
+        for w in (w0, w1):
+            if w.poll() is None:
+                w.kill()
+                w.wait(timeout=10)
+            w.stdout.close()
+
+
+def _leg_breaker_shed_and_quota(d, bams, fai, windows, env, verbose):
+    """Legs 4+5: per-site breaker shed via the router, then tenant
+    quotas (one router hosts both: quotas configured at spawn)."""
+    import shutil
+
+    from ..serve.client import ServeClient, ServeError
+
+    # w_fault: every pairhmm dispatch fails permanently; threshold 2
+    # trips its breaker. w_clean: healthy sibling.
+    fenv = dict(env, GOLEFT_TPU_FAULTS="pairhmm:every=1:permanent")
+    w_fault, uf = _spawn_worker(fenv, "--breaker-threshold", "2",
+                                "--breaker-cooldown-s", "600")
+    w_clean, uc = _spawn_worker(env)
+    router = None
+    try:
+        router, rurl = _spawn_router(
+            env, [uf, uc], "--quota", "alice=0.5:2")
+        client = ServeClient(rurl, timeout_s=120.0)
+
+        # trip w_fault's pairhmm breaker DIRECTLY (not via the
+        # router: the trip itself is the worker's own 500 story)
+        direct = ServeClient(uf, timeout_s=60.0)
+        for _ in range(2):
+            try:
+                direct.pairhmm(windows)
+                raise RuntimeError("faulted pairhmm unexpectedly ok")
+            except ServeError as e:
+                if e.status != 500:
+                    raise RuntimeError(
+                        f"want 500 from faulted worker, got "
+                        f"{e.status}")
+        if direct.metrics()["breakers"]["pairhmm"] != "open":
+            raise RuntimeError("pairhmm breaker did not trip")
+        time.sleep(0.8)  # two poll intervals: router imports state
+
+        # pairhmm now avoids w_fault entirely…
+        plan = client.route_plan("pairhmm", input=windows)
+        if plan[0] == uf:
+            raise RuntimeError(
+                "router still plans pairhmm onto the tripped worker")
+        r = client.pairhmm(windows)
+        if not r.get("likelihoods_tsv"):
+            raise RuntimeError("re-routed pairhmm response empty")
+        # …while depth traffic whose affinity home IS w_fault keeps
+        # landing there (shed is per-site, not per-worker). Find —
+        # or mint — a bam homed on w_fault (content identity includes
+        # the path, so copies re-roll the ring position).
+        probe = None
+        for i in range(24):
+            cand = bams[2] if i == 0 \
+                else os.path.join(d, f"homed{i}.bam")
+            if i > 0:
+                shutil.copy(bams[2], cand)
+                shutil.copy(bams[2] + ".bai", cand + ".bai")
+            if client.route_plan("depth", bam=cand)[0] == uf:
+                probe = cand
+                break
+        if probe is None:
+            raise RuntimeError(
+                "could not mint a bam homed on the tripped worker")
+        if not client.depth(probe, fai=fai,
+                            window=200)["depth_bed"]:
+            raise RuntimeError("depth via tripped-pairhmm worker "
+                               "failed")
+        port_f = uf.rsplit(":", 1)[-1]
+        m = client.metrics()
+        if m["counters"].get(
+                f"fleet.routed_total.{port_f}.depth", 0) < 1:
+            raise RuntimeError(
+                "depth request did not land on the tripped worker")
+        if m["counters"].get(
+                f"fleet.routed_total.{port_f}.pairhmm", 0) != 0:
+            raise RuntimeError(
+                "pairhmm traffic still reached the tripped worker")
+        if verbose:
+            print("fleet-smoke: tripped pairhmm breaker sheds ONLY "
+                  "pairhmm traffic (depth still lands on the "
+                  "worker)")
+
+        # leg 5: tenant quotas. alice has burst 2 at 0.5/s; bob is
+        # unmetered. Distinct cache_busters keep requests distinct.
+        client.depth(probe, fai=fai, window=200, tenant="alice",
+                     cache_buster=1)
+        client.depth(probe, fai=fai, window=200, tenant="alice",
+                     cache_buster=2)
+        try:
+            client.depth(probe, fai=fai, window=200, tenant="alice",
+                         cache_buster=3)
+            raise RuntimeError("alice's third burst request was not "
+                               "shed")
+        except ServeError as e:
+            if e.status != 429 or not e.retry_after_s:
+                raise RuntimeError(
+                    f"want 429 + retry_after_s, got {e.status} "
+                    f"{e.retry_after_s!r}")
+            hint = e.retry_after_s
+        # bob is untouched by alice's exhaustion
+        if not client.depth(probe, fai=fai, window=200,
+                            tenant="bob")["depth_bed"]:
+            raise RuntimeError("bob's request failed during alice's "
+                               "quota exhaustion")
+        # the retry-aware client honors the hint and lands the 200
+        patient = ServeClient(rurl, timeout_s=120.0, retries=1)
+        t0 = time.monotonic()
+        r = patient.depth(probe, fai=fai, window=200,
+                          tenant="alice", cache_buster=4)
+        waited = time.monotonic() - t0
+        if not r["depth_bed"] or waited < min(hint, 1.0) * 0.5:
+            raise RuntimeError(
+                f"retry-aware client did not honor retry_after_s "
+                f"(waited {waited:.2f}s, hint {hint:.2f}s)")
+        if verbose:
+            print("fleet-smoke: tenant quota shed alice with 429 + "
+                  f"retry_after_s={hint:.2f} (bob unaffected; "
+                  "retry-aware client honored the hint)")
+    finally:
+        if router is not None:
+            _stop_daemon(router)
+        for w in (w_fault, w_clean):
+            _stop_daemon(w)
+
+
+def run_smoke(timeout_s: float = 600.0, verbose: bool = True) -> int:
+    """Returns 0 on success; raises on any failed step."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",     # CI has no accelerator
+               GOLEFT_TPU_PROBE="0")    # don't pay a probe timeout
+    env.pop("GOLEFT_TPU_FAULTS", None)  # hermetic
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="goleft_fleet_") as d:
+        # ref_len 20k: indexcov needs at least one full 16kb index
+        # tile per chromosome to have usable bins
+        bams, fai, _bed = _make_cohort(d, ref_len=20_000)
+        windows = _write_windows(d)
+        _leg_byte_identity(d, bams, fai, windows, env, verbose)
+        _leg_dedup(d, bams, fai, env, verbose)
+        _leg_router_sigkill_retry(d, bams, fai, env, verbose)
+        _leg_breaker_shed_and_quota(d, bams, fai, windows, env,
+                                    verbose)
+        if time.monotonic() - t0 > timeout_s:
+            raise RuntimeError(
+                f"fleet-smoke exceeded its {timeout_s:g}s budget")
+        if verbose:
+            print(f"fleet-smoke: PASS "
+                  f"({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run_smoke())

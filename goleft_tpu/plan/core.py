@@ -44,6 +44,13 @@ class Step:
     propagate raw) while keeping the fault site and checkpoint
     behavior — the region-advance steps, whose inner work carries its
     own per-sample policy.
+
+    ``dedup=True`` opts the step into the executor's in-flight step
+    table: a concurrent step with the SAME key joins the running
+    execution instead of launching its own (one device pass serves
+    both callers). Only safe — and only meaningful — for steps whose
+    key is full content identity and whose value is a pure function of
+    it; callers must treat the shared value as read-only.
     """
 
     key: tuple
@@ -66,6 +73,7 @@ class Step:
     fallback: Callable[[], Any] | None = None
     span: str | None = None        # obs span name (None: no extra span)
     device: bool = False           # span is a device-event span
+    dedup: bool = False            # share one in-flight execution per key
     attrs: dict = field(default_factory=dict)
 
     def ck_keys(self) -> list[tuple]:
@@ -92,6 +100,7 @@ class StepOutcome:
     from_cache: bool = False
     resumed: bool = False
     quarantined: bool = False
+    deduped: bool = False  # value shared from a concurrent execution
 
     @property
     def ok(self) -> bool:

@@ -28,6 +28,7 @@ costs one backoff instead of failing the whole batch.
 
 from __future__ import annotations
 
+import threading
 from typing import Any
 
 from ..obs import get_registry
@@ -38,6 +39,75 @@ from ..resilience.policy import (
 from .core import Plan, Step, StepOutcome
 
 
+class _InflightEntry:
+    __slots__ = ("event", "outcome")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.outcome: StepOutcome | None = None
+
+
+class InflightSteps:
+    """Cross-request in-flight step table: the dedup machinery.
+
+    Two concurrent Steps carrying the same content key (and
+    ``dedup=True``) share ONE execution: the first arrival is the
+    *leader* and computes; every later arrival is a *follower* that
+    waits on the leader's outcome and reuses its value — one device
+    pass serves all of them. Content keys make this safe: the key
+    pins every input's identity (``file_key`` = path+size+mtime_ns)
+    plus the canonical parameters, so "same key" means "same bytes
+    out".
+
+    Failures are NOT shared: a follower whose leader errored (or
+    vanished past ``wait_s``) computes independently — dedup is an
+    optimization, never a correlated-failure amplifier.
+
+    The process-wide instance is :data:`INFLIGHT`; executors use it by
+    default so dedup spans every Executor in the process (the serve
+    executors construct one per dispatch).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
+
+    def join(self, key) -> tuple[_InflightEntry, bool]:
+        """(entry, is_leader). The leader MUST eventually
+        :meth:`settle` its entry (use try/finally)."""
+        with self._lock:
+            entry = self._inflight.get(key)
+            if entry is None:
+                entry = self._inflight[key] = _InflightEntry()
+                return entry, True
+            return entry, False
+
+    def settle(self, key, entry: _InflightEntry,
+               outcome: StepOutcome | None) -> None:
+        with self._lock:
+            # pop only our own entry: a follower that timed out and
+            # re-led must not have its fresh entry evicted by the
+            # stale leader settling late
+            if self._inflight.get(key) is entry:
+                del self._inflight[key]
+        entry.outcome = outcome
+        entry.event.set()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+
+#: the process-wide in-flight table (one dedup domain per process)
+INFLIGHT = InflightSteps()
+
+#: how long a follower waits on its leader before giving up and
+#: computing independently — generous (a wedged leader is the
+#: watchdog's business, not the follower's), but bounded so a leaked
+#: leader cannot wedge every future identical request
+DEDUP_WAIT_S = 600.0
+
+
 class Executor:
     """Runs Steps under one (policy, quarantine, checkpoint, cache)
     composition. All collaborators optional: a bare ``Executor()``
@@ -46,11 +116,15 @@ class Executor:
     wired, which is what makes the lowering transparent."""
 
     def __init__(self, policy: RetryPolicy | None = None,
-                 quarantine=None, checkpoint=None, cache=None):
+                 quarantine=None, checkpoint=None, cache=None,
+                 inflight: InflightSteps | None = None):
         self.policy = policy
         self.quarantine = quarantine
         self.checkpoint = checkpoint
         self.cache = cache
+        # dedup domain: the process-wide table unless a test injects
+        # its own — steps only participate when they set dedup=True
+        self.inflight = inflight if inflight is not None else INFLIGHT
 
     # ---- the composition ----
 
@@ -88,14 +162,14 @@ class Executor:
             with self._span(step):
                 return step.fn()
 
-        policy = step.policy if step.policy is not None else self.policy
-        if policy is None or not step.retry:
-            # resilience layer off (or a no-retry boundary step): run
-            # raw — errors propagate to the caller, exactly the
-            # pre-plan behavior of the unguarded paths
-            value = attempt()
-            attempts = 1
-        else:
+        def compute() -> StepOutcome:
+            policy = step.policy if step.policy is not None \
+                else self.policy
+            if policy is None or not step.retry:
+                # resilience layer off (or a no-retry boundary step):
+                # run raw — errors propagate to the caller, exactly
+                # the pre-plan behavior of the unguarded paths
+                return StepOutcome(step.key, value=attempt())
             try:
                 value, attempts = policy.call(step.key, attempt)
             except RetriesExhausted as rx:
@@ -113,17 +187,55 @@ class Executor:
                                    retries_exhausted=rx,
                                    attempts=rx.attempts,
                                    classification=rx.classification)
+            return StepOutcome(step.key, value=value,
+                               attempts=attempts)
 
-        if self.cache is not None and step.cacheable:
+        if step.dedup:
+            outcome = self._run_deduped(step, compute, reg)
+        else:
+            outcome = compute()
+
+        if outcome.error is None and not outcome.quarantined \
+                and not outcome.deduped:
+            # persistence is the leader's job: a follower's value is
+            # already covered by the execution it joined
+            if self.cache is not None and step.cacheable:
+                try:
+                    self.cache.put(step.key, outcome.value)
+                except Exception:  # noqa: BLE001 — cache must not fail steps
+                    reg.counter("result_cache.io_errors_total").inc()
+            if ck_keys:
+                items = step.commit(outcome.value) \
+                    if step.commit is not None \
+                    else [(ck_keys[0], outcome.value)]
+                ck.put_many(items)
+        return outcome
+
+    def _run_deduped(self, step: Step, compute, reg) -> StepOutcome:
+        """Leader-or-follower execution through the in-flight table.
+
+        Exceptions escaping ``compute()`` (the no-policy raw path)
+        still settle the entry — a follower never waits on a leader
+        that already died."""
+        entry, leader = self.inflight.join(step.key)
+        if leader:
+            outcome = None
             try:
-                self.cache.put(step.key, value)
-            except Exception:  # noqa: BLE001 — cache must not fail steps
-                reg.counter("result_cache.io_errors_total").inc()
-        if ck_keys:
-            items = step.commit(value) if step.commit is not None \
-                else [(ck_keys[0], value)]
-            ck.put_many(items)
-        return StepOutcome(step.key, value=value, attempts=attempts)
+                outcome = compute()
+            finally:
+                self.inflight.settle(step.key, entry, outcome)
+            return outcome
+        reg.counter("plan.steps_deduped_total").inc()
+        shared = entry.outcome if entry.event.wait(DEDUP_WAIT_S) \
+            else None
+        if shared is not None and shared.error is None \
+                and not shared.quarantined:
+            return StepOutcome(step.key, value=shared.value,
+                               deduped=True)
+        # leader failed / was quarantined / timed out: compute
+        # independently — failures are never shared
+        reg.counter("plan.dedup_fallbacks_total").inc()
+        return compute()
 
     def run(self, step: Step):
         """run_step, raising the failure (the exhausted attempt's
@@ -176,7 +288,8 @@ def execute_task(key, thunk, cache=None,
 
 def run_device_step(name: str, fn, *, key=None, metrics=None,
                     policy: RetryPolicy | None = None,
-                    retry: bool = True, **attrs):
+                    retry: bool = True, dedup: bool = False,
+                    count_passes: bool = False, **attrs):
     """One coalesced serve device dispatch as a Step.
 
     The serve executors' dispatch boundary: the shared ``compute``
@@ -188,6 +301,15 @@ def run_device_step(name: str, fn, *, key=None, metrics=None,
     numpy before returning, so the span already fences on the device
     work. Raises the original failure on exhaustion (the batcher's
     bisect-and-retry isolation takes it from there).
+
+    ``dedup=True`` (with a content-identity ``key``) routes the step
+    through the process-wide in-flight table: a concurrent dispatch of
+    the same key joins the running pass instead of re-executing —
+    cross-request step dedup (``plan.steps_deduped_total``).
+    ``count_passes=True`` moves the executors'
+    ``device_passes_total`` accounting here, where a deduped dispatch
+    is visibly NOT a pass: only a genuinely executed step increments
+    it — the honesty the fleet smoke's one-pass assertion rests on.
     """
     import contextlib
 
@@ -201,6 +323,11 @@ def run_device_step(name: str, fn, *, key=None, metrics=None,
 
     ex = Executor(policy=policy if policy is not None
                   else DEFAULT_POLICY)
-    return ex.run(Step(key=key if key is not None else (name,),
-                       fn=staged, site="device", retry=retry,
-                       span=name, device=True, attrs=attrs))
+    out = ex.run_step(Step(key=key if key is not None else (name,),
+                           fn=staged, site="device", retry=retry,
+                           dedup=dedup, span=name, device=True,
+                           attrs=attrs))
+    if count_passes and metrics is not None and not out.deduped \
+            and out.error is None:
+        metrics.inc("device_passes_total")
+    return out.value_or_raise()

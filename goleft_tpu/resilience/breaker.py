@@ -36,6 +36,20 @@ log = get_logger("resilience.breaker")
 CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 
+#: state names that mean "stop sending this site traffic" — the
+#: contract the fleet router's breaker import reads out of a worker's
+#: /metrics ``breakers`` block. Half-open is NOT shedding: the worker
+#: itself admits exactly one probe, and starving it of traffic would
+#: keep the breaker open forever from the router's point of view.
+SHEDDING_STATES = frozenset({_NAMES[OPEN]})
+
+
+def is_shedding(state_name: str) -> bool:
+    """Should a router treat a site reporting ``state_name`` as
+    closed for business? (The one place the name strings published in
+    /metrics are interpreted outside this module.)"""
+    return state_name in SHEDDING_STATES
+
 
 class CircuitBreaker:
     def __init__(self, name: str = "", failure_threshold: int = 5,

@@ -35,6 +35,15 @@ then the serve legs — the same failure domains against a REAL
      (``checkpoint.shards_resumed_total`` > 0 in the /metrics
      Prometheus body)
 
+and the fleet legs (PR 9, bodies shared with ``make fleet-smoke``):
+
+  10. a fleet worker is SIGKILLed mid-flight; the router retries the
+      request on its sibling to a byte-identical 200
+  11. one worker's ``pairhmm`` breaker is tripped; the router imports
+      the breaker state and re-routes ONLY pairhmm traffic — the
+      worker's depth traffic keeps landing on it (plus the per-tenant
+      quota 429/retry_after_s leg riding the same router)
+
 Run directly::
 
     python -m goleft_tpu.resilience.smoke
@@ -264,7 +273,10 @@ def _serve_checkpoint_leg(d, bams, fai, bed, env, verbose):
 
     ckroot = os.path.join(d, "serve-ck")
     req = dict(fai=fai, window=200, bed=bed)
-    kill_env = dict(env, GOLEFT_TPU_FAULTS="shard:after=3:kill")
+    # after=5: the serve path batches journal commits (DeferredCommits,
+    # one fsync per JOURNAL_FLUSH_EVERY=4 regions) — the kill must land
+    # past the first flush so a committed prefix exists to resume from
+    kill_env = dict(env, GOLEFT_TPU_FAULTS="shard:after=5:kill")
     child, url = _spawn_daemon(kill_env, "--checkpoint-root", ckroot)
     try:
         client = ServeClient(url, timeout_s=60.0)
@@ -436,6 +448,21 @@ def run_smoke(timeout_s: float = 180.0, verbose: bool = True) -> int:
         _serve_watchdog_leg(d, fai, healthy_bam, env, verbose)
         _serve_checkpoint_leg(d, [bams[0], bams[2]], fai, bed, env,
                               verbose)
+
+        # 10-11. the fleet failure domains (bodies shared with
+        # `make fleet-smoke`): SIGKILLed worker → router retry, and
+        # a tripped per-site breaker shedding only its own traffic.
+        # bams[1] is corrupt by now — hand the legs healthy inputs.
+        from ..fleet.smoke import (
+            _leg_breaker_shed_and_quota, _leg_router_sigkill_retry,
+            _write_windows,
+        )
+
+        fleet_bams = [bams[0], bams[2], bams[0]]
+        windows = _write_windows(d)
+        _leg_router_sigkill_retry(d, fleet_bams, fai, env, verbose)
+        _leg_breaker_shed_and_quota(d, fleet_bams, fai, windows,
+                                    env, verbose)
         if verbose:
             print("chaos-smoke: PASS")
     return 0

@@ -83,6 +83,7 @@ class _Item:          # compare payloads
     result: Any = None
     error: BaseException | None = None
     requeues: int = 0
+    enqueued: float = 0.0  # time.monotonic() at admission
 
     def finish(self, result=None, error=None) -> None:
         self.result = result
@@ -145,7 +146,8 @@ class MicroBatcher:
                     self.metrics.inc("rejected_total")
                 raise Overloaded(
                     f"queue full ({self.max_queue} requests pending)")
-            item = _Item(next(self._seq), key, payload, deadline)
+            item = _Item(next(self._seq), key, payload, deadline,
+                         enqueued=time.monotonic())
             self._q.append(item)
             self._cond.notify_all()
         # wait past the deadline by the grace period: if the batch
@@ -314,6 +316,16 @@ class MicroBatcher:
 
     # ---- lifecycle ----
 
+    def queue_age_s(self) -> float:
+        """Seconds the OLDEST queued item has been waiting (0 when
+        empty) — the admission layer's backlog-pressure signal: a
+        growing queue age means dispatches are not keeping up."""
+        with self._cond:
+            if not self._q:
+                return 0.0
+            oldest = min(it.enqueued for it in self._q)
+            return max(0.0, time.monotonic() - oldest)
+
     def close(self, drain: bool = True) -> None:
         """Stop admission; with ``drain`` finish queued work first,
         else fail everything still queued. Idempotent."""
@@ -333,3 +345,69 @@ class MicroBatcher:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class ContinuousBatcher(MicroBatcher):
+    """Continuous batching: no fixed coalescing window.
+
+    The window batcher holds every batch anchor for ``window_s`` hoping
+    compatible requests arrive — a latency tax paid by EVERY request,
+    sized by hand against compile+dispatch costs. Continuous batching
+    drops the wait entirely: a dispatch forms from whatever compatible
+    work is queued *right now* and leaves immediately. Coalescing still
+    happens — better, under load — because dispatches are serialized on
+    the one dispatcher thread: every request that arrives while pass N
+    occupies the device joins the batch for pass N+1. The previous
+    pass's duration is the coalescing horizon, which self-sizes to the
+    actual compile/dispatch cost instead of a static knob:
+
+      - idle service: a lone request dispatches with zero added
+        latency (the window batcher charged it ``window_s``)
+      - loaded service: arrivals during an in-flight pass accumulate
+        and ride the next dispatch — max_batch-wide passes under
+        saturation, exactly when batching pays
+
+    Everything else — admission bound (429), deadlines (504), poison
+    bisection, the hung-dispatch watchdog, drain — is inherited
+    unchanged from :class:`MicroBatcher`; only batch *formation*
+    differs, and the executors are batch-composition-invariant, so
+    responses are byte-identical between the two batchers (pinned by
+    ``make fleet-smoke``).
+    """
+
+    def __init__(self, run_batch: Callable[[Hashable, Sequence], list],
+                 max_batch: int = 16, max_queue: int = 64,
+                 metrics=None, grace_s: float = 0.05,
+                 bisect_isolation: bool = True,
+                 classify: Callable[[BaseException], str] | None = None,
+                 watchdog_s: float | None = None,
+                 max_requeues: int = 1, **_ignored_window):
+        # window_s=0.0 documents intent; _take_batch below never
+        # consults it (an accidental window_s kwarg is swallowed so
+        # callers can switch batchers without re-plumbing)
+        super().__init__(run_batch, window_s=0.0, max_batch=max_batch,
+                         max_queue=max_queue, metrics=metrics,
+                         grace_s=grace_s,
+                         bisect_isolation=bisect_isolation,
+                         classify=classify, watchdog_s=watchdog_s,
+                         max_requeues=max_requeues)
+
+    def _take_batch(self) -> list[_Item] | None:
+        """Anchor on the oldest live item and sweep every compatible
+        item already queued — no wait, no window. Returns None when
+        stopping with an empty queue."""
+        with self._cond:
+            while True:
+                self._purge_expired(time.monotonic())
+                if self._q:
+                    break
+                if self._stopped:
+                    return None
+                self._cond.wait(timeout=0.1)
+            anchor = self._q.popleft()
+            batch = [anchor]
+            matched = [it for it in self._q if it.key == anchor.key]
+            for it in matched[: self.max_batch - 1]:
+                self._q.remove(it)
+                batch.append(it)
+        return batch

@@ -1,29 +1,86 @@
-"""Thin stdlib client for the serve daemon.
+"""Thin stdlib client for the serve daemon and the fleet router.
 
 urllib-only so scripts, the bench and `make serve-smoke` need nothing
 beyond this repo. Methods mirror the routes; non-2xx responses raise
-:class:`ServeError` carrying the HTTP status and the server's error
-message (so a 429 is distinguishable from a 504 at the call site).
+:class:`ServeError` carrying the HTTP status, the server's error
+message and (when the server sent one) its ``retry_after_s`` hint —
+so a 429 is distinguishable from a 504 at the call site.
+
+Routing-aware behavior (what the fleet layer leans on):
+
+  - **redirects**: a ``307``/``308`` whose body/headers carry the
+    target (the router's redirect mode — it hands the client the
+    affinity worker's URL and steps out of the data path) is followed
+    once per hop, re-POSTing the same body. urllib alone refuses to
+    follow redirected POSTs; this client implements them explicitly.
+  - **retry_after honor** (``retries > 0``): a 429 (quota) or 503
+    (breaker open, worker draining, fleet shedding) carrying
+    ``retry_after_s`` is retried after sleeping that hint (never more
+    than ``retry_cap_s``), up to ``retries`` times. Responses without
+    the hint fail immediately — the server didn't promise recovery.
 """
 
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 
+#: statuses whose retry_after_s hint the client will honor
+_RETRYABLE = (429, 503)
+_REDIRECT = (307, 308)
+
 
 class ServeError(RuntimeError):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 retry_after_s: float | None = None):
         super().__init__(f"HTTP {status}: {message}")
         self.status = status
         self.message = message
+        self.retry_after_s = retry_after_s
 
 
 class ServeClient:
-    def __init__(self, base_url: str, timeout_s: float = 120.0):
+    def __init__(self, base_url: str, timeout_s: float = 120.0,
+                 retries: int = 0, retry_cap_s: float = 30.0,
+                 max_redirects: int = 4):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retries = retries
+        self.retry_cap_s = retry_cap_s
+        self.max_redirects = max_redirects
+
+    def _post_once(self, url: str, data: bytes | None,
+                   headers: dict) -> dict:
+        """One HTTP exchange, following router redirects (re-POSTing
+        the same body); raises :class:`ServeError` on non-2xx."""
+        for _hop in range(self.max_redirects + 1):
+            req = urllib.request.Request(url, data=data,
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as r:
+                    return json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                raw = e.read()
+                try:
+                    body = json.loads(raw.decode())
+                except ValueError:
+                    body = {}
+                if e.code in _REDIRECT:
+                    target = e.headers.get("Location") \
+                        or body.get("location")
+                    if target:
+                        url = target
+                        continue
+                raise ServeError(
+                    e.code,
+                    body.get("error", "") or (e.reason or ""),
+                    retry_after_s=body.get("retry_after_s"),
+                ) from e
+        raise ServeError(508, f"too many redirects (> "
+                              f"{self.max_redirects}) from {url}")
 
     def _request(self, path: str, payload: dict | None = None) -> dict:
         url = self.base_url + path
@@ -32,17 +89,18 @@ class ServeClient:
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(url, data=data, headers=headers)
-        try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode())
-        except urllib.error.HTTPError as e:
+        attempt = 0
+        while True:
             try:
-                msg = json.loads(e.read().decode()).get("error", "")
-            except ValueError:
-                msg = e.reason
-            raise ServeError(e.code, msg) from e
+                return self._post_once(url, data, headers)
+            except ServeError as e:
+                if attempt >= self.retries \
+                        or e.status not in _RETRYABLE \
+                        or e.retry_after_s is None:
+                    raise
+                attempt += 1
+                time.sleep(min(max(0.0, e.retry_after_s),
+                               self.retry_cap_s))
 
     # ---- operability ----
 
@@ -65,6 +123,13 @@ class ServeClient:
         completed requests/batches, newest first."""
         path = "/debug/flight" + (f"?n={n}" if n is not None else "")
         return self._request(path)
+
+    def route_plan(self, kind: str, **params) -> list[str]:
+        """Fleet router only: the candidate worker order a request
+        with these params would route to (no forwarding) — the smoke
+        tests' way of finding a request's affinity home."""
+        return self._request("/fleet/plan",
+                             {"kind": kind, **params})["candidates"]
 
     # ---- workloads ----
 

@@ -55,7 +55,8 @@ def _stage(metrics, name: str):
     return metrics.timer.stage(name)
 
 
-def _dispatch(metrics, name: str, fn, retry: bool = True, **attrs):
+def _dispatch(metrics, name: str, fn, retry: bool = True, key=None,
+              count_passes: bool = False, **attrs):
     """The executors' dispatch boundary, lowered through the plan
     layer (plan/executor.py run_device_step): the shared ``compute``
     stage wall-clock PLUS a device-event span carrying backend/
@@ -66,6 +67,19 @@ def _dispatch(metrics, name: str, fn, retry: bool = True, **attrs):
     fetch their results to host numpy before returning, so the span's
     extent already fences on the device work.
 
+    ``key``: content identity of the pass (every input's file_key +
+    the canonical parameters + the batch order) — it seeds the retry
+    policy's deterministic jitter and labels injected faults with
+    WHAT was being computed, not just where. Dispatches do NOT join
+    the in-flight dedup table: batches are serialized on the one
+    dispatcher thread, so two executor steps are never genuinely
+    concurrent — except a watchdog-abandoned straggler, which a
+    re-queued pass must NOT join (the retry exists to escape it).
+    Cross-request dedup lives at the request boundary instead
+    (ServeApp._handle), where handler threads really are concurrent.
+    ``count_passes=True`` moves the ``device_passes_total`` inc into
+    run_device_step, which only counts genuinely executed steps.
+
     Failures that survive the retry budget raise out of the executor;
     the batcher's bisect-and-retry isolation (serve/batcher.py) then
     narrows them to the poisoned request instead of 500ing the whole
@@ -73,6 +87,7 @@ def _dispatch(metrics, name: str, fn, retry: bool = True, **attrs):
     from ..plan.executor import run_device_step
 
     return run_device_step(name, fn, metrics=metrics, retry=retry,
+                           key=key, count_passes=count_passes,
                            **attrs)
 
 
@@ -138,6 +153,7 @@ class DepthExecutor:
         from ..io.bai import read_bai
         from ..io.bam import open_bam_file
         from ..io.fai import read_fai
+        from ..parallel.scheduler import file_key
 
         p0 = reqs[0]
         window = int(p0.get("window", 250))
@@ -148,9 +164,18 @@ class DepthExecutor:
         regions = gen_regions(fai_records, chrom, window, bed)
         max_span = max((e - (s // window) * window
                         for _, s, e in regions), default=1)
-        engine = DepthEngine(window, int(p0.get("mincov", 4)),
-                             int(p0.get("maxmeandepth", 0)), mapq,
+        mincov = int(p0.get("mincov", 4))
+        maxmeandepth = int(p0.get("maxmeandepth", 0))
+        engine = DepthEngine(window, mincov, maxmeandepth, mapq,
                              max_span=max_span)
+        # content identity of one region pass: every parameter the
+        # engine reads, the region source (bed or fai — their CONTENT
+        # shapes the regions), and each batch member's BAM identity in
+        # order — the dedup key a concurrent identical dispatch joins
+        base_key = ("serve.depth", window, mincov, maxmeandepth, mapq,
+                    chrom, file_key(bed) if bed
+                    else file_key(_resolve_fai(p0)),
+                    tuple(file_key(r["bam"]) for r in reqs))
 
         def _open(req):
             handle = open_bam_file(req["bam"], lazy=True)
@@ -180,9 +205,8 @@ class DepthExecutor:
                     starts, ends, sums, cls = _dispatch(
                         self.metrics, "serve.depth.dispatch",
                         lambda: engine.run_segments_batch(segs, s, e),
+                        key=base_key + (c, s, e), count_passes=True,
                         batch=len(segs), region=f"{c}:{s}-{e}")
-                    if self.metrics:
-                        self.metrics.inc("device_passes_total")
                     with _stage(self.metrics, "format"):
                         for i, (dout, cout) in enumerate(outs):
                             write_shard_output(c, starts, ends,
@@ -237,11 +261,28 @@ class IndexcovExecutor:
             references,
         )
         from ..ops import indexcov_ops as ops
+        from ..parallel.scheduler import file_key
 
         p0 = reqs[0]
         refs = references([], p0["fai"], p0.get("chrom", "") or "")
         patt = p0.get("excludepatt", DEFAULT_EXCLUDE)
         exclude = re.compile(patt) if patt else None
+        # content identity of one chrom_qc pass: the reference dict,
+        # the filter params and every batch member's input identity in
+        # order — the INDEX file (what normalized_depth actually
+        # reads) alongside the named path, so a rebuilt .bai/.crai
+        # changes the key even when the bam itself did not move
+        def _input_keys(p):
+            keys = [file_key(p)] if os.path.exists(p) else [p]
+            for ext in (".bai", ".crai"):
+                if os.path.exists(p + ext):
+                    keys.append(file_key(p + ext))
+            return tuple(keys)
+
+        base_key = ("serve.indexcov", file_key(p0["fai"]),
+                    p0.get("chrom", "") or "", patt,
+                    tuple(_input_keys(p)
+                          for r in reqs for p in r["bams"]))
 
         with cf.ThreadPoolExecutor(
                 max_workers=max(1, self.processes)) as ex:
@@ -269,9 +310,8 @@ class IndexcovExecutor:
                 self.metrics, "serve.indexcov.dispatch",
                 lambda: np.asarray(
                     ops.chrom_qc(mat, valid, np.int32(longest))),
-                samples=S, chrom=ref_name)
-            if self.metrics:
-                self.metrics.inc("device_passes_total")
+                key=base_key + (int(ref_id), ref_name),
+                count_passes=True, samples=S, chrom=ref_name)
             _rocs, counters, cn = ops.unpack_chrom_qc(packed, S)
             for r, (lo, hi) in zip(out, zip(bounds, bounds[1:])):
                 # tail bins count vs the LONGEST sample; that was the
@@ -344,6 +384,7 @@ class PairhmmExecutor:
     def run(self, reqs: Sequence[dict]) -> list[dict]:
         from ..commands.pairhmm_cmd import read_windows, select_windows
         from ..models import genotype
+        from ..parallel.scheduler import file_key
 
         p0 = reqs[0]
         with _stage(self.metrics, "decode"):
@@ -354,6 +395,18 @@ class PairhmmExecutor:
         bounds = np.cumsum([0] + [len(ws) for ws in per_req])
         n_pairs = sum(len(w["reads"]) * len(w["haps"])
                       for w in windows)
+        # content identity of the coalesced wavefront pass: the model
+        # parameters plus each batch member's (windows doc, candidate
+        # file) identities in order — a concurrent identical dispatch
+        # joins this pass through the in-flight step table
+        step_key = ("serve.pairhmm",
+                    float(p0.get("gap_open", 45.0)),
+                    float(p0.get("gap_ext", 10.0)),
+                    bool(p0.get("f64", False)),
+                    tuple((file_key(r["input"]),
+                           file_key(r["candidates"])
+                           if r.get("candidates") else None)
+                          for r in reqs))
         results, n_bad = _dispatch(
             self.metrics, "serve.pairhmm.dispatch",
             lambda: genotype.score_windows(
@@ -361,9 +414,8 @@ class PairhmmExecutor:
                 gap_open=float(p0.get("gap_open", 45.0)),
                 gap_ext=float(p0.get("gap_ext", 10.0)),
                 dtype=np.float64 if p0.get("f64") else np.float32),
+            key=step_key, count_passes=True,
             windows=len(windows), pairs=n_pairs)
-        if self.metrics:
-            self.metrics.inc("device_passes_total")
         with _stage(self.metrics, "format"):
             return [{
                 "likelihoods_tsv": genotype.format_table(
@@ -439,19 +491,34 @@ class CohortdepthExecutor:
             i += 1
             yield blk
 
+    #: journal-batching factor under serve load: one fsync'd journal
+    #: append per this many region commits (blocks stay immediate and
+    #: atomic — a crash recomputes at most this many regions on
+    #: resume, byte-identically). The chaos smoke's mid-flight kill
+    #: (shard:after=5) lands one region past the first flush.
+    JOURNAL_FLUSH_EVERY = 4
+
     def _open_store(self, reqs):
         """The persistent store for ``checkpoint: true`` requests —
         always opened with ``resume=True`` so commits accumulate
         across requests AND daemon restarts (content-keyed: stale
-        inputs simply stop matching; entries for them go inert)."""
+        inputs simply stop matching; entries for them go inert).
+        Wrapped in :class:`DeferredCommits` so the region steps'
+        journal writes spill through one batched ``put_many`` commit
+        per :data:`JOURNAL_FLUSH_EVERY` dispatches instead of one
+        fsync pair per step."""
         if not (self.checkpoint_root
                 and any(r.get("checkpoint") for r in reqs)):
             return None
-        from ..resilience.checkpoint import CheckpointStore
+        from ..resilience.checkpoint import (
+            CheckpointStore, DeferredCommits,
+        )
 
-        return CheckpointStore(
-            os.path.join(self.checkpoint_root, "cohortdepth"),
-            resume=True)
+        return DeferredCommits(
+            CheckpointStore(
+                os.path.join(self.checkpoint_root, "cohortdepth"),
+                resume=True),
+            flush_every=self.JOURNAL_FLUSH_EVERY)
 
     def run(self, reqs: Sequence[dict]) -> list[dict]:
         from ..commands.cohortdepth import cohort_matrix_blocks

@@ -115,7 +115,8 @@ class ServeMetrics:
     def snapshot(self, queue_depth: int | None = None,
                  cache_stats: dict | None = None,
                  slo: dict | None = None,
-                 breakers: dict | None = None) -> dict:
+                 breakers: dict | None = None,
+                 queue_age_s: float | None = None) -> dict:
         counters = {
             n: v for n, v in self.registry.counters(_PREFIX).items()
             if not n.startswith("batch_size.")
@@ -136,6 +137,10 @@ class ServeMetrics:
         }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
+        if queue_age_s is not None:
+            # oldest-waiter age: the backlog-pressure signal the fleet
+            # router's admission layer sheds on
+            out["queue_age_s"] = round(queue_age_s, 4)
         if cache_stats is not None:
             out["cache"] = cache_stats
         if slo is not None:

@@ -48,7 +48,8 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from .batcher import (
-    DeadlineExceeded, MicroBatcher, Overloaded, PoisonRequest,
+    ContinuousBatcher, DeadlineExceeded, MicroBatcher, Overloaded,
+    PoisonRequest,
 )
 from .executors import (
     BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
@@ -82,7 +83,8 @@ class ServeApp:
                  watchdog_requeues: int = 1,
                  breaker_threshold: int = 5,
                  breaker_cooldown_s: float = 30.0,
-                 checkpoint_root: str | None = None):
+                 checkpoint_root: str | None = None,
+                 batch_mode: str = "continuous"):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
@@ -132,15 +134,41 @@ class ServeApp:
 
             self.cache = ResultCache(cache_dir,
                                      max_bytes=cache_max_bytes)
-        self.batcher = MicroBatcher(self._run_batch,
-                                    window_s=batch_window_s,
-                                    max_batch=max_batch,
-                                    max_queue=max_queue,
-                                    metrics=self.metrics,
-                                    grace_s=grace_s,
-                                    bisect_isolation=bisect_isolation,
-                                    watchdog_s=watchdog_s,
-                                    max_requeues=watchdog_requeues)
+        # continuous batching is the default: every dispatch admits
+        # whatever compatible work is queued (the in-flight pass is the
+        # coalescing horizon); "window" keeps the PR-2 fixed-window
+        # batcher — the byte-identity reference `make fleet-smoke`
+        # pins the continuous batcher against
+        if batch_mode not in ("continuous", "window"):
+            raise ValueError(
+                f"batch_mode must be 'continuous' or 'window' "
+                f"(got {batch_mode!r})")
+        self.batch_mode = batch_mode
+        if batch_mode == "continuous":
+            self.batcher = ContinuousBatcher(
+                self._run_batch, max_batch=max_batch,
+                max_queue=max_queue, metrics=self.metrics,
+                grace_s=grace_s, bisect_isolation=bisect_isolation,
+                watchdog_s=watchdog_s, max_requeues=watchdog_requeues)
+        else:
+            self.batcher = MicroBatcher(
+                self._run_batch, window_s=batch_window_s,
+                max_batch=max_batch, max_queue=max_queue,
+                metrics=self.metrics, grace_s=grace_s,
+                bisect_isolation=bisect_isolation,
+                watchdog_s=watchdog_s, max_requeues=watchdog_requeues)
+        # cross-request step dedup: every request lowers its batcher
+        # submit into a content-keyed plan Step (dedup=True), so two
+        # concurrent identical requests — handler threads really are
+        # concurrent, unlike the serialized batch dispatches — share
+        # ONE device pass through the process-wide in-flight step
+        # table (plan/executor.py InflightSteps); the follower's
+        # response is byte-identical because the key is full content
+        # identity (the session-cache key: canonical params + every
+        # input's file_key)
+        from ..plan import Executor as PlanExecutor
+
+        self._request_executor = PlanExecutor()
         # lifecycle flags cross threads: the signal handler / CLI
         # main thread flips draining while every HTTP handler thread
         # reads it, and SIGTERM can race atexit (or a test fixture)
@@ -210,10 +238,25 @@ class ServeApp:
                     return 200, {**hit, "cached": True}
             timeout = float(req.get("timeout_s",
                                     self.default_timeout_s))
-            result = self.batcher.submit(ex.group_key(req), req,
-                                         timeout_s=timeout)
+            # the request's plan Step: content-keyed (dedup domain),
+            # retry=False (the batcher owns retry semantics — this
+            # step must propagate Overloaded/Deadline/Poison raw).
+            # A failed leader never poisons its followers: they fall
+            # back to their own submit (plan/executor.py).
+            from ..plan import Step
+
+            out = self._request_executor.run_step(Step(
+                key=ckey if ckey is not None
+                else self._cache_key(kind, req),
+                fn=lambda: self.batcher.submit(
+                    ex.group_key(req), req, timeout_s=timeout),
+                name=f"serve.request.{kind}", retry=False,
+                dedup=True))
+            result = out.value_or_raise()
+            if out.deduped:
+                self.metrics.inc(f"request_deduped_total.{kind}")
             verdict = "success"
-            if ckey is not None:
+            if ckey is not None and not out.deduped:
                 self.cache.put(ckey, result)
         except BadRequest as e:
             return 400, {"error": str(e)}
@@ -258,6 +301,7 @@ class ServeApp:
     def metrics_snapshot(self) -> dict:
         return self.metrics.snapshot(
             queue_depth=self.batcher.queue_depth(),
+            queue_age_s=self.batcher.queue_age_s(),
             cache_stats=self.cache.stats() if self.cache else None,
             slo=self.metrics.slo_snapshot(
                 p99_target_s=self.slo_p99_target_s,
@@ -279,6 +323,8 @@ class ServeApp:
             time.time() - self.metrics.started, 1)
         snap["gauges"]["serve.queue_depth"] = \
             self.batcher.queue_depth()
+        snap["gauges"]["serve.queue_age_s"] = round(
+            self.batcher.queue_age_s(), 4)
         if self.cache:
             for k, v in self.cache.stats().items():
                 if isinstance(v, (int, float)) \

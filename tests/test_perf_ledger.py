@@ -416,3 +416,40 @@ def test_pairhmm_forward_entry_ingests(tmp_path):
     back = [r for r in ledger.read_ledger(lp)
             if r["entry"] == "pairhmm_forward"]
     assert len(back) == 2
+
+
+def test_fleet_throughput_entry_ingests(tmp_path):
+    """The fleet bench entry (fleet_throughput: router + 2 workers vs
+    the single daemon) lands in the ledger with its nested req/s and
+    latency leaves flattened to dotted metrics, so `perf check` can
+    trend and gate both topologies."""
+    entry = {
+        "platform": "cpu", "clients": 4, "requests_per_phase": 16,
+        "workers": 2, "ref_bp": 200_000,
+        "single": {"req_per_sec": 4.6,
+                   "latency_s": {"p50": 0.76, "p99": 1.09,
+                                 "count": 16, "max": 1.09}},
+        "fleet": {"req_per_sec": 4.2,
+                  "latency_s": {"p50": 0.81, "p99": 1.2,
+                                "count": 16, "max": 1.2},
+                  "affinity_hits": 17, "retries": 0},
+        "router_overhead_frac": 0.087,
+        "note": "in-process router + 2 workers vs single daemon",
+    }
+    recs = ledger.live_run_records({"fleet_throughput": entry}, None)
+    by_entry = {r["entry"]: r for r in recs}
+    rec = by_entry["fleet_throughput"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("single.req_per_sec", "fleet.req_per_sec",
+                "single.latency_s.p99", "fleet.latency_s.p99",
+                "router_overhead_frac", "fleet.affinity_hits"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["fleet.req_per_sec"] == pytest.approx(4.2)
+    # round-trips through the on-disk ledger (what perf check reads)
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "fleet_throughput"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["router_overhead_frac"] \
+        == pytest.approx(0.087)

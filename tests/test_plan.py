@@ -6,6 +6,7 @@ prefetched vs serve outputs at every --prefetch-depth)."""
 import io
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -273,3 +274,139 @@ def test_cli_prefetched_and_serve_byte_identical(tmp_path,
         r = ServeClient(url, timeout_s=120).cohortdepth(
             bams, fai=fa + ".fai", window=200)
     assert r["matrix_tsv"] == cold
+
+
+# ---------------- cross-request step dedup ----------------
+
+
+def test_dedup_concurrent_same_key_shares_one_execution():
+    """Two concurrent Steps with the same content key: one leader
+    computes, the follower waits and reuses the value — one
+    execution, counted in plan.steps_deduped_total."""
+    from goleft_tpu.obs import get_registry
+    from goleft_tpu.plan.executor import InflightSteps
+
+    table = InflightSteps()
+    ex = Executor(inflight=table)
+    runs = []
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow():
+        runs.append(1)
+        started.set()
+        release.wait(timeout=10)
+        return "value"
+
+    before = get_registry().counter(
+        "plan.steps_deduped_total").value
+    outs = [None, None]
+
+    def leader():
+        outs[0] = ex.run_step(Step(key=("k",), fn=slow, dedup=True))
+
+    def follower():
+        started.wait(timeout=10)
+        outs[1] = ex.run_step(Step(key=("k",), fn=slow, dedup=True))
+
+    t0, t1 = (threading.Thread(target=leader),
+              threading.Thread(target=follower))
+    t0.start()
+    t1.start()
+    started.wait(timeout=10)
+    time.sleep(0.2)  # follower is now parked on the leader's entry
+    release.set()
+    t0.join(timeout=10)
+    t1.join(timeout=10)
+    assert runs == [1]  # ONE execution
+    assert outs[0].value == "value" and outs[1].value == "value"
+    assert {outs[0].deduped, outs[1].deduped} == {False, True}
+    assert get_registry().counter(
+        "plan.steps_deduped_total").value == before + 1
+    assert table.depth() == 0  # entry settled and removed
+
+
+def test_dedup_failures_are_not_shared():
+    """A follower whose leader failed computes independently — dedup
+    must never amplify a failure across requests."""
+    from goleft_tpu.plan.executor import InflightSteps
+
+    table = InflightSteps()
+    ex = Executor(inflight=table)
+    started = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            started.set()
+            release.wait(timeout=10)
+            raise ValueError("leader dies")
+        return "recovered"
+
+    outs = [None, None]
+    errs = [None, None]
+
+    def leader():
+        try:
+            outs[0] = ex.run_step(
+                Step(key=("k",), fn=flaky, dedup=True, retry=False))
+        except ValueError as e:
+            errs[0] = e
+
+    def follower():
+        started.wait(timeout=10)
+        outs[1] = ex.run_step(
+            Step(key=("k",), fn=flaky, dedup=True, retry=False))
+
+    t0, t1 = (threading.Thread(target=leader),
+              threading.Thread(target=follower))
+    t0.start()
+    t1.start()
+    started.wait(timeout=10)
+    time.sleep(0.2)
+    release.set()
+    t0.join(timeout=10)
+    t1.join(timeout=10)
+    assert isinstance(errs[0], ValueError)  # leader's own failure
+    assert outs[1].value == "recovered"     # follower recomputed
+    assert not outs[1].deduped
+    assert len(calls) == 2
+
+
+def test_dedup_sequential_keys_do_not_alias():
+    """Dedup is in-flight only: a second run AFTER the first finished
+    executes again (the session cache, not this table, handles
+    replay)."""
+    ex = Executor()
+    runs = []
+    step = lambda: Step(key=("seq",), fn=lambda: runs.append(1),
+                        dedup=True)
+    ex.run_step(step())
+    ex.run_step(step())
+    assert len(runs) == 2
+
+
+def test_no_dedup_without_flag():
+    """dedup=False (the default) never consults the table — two
+    concurrent identical keys both execute."""
+    ex = Executor()
+    gate = threading.Event()
+    runs = []
+
+    def body():
+        runs.append(1)
+        gate.wait(timeout=5)
+        return len(runs)
+
+    ts = [threading.Thread(
+        target=lambda: ex.run_step(Step(key=("k",), fn=body)))
+        for _ in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.3)
+    gate.set()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(runs) == 2

@@ -394,13 +394,17 @@ def test_overload_maps_to_429():
     codes = []
     lock = threading.Lock()
 
-    def fire():
-        code, _ = app.handle("depth", {"bam": "x"})
+    # DISTINCT payloads: identical concurrent requests would be
+    # deduped at the request boundary (one pass, no queue slots) —
+    # the overload cliff is about distinct work
+    def fire(i):
+        code, _ = app.handle("depth", {"bam": f"x{i}"})
         with lock:
             codes.append(code)
 
     try:
-        ts = [threading.Thread(target=fire) for _ in range(5)]
+        ts = [threading.Thread(target=fire, args=(i,))
+              for i in range(5)]
         ts[0].start()
         time.sleep(0.25)  # dispatcher takes it → queue empty again
         ts[1].start()
@@ -425,3 +429,61 @@ def test_sigterm_drain_exits_zero():
     from goleft_tpu.serve.smoke import run_smoke
 
     assert run_smoke(timeout_s=120.0, verbose=False) == 0
+
+
+def test_concurrent_identical_requests_dedup_to_one_pass():
+    """Cross-request step dedup (plan/executor.py InflightSteps): two
+    concurrent IDENTICAL requests share one device pass — the
+    follower's response is byte-identical and the dedup counters
+    fire; a third, sequential repeat computes again (in-flight only)."""
+    app = ServeApp(batch_window_s=0.0, max_batch=1)
+    started = threading.Event()
+    release = threading.Event()
+    passes = []
+
+    class StubExec:
+        kind = "depth"
+
+        def validate(self, req):
+            pass
+
+        def group_key(self, req):
+            return ("depth", "stub")
+
+        def cache_files(self, req):
+            return []
+
+        def run(self, reqs):
+            passes.append(list(reqs))
+            started.set()
+            release.wait(timeout=30)
+            return [{"bed": f"bytes-for-{r['bam']}"} for r in reqs]
+
+    app.executors["depth"] = StubExec()
+    out = [None, None]
+
+    def fire(i):
+        out[i] = app.handle("depth", {"bam": "same.bam"})
+
+    try:
+        t0 = threading.Thread(target=fire, args=(0,))
+        t0.start()
+        started.wait(timeout=30)  # leader's pass is now in flight
+        t1 = threading.Thread(target=fire, args=(1,))
+        t1.start()
+        time.sleep(0.3)  # follower parks on the in-flight entry
+        release.set()
+        for t in (t0, t1):
+            t.join(timeout=30)
+        assert out[0] == (200, {"bed": "bytes-for-same.bam"})
+        assert out[1] == out[0]  # byte-identical follower
+        assert len(passes) == 1  # ONE pass for both requests
+        counters = app.metrics.snapshot()["counters"]
+        assert counters["request_deduped_total.depth"] == 1
+        # sequential repeat: the table is in-flight only
+        release.set()
+        code, _ = app.handle("depth", {"bam": "same.bam"})
+        assert code == 200 and len(passes) == 2
+    finally:
+        release.set()
+        app.close()

@@ -77,7 +77,11 @@ def _fire_all(app, reqs):
 def test_poison_request_isolated_in_batch_of_8():
     """Acceptance: a batch of 8 with one permanent failure → seven
     200s identical to a healthy run and one 400, poison counted."""
-    app = ServeApp(batch_window_s=0.4, max_batch=8, watchdog_s=None)
+    # window mode: the test needs all 8 to form ONE batch, which the
+    # fixed window guarantees (continuous mode would dispatch the
+    # first arrival immediately)
+    app = ServeApp(batch_window_s=0.4, max_batch=8, watchdog_s=None,
+                   batch_mode="window")
     stub = app.executors["depth"] = StubExec()
     try:
         reqs = [{"name": f"r{i}"} for i in range(8)]
@@ -103,7 +107,8 @@ def test_poison_request_isolated_in_batch_of_8():
 def test_systemic_batch_failure_stays_500_not_poison():
     """Every request failing is a site problem, not a poison — no
     request should be blamed (400) for a dead device."""
-    app = ServeApp(batch_window_s=0.3, max_batch=4, watchdog_s=None)
+    app = ServeApp(batch_window_s=0.3, max_batch=4, watchdog_s=None,
+                   batch_mode="window")
     app.executors["depth"] = StubExec()
     try:
         codes, bodies = _fire_all(
@@ -125,7 +130,8 @@ def test_corrupt_bam_poisons_alone_real_executor(tmp_path):
     fa, bams = _cohort(tmp_path, n=3)
     with open(bams[1], "r+b") as fh:
         fh.write(b"\x00" * 64)  # trash the BGZF header
-    app = ServeApp(batch_window_s=0.3, max_batch=8, watchdog_s=None)
+    app = ServeApp(batch_window_s=0.3, max_batch=8, watchdog_s=None,
+                   batch_mode="window")
     try:
         solo = {}
         for p in (bams[0], bams[2]):
