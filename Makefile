@@ -106,9 +106,22 @@ plan-lint:
 fleet-smoke:
 	python -m goleft_tpu.fleet.smoke
 
+# the supervisor chaos legs, all real subprocess daemons: a SIGKILL
+# storm is healed to full capacity without operator action; a
+# SIGSTOPped (hung) worker is detected by healthz timeout, SIGKILLed
+# and recycled; a crash-looping slot is quarantined after K deaths
+# (cohortdepth's manifest/exit-3 contract) while the remaining fleet
+# serves byte-identical responses; a deterministic backlog scales the
+# fleet up; a scale-down drain completes in-flight work
+# byte-identically BEFORE the worker exits; and a --shared-cache
+# request replayed after SIGKILL+restart hits the shared tier with
+# zero device passes. Host-pinned like the other smokes.
+fleet-chaos:
+	python -m goleft_tpu.fleet.smoke --chaos
+
 # the check-style aggregate: static gates first (cheap, loud), then
-# the test suite, then the fleet end-to-end proof
-check: lint plan-lint test fleet-smoke
+# the test suite, then the fleet end-to-end proofs
+check: lint plan-lint test fleet-smoke fleet-chaos
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -1,20 +1,38 @@
 """fleet: N serve workers behind the file-affinity router.
 
-Two shapes:
+Three shapes:
 
   - ``goleft-tpu fleet --workers N [...]``: spawn N ``goleft-tpu
-    serve`` subprocesses on ephemeral ports (scraping their listen
-    lines), then run the router in front of them. SIGTERM drains the
-    router first, then the workers.
+    serve`` subprocesses on ephemeral ports and run the router in
+    front of them, SUPERVISED (fleet/supervisor.py): dead workers are
+    restarted with backoff, hung workers (healthz timeout) are
+    SIGKILLed and recycled, crash-looping slots are quarantined (the
+    fleet completes degraded and exits 3, cohortdepth's quarantine
+    contract), and with ``--min-workers``/``--max-workers`` +
+    ``--target-queue-age-s`` the fleet scales elastically against the
+    router's queue-age signal.
+  - ``goleft-tpu fleet --workers N --no-supervise``: spawn-and-front
+    only — the pre-supervisor behavior (a dead worker stays dead).
   - ``goleft-tpu fleet --worker URL --worker URL [...]``: front
-    already-running daemons (workers you manage yourself — other
-    hosts, containers, a mixed fleet).
+    already-running daemons you manage yourself (other hosts,
+    containers). No supervision: the fleet cannot restart processes
+    it does not own.
+
+``--shared-cache DIR`` gives every SPAWNED worker the same
+content-keyed ResultCache directory (``--cache DIR --cache-shared``),
+so a restarted or rescheduled worker replays previously computed
+responses instead of recomputing them.
 
 Lifecycle mirrors the serve daemon: one ``listening on http://...``
 line on stdout once the router socket is bound (plus one ``worker N
 at URL`` line per spawned worker), then block until SIGTERM/SIGINT.
-The router process never imports jax — it stays a cheap, boring
-forwarder no matter what the workers are chewing on.
+If any worker slot was quarantined, the exit code is 3 and
+``--quarantine-manifest`` (when given) receives the same JSON
+manifest shape cohortdepth writes for quarantined samples. If worker
+i of N fails to START, every already-spawned child is killed before
+the command exits nonzero — no orphan daemons. The router process
+never imports jax — it stays a cheap, boring forwarder no matter what
+the workers are chewing on.
 """
 
 from __future__ import annotations
@@ -30,23 +48,28 @@ import threading
 
 def _spawn_worker(extra_args: list[str], env: dict):
     """One serve child on an ephemeral port; returns (proc, url)."""
+    from ..fleet.supervisor import WorkerSpawnError, read_announce
+
     child = subprocess.Popen(
         [sys.executable, "-m", "goleft_tpu", "serve", "--port", "0",
          *extra_args],
         stdout=subprocess.PIPE, text=True, env=env)
-    line = child.stdout.readline()
-    if "listening on " not in line:
+    url = read_announce(child, timeout_s=120.0)
+    if url is None:
         child.kill()
-        raise RuntimeError(
-            f"worker did not announce its port: {line!r}")
-    return child, line.rsplit("listening on ", 1)[1].strip()
+        child.wait(timeout=10)
+        if child.stdout is not None:
+            child.stdout.close()
+        raise WorkerSpawnError("worker did not announce its port")
+    return child, url
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "goleft-tpu fleet",
         description="multi-worker serve fleet behind a file-affinity "
-                    "router with admission control",
+                    "router with admission control, supervision and "
+                    "elastic scaling",
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8090,
@@ -54,11 +77,12 @@ def main(argv=None) -> int:
     g = p.add_mutually_exclusive_group()
     g.add_argument("--workers", type=int, default=0,
                    help="spawn this many goleft-tpu serve workers on "
-                        "ephemeral ports")
+                        "ephemeral ports (supervised unless "
+                        "--no-supervise)")
     g.add_argument("--worker", action="append", default=[],
                    metavar="URL",
                    help="front an already-running serve daemon "
-                        "(repeatable)")
+                        "(repeatable; unsupervised)")
     p.add_argument("--worker-args", default="",
                    help="extra flags passed through to each SPAWNED "
                         "worker (one shell-quoted string, e.g. "
@@ -95,24 +119,125 @@ def main(argv=None) -> int:
                         "follow redirects; serve/client.py does)")
     p.add_argument("--vnodes", type=int, default=64,
                    help="virtual nodes per worker on the hash ring")
+    sup = p.add_argument_group(
+        "supervision + elastic scaling (spawn mode only)")
+    sup.add_argument("--no-supervise", action="store_true",
+                     help="spawn workers without lifecycle "
+                          "management (a dead worker stays dead)")
+    sup.add_argument("--min-workers", type=int, default=0,
+                     help="autoscaler floor (default: --workers)")
+    sup.add_argument("--max-workers", type=int, default=0,
+                     help="autoscaler ceiling (default: --workers)")
+    sup.add_argument("--target-queue-age-s", type=float, default=0.0,
+                     help="scale up while the router's queue age "
+                          "exceeds this; scale down when idle "
+                          "(0 disables the autoscaler)")
+    sup.add_argument("--scale-cooldown-s", type=float, default=30.0,
+                     help="quiet period after any scale event")
+    sup.add_argument("--scale-down-idle-ticks", type=int, default=5,
+                     help="consecutive idle supervision ticks before "
+                          "a scale-down (hysteresis)")
+    sup.add_argument("--supervise-interval-s", type=float,
+                     default=1.0,
+                     help="supervision tick cadence (liveness + hang "
+                          "checks, autoscale evaluation)")
+    sup.add_argument("--hang-timeout-s", type=float, default=5.0,
+                     help="per-probe healthz budget; a worker "
+                          "answering nothing is presumed hung")
+    sup.add_argument("--hang-after", type=int, default=2,
+                     help="consecutive healthz timeouts before a "
+                          "worker is SIGKILLed and recycled")
+    sup.add_argument("--restart-limit", type=int, default=5,
+                     help="deaths inside --crash-window-s before a "
+                          "slot is quarantined (fleet runs degraded, "
+                          "exit 3)")
+    sup.add_argument("--crash-window-s", type=float, default=300.0,
+                     help="the crash-loop detection window")
+    sup.add_argument("--drain-timeout-s", type=float, default=30.0,
+                     help="scale-down: how long to wait for a "
+                          "draining worker's in-flight forwards")
+    sup.add_argument("--spawn-timeout-s", type=float, default=120.0,
+                     help="how long a spawned worker may take to "
+                          "announce its URL")
+    sup.add_argument("--shared-cache", default=None, metavar="DIR",
+                     help="content-keyed ResultCache directory "
+                          "shared by ALL spawned workers (passes "
+                          "--cache DIR --cache-shared through): "
+                          "restarts and ring resizes replay instead "
+                          "of recompute")
+    sup.add_argument("--quarantine-manifest", default=None,
+                     metavar="PATH",
+                     help="write the slot-quarantine JSON manifest "
+                          "here on exit (same shape as cohortdepth's "
+                          "sample quarantine)")
     a = p.parse_args(argv)
 
     if a.workers <= 0 and not a.worker:
         p.error("need --workers N or at least one --worker URL")
 
     from ..fleet.router import RouterApp, make_router_server
+    from ..obs.metrics import MetricsRegistry
 
+    registry = MetricsRegistry()
     children: list = []
+    supervisor = None
     urls = [u for u in a.worker]
-    if a.workers > 0:
-        worker_extra = shlex.split(a.worker_args)
-        env = dict(os.environ)
-        for i in range(a.workers):
-            child, url = _spawn_worker(worker_extra, env)
-            children.append(child)
-            urls.append(url)
+    worker_extra = shlex.split(a.worker_args)
+    env = dict(os.environ)
+    if a.workers > 0 and not a.no_supervise:
+        from ..fleet.supervisor import Supervisor, WorkerSpawnError
+
+        min_w = a.min_workers or a.workers
+        max_w = a.max_workers or max(a.workers, min_w)
+        supervisor = Supervisor(
+            worker_args=worker_extra, env=env,
+            min_workers=min_w, max_workers=max_w,
+            registry=registry,
+            interval_s=a.supervise_interval_s,
+            hang_timeout_s=a.hang_timeout_s,
+            hang_after=a.hang_after,
+            crash_limit=a.restart_limit,
+            crash_window_s=a.crash_window_s,
+            target_queue_age_s=a.target_queue_age_s,
+            scale_cooldown_s=a.scale_cooldown_s,
+            scale_down_idle_ticks=a.scale_down_idle_ticks,
+            drain_timeout_s=a.drain_timeout_s,
+            spawn_timeout_s=a.spawn_timeout_s,
+            shared_cache=a.shared_cache)
+        try:
+            urls = supervisor.spawn_initial(a.workers)
+        except WorkerSpawnError as e:
+            print(f"goleft-tpu fleet: {e} (already-spawned workers "
+                  "killed)", file=sys.stderr, flush=True)
+            return 1
+        for i, url in enumerate(urls):
             print(f"goleft-tpu fleet: worker {i} at {url}",
                   file=sys.stderr, flush=True)
+    elif a.workers > 0:
+        extra = list(worker_extra)
+        if a.shared_cache:
+            os.makedirs(a.shared_cache, exist_ok=True)
+            extra += ["--cache", a.shared_cache, "--cache-shared"]
+        try:
+            for i in range(a.workers):
+                child, url = _spawn_worker(extra, env)
+                children.append(child)
+                urls.append(url)
+                print(f"goleft-tpu fleet: worker {i} at {url}",
+                      file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — startup failure:
+            # kill whatever did spawn; a failed `fleet` start must
+            # not leave orphan serve daemons running
+            for child in children:
+                if child.poll() is None:
+                    child.kill()
+                child.wait(timeout=10)
+                if child.stdout is not None:
+                    child.stdout.close()
+            print(f"goleft-tpu fleet: worker spawn failed ({e}); "
+                  f"killed {len(children)} already-spawned "
+                  "worker(s)", file=sys.stderr, flush=True)
+            return 1
 
     app = RouterApp(urls, quotas=a.quota,
                     max_inflight=a.max_inflight,
@@ -122,8 +247,13 @@ def main(argv=None) -> int:
                     down_after=a.down_after,
                     shed_below=a.shed_below,
                     redirect=a.redirect,
-                    vnodes=a.vnodes)
+                    vnodes=a.vnodes,
+                    registry=registry)
+    if supervisor is not None:
+        supervisor.bind(app)
     app.start()
+    if supervisor is not None:
+        supervisor.start()
     httpd = make_router_server(app, a.host, a.port)
     host, port = httpd.server_address[:2]
     print(f"goleft-tpu fleet: listening on http://{host}:{port}",
@@ -143,6 +273,17 @@ def main(argv=None) -> int:
     httpd.server_close()
     app.close()
     rc = 0
+    if supervisor is not None:
+        supervisor.close()
+        if supervisor.quarantine:
+            if a.quarantine_manifest:
+                supervisor.quarantine.write(a.quarantine_manifest)
+                print("goleft-tpu fleet: quarantine manifest at "
+                      f"{a.quarantine_manifest}", file=sys.stderr,
+                      flush=True)
+            print(supervisor.quarantine.exit_summary(),
+                  file=sys.stderr, flush=True)
+            rc = 3
     for child in children:
         if child.poll() is None:
             child.send_signal(signal.SIGTERM)
@@ -151,7 +292,7 @@ def main(argv=None) -> int:
             child.wait(timeout=30)
         except subprocess.TimeoutExpired:
             child.kill()
-            rc = 1
+            rc = rc or 1
         if child.stdout is not None:
             child.stdout.close()
     print("goleft-tpu fleet: drained, bye", file=sys.stderr,

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
     p.add_argument("--cache-max-bytes", type=int,
                    default=256 * 1024 * 1024,
                    help="session cache bound (mtime-LRU eviction)")
+    p.add_argument("--cache-shared", action="store_true",
+                   help="mark --cache as a fleet-shared tier (safe: "
+                        "keys are content identity, writes are "
+                        "atomic); reported via /healthz and the "
+                        "serve.cache.shared gauge")
     p.add_argument("-p", "--processes", type=int, default=4,
                    help="decode threads per batch")
     p.add_argument("--no-warmup", action="store_true",
@@ -123,7 +128,8 @@ def main(argv=None) -> int:
                    breaker_threshold=a.breaker_threshold,
                    breaker_cooldown_s=a.breaker_cooldown_s,
                    checkpoint_root=a.checkpoint_root,
-                   batch_mode=a.batch_mode)
+                   batch_mode=a.batch_mode,
+                   cache_shared=a.cache_shared)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)

@@ -16,11 +16,22 @@ fleet layer scales it horizontally without touching the workers:
     the workers' 429 cliff — per-tenant token-bucket quotas (429 +
     ``retry_after_s`` on exhaustion) and deadline-aware, starvation-free
     priority/fairness scheduling of the forwarding slots.
+  - :mod:`~goleft_tpu.fleet.supervisor`: the self-healing layer — it
+    OWNS the serve subprocesses: worker death and hangs are detected
+    and restarted with the resilience backoff, crash-looping slots
+    are quarantined (cohortdepth's manifest/exit-3 contract), the
+    fleet scales elastically between ``--min-workers`` and
+    ``--max-workers`` against the router's queue-age signal, and
+    ``--shared-cache`` puts one content-keyed ResultCache tier behind
+    every worker so restarts and ring resizes replay instead of
+    recompute.
   - :mod:`~goleft_tpu.fleet.smoke`: the ``make fleet-smoke`` body —
     real subprocess daemons proving byte identity (continuous vs
     window batching vs the one-shot CLIs), cross-request step dedup,
     router-level retry across a SIGKILLed worker, and per-tenant quota
-    isolation.
+    isolation — plus the ``make fleet-chaos`` supervisor legs
+    (SIGKILL storm, SIGSTOP hang, crash-loop quarantine, elastic
+    scale up/down, shared-cache replay across a restart).
 
 ``goleft-tpu fleet`` (commands/fleet.py) spawns the workers and runs
 the router; see docs/fleet.md.
@@ -31,3 +42,6 @@ from .admission import (  # noqa: F401
     TokenBucket,
 )
 from .router import HashRing, RouterApp, WorkerPool  # noqa: F401
+from .supervisor import (  # noqa: F401
+    Supervisor, WorkerSlot, WorkerSpawnError,
+)

@@ -95,12 +95,25 @@ class HashRing:
     the deterministic failover order. Adding/removing a node moves
     only ~1/N of the keyspace (the property that keeps worker caches
     warm across fleet resizes).
+
+    Membership changes are **copy-on-write**: ``with_node`` /
+    ``without_node`` return a NEW ring sharing nothing mutable, so the
+    router can swap its ring reference atomically while handler
+    threads keep walking the old one — no lock on the request path,
+    and a key's candidate order over the surviving nodes is provably
+    identical before and after a resize (each node contributes its own
+    hash points and nothing else; removing a node deletes exactly its
+    points). Point positions depend only on (node name, vnode index)
+    through sha256, so every process that builds a ring from the same
+    membership computes the same plan — the cross-process determinism
+    the smoke tests and the supervisor both lean on.
     """
 
     def __init__(self, nodes: list[str], vnodes: int = 64):
         if not nodes:
             raise ValueError("HashRing needs at least one node")
         self.nodes = list(nodes)
+        self.vnodes = vnodes
         self._points: list[tuple[int, str]] = sorted(
             (self._hash(f"{node}#{i}"), node)
             for node in nodes for i in range(vnodes))
@@ -123,6 +136,41 @@ class HashRing:
                     break
         return seen
 
+    # ---- dynamic membership (copy-on-write) ----
+
+    def with_node(self, node: str) -> "HashRing":
+        """A new ring with ``node`` added (idempotent)."""
+        if node in self.nodes:
+            return self
+        return HashRing(self.nodes + [node], vnodes=self.vnodes)
+
+    def without_node(self, node: str) -> "HashRing":
+        """A new ring with ``node`` removed. Removing the LAST node
+        returns the ring unchanged: an empty ring cannot answer
+        ``candidates`` at all, and a fleet that lost every worker
+        still wants a deterministic plan for when one returns — the
+        pool's eligibility filter (not the ring) is what actually
+        stops traffic."""
+        if node not in self.nodes or len(self.nodes) == 1:
+            return self
+        return HashRing([n for n in self.nodes if n != node],
+                        vnodes=self.vnodes)
+
+    def ownership(self) -> dict:
+        """{node: fraction of the hash space it owns}. The supervisor
+        uses this to pick the LEAST-AFFINE scale-down victim: removing
+        the smallest owner remaps the fewest keys (and therefore
+        invalidates the least private-cache locality)."""
+        span = 2.0 ** 64
+        owned = {n: 0.0 for n in self.nodes}
+        pts = self._points
+        for i, (pos, _node) in enumerate(pts):
+            # the arc (previous point, this point] belongs to the node
+            # AT this point (bisect_right walks clockwise to it)
+            prev = pts[i - 1][0] if i else pts[-1][0] - span
+            owned[pts[i][1]] += (pos - prev) / span
+        return owned
+
 
 class _Worker:
     """Mutable polled state for one worker (lock: the pool's)."""
@@ -131,6 +179,10 @@ class _Worker:
         self.url = url.rstrip("/")
         self.healthy = True      # optimistic until a poll says otherwise
         self.draining = False
+        self.admin_draining = False  # supervisor-imposed (scale-down):
+        # the poller must NOT clear it — it reflects an operator/
+        # supervisor decision, not the worker's self-reported state
+        self.inflight = 0        # forwards currently inside _forward
         self.consecutive_fails = 0
         self.open_breakers: frozenset[str] = frozenset()
         self.availability: float | None = None
@@ -214,6 +266,57 @@ class WorkerPool:
         while not self._stop.wait(self.poll_interval_s):
             self.poll_all()
 
+    # ---- dynamic membership (the supervisor's levers) ----
+
+    def add(self, url: str) -> None:
+        """Admit a new worker (idempotent). It enters optimistic (the
+        supervisor only adds a worker that already announced its URL);
+        the next poll replaces optimism with evidence."""
+        url = url.rstrip("/")
+        with self._lock:
+            if url not in self.workers:
+                self.workers[url] = _Worker(url)
+
+    def remove(self, url: str) -> None:
+        """Forget a worker entirely (idempotent) — after its process
+        exited or its drain completed. In-flight forwards to it (if
+        any) finish on their own; end_forward tolerates the missing
+        entry."""
+        with self._lock:
+            self.workers.pop(url.rstrip("/"), None)
+
+    def set_draining(self, url: str, draining: bool = True) -> None:
+        """Administratively drain a worker: it stops receiving NEW
+        traffic (``eligible`` excludes it) while in-flight forwards
+        run to completion — the scale-down half of drain-before-
+        removal."""
+        w = self.workers.get(url.rstrip("/"))
+        if w is None:
+            return
+        with self._lock:
+            w.admin_draining = draining
+
+    def begin_forward(self, url: str) -> None:
+        w = self.workers.get(url.rstrip("/"))
+        if w is None:
+            return
+        with self._lock:
+            w.inflight += 1
+
+    def end_forward(self, url: str) -> None:
+        w = self.workers.get(url.rstrip("/"))
+        if w is None:
+            return
+        with self._lock:
+            w.inflight = max(0, w.inflight - 1)
+
+    def inflight(self, url: str) -> int:
+        w = self.workers.get(url.rstrip("/"))
+        if w is None:
+            return 0
+        with self._lock:
+            return w.inflight
+
     # ---- routing state ----
 
     def mark_failed(self, url: str) -> None:
@@ -234,11 +337,13 @@ class WorkerPool:
 
     def eligible(self, kind: str) -> set[str]:
         """Workers that may serve ``kind`` right now: healthy, not
-        draining, and without an open breaker for that endpoint."""
+        draining (self-reported or supervisor-imposed), and without an
+        open breaker for that endpoint."""
         with self._lock:
             return {
                 u for u, w in self.workers.items()
                 if w.healthy and not w.draining
+                and not w.admin_draining
                 and kind not in w.open_breakers
             }
 
@@ -256,6 +361,8 @@ class WorkerPool:
                 u: {
                     "healthy": w.healthy,
                     "draining": w.draining,
+                    "admin_draining": w.admin_draining,
+                    "inflight": w.inflight,
                     "consecutive_fails": w.consecutive_fails,
                     "open_breakers": sorted(w.open_breakers),
                     "availability": w.availability,
@@ -293,6 +400,8 @@ class RouterApp:
         self.shed_below = shed_below
         self.redirect = redirect
         self.started = time.time()
+        # set by Supervisor.bind(); the router itself never calls it
+        self.supervisor = None
 
     def start(self) -> "RouterApp":
         self.pool.start()
@@ -300,6 +409,38 @@ class RouterApp:
 
     def close(self) -> None:
         self.pool.close()
+
+    # ---- dynamic membership ----
+    #
+    # Ring updates are copy-on-write reference swaps (atomic in
+    # CPython), pool updates take the pool's lock — handler threads
+    # racing a resize see either the old membership or the new one,
+    # both internally consistent. A worker present in the ring but
+    # absent from eligibility is harmless (it lands in the plan's
+    # ineligible tail); the reverse (eligible but not in the ring) is
+    # avoided by ordering: add ring-first, remove pool-visibility-first.
+
+    def add_worker(self, url: str) -> None:
+        url = url.rstrip("/")
+        self.pool.add(url)
+        ring = self.ring.with_node(url)
+        # prune ghosts: when the LAST worker died, its node stayed on
+        # the ring (an empty ring cannot plan) — drop any node the
+        # pool no longer knows now that the ring is non-trivial again
+        for node in ring.nodes:
+            if node != url and node not in self.pool.workers:
+                ring = ring.without_node(node)
+        self.ring = ring
+
+    def remove_worker(self, url: str) -> None:
+        url = url.rstrip("/")
+        self.pool.remove(url)
+        self.ring = self.ring.without_node(url)
+
+    def drain_worker(self, url: str) -> None:
+        """Stop routing NEW traffic to ``url``; in-flight forwards
+        finish (``pool.inflight(url)`` reaches 0 when they have)."""
+        self.pool.set_draining(url, True)
 
     # ---- routing ----
 
@@ -420,6 +561,7 @@ class RouterApp:
             if i > 0:
                 self.registry.counter("fleet.retries_total").inc()
             wk = url.rsplit(":", 1)[-1]  # port: the stable short label
+            self.pool.begin_forward(url)
             try:
                 status, payload = self._forward(url, kind, body,
                                                 timeout_s)
@@ -432,6 +574,8 @@ class RouterApp:
                 last_err = {"error": f"worker {url} unreachable: "
                                      f"{e!r}"}
                 continue
+            finally:
+                self.pool.end_forward(url)
             if status == 503:
                 # the worker is shedding (breaker open / draining):
                 # re-route reactively instead of bouncing the client —
@@ -458,11 +602,18 @@ class RouterApp:
     def healthz(self) -> tuple[int, dict]:
         snap = self.pool.snapshot()
         n_up = sum(1 for w in snap.values() if w["healthy"])
-        return (200 if n_up else 503), {
+        body = {
             "status": "ok" if n_up else "degraded",
             "workers": len(snap), "healthy": n_up,
             "uptime_s": round(time.time() - self.started, 1),
         }
+        if self.supervisor is not None:
+            body["capacity"] = self.supervisor.capacity
+            body["quarantined_slots"] = \
+                self.supervisor.quarantined_slots
+            if body["quarantined_slots"]:
+                body["status"] = "degraded" if n_up else body["status"]
+        return (200 if n_up else 503), body
 
     def metrics_snapshot(self) -> dict:
         g = self.registry.gauge
@@ -474,12 +625,15 @@ class RouterApp:
         if avail is not None:
             g("fleet.availability").set(round(avail, 6))
         snap = self.registry.snapshot()
-        return {
+        out = {
             "counters": snap["counters"],
             "gauges": snap["gauges"],
             "histograms": snap.get("histograms", {}),
             "workers": self.pool.snapshot(),
         }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.snapshot()
+        return out
 
 
 class _RouterHandler(BaseHTTPRequestHandler):

@@ -27,9 +27,32 @@ Real subprocess daemons all the way down (the acceptance contract):
      through; a retry-aware client (serve/client.py ``retries=1``)
      honors the hint and lands the follow-up 200.
 
+``run_chaos`` (the ``make fleet-chaos`` body) adds the SUPERVISOR
+legs, still against real subprocess daemons:
+
+  6. **SIGKILL storm**: every worker killed -9; the supervisor
+     restores full capacity without operator action and the next
+     routed response is byte-identical to the one-shot CLI.
+  7. **SIGSTOP hang**: a stopped worker answers no ``/healthz``; the
+     supervisor SIGKILLs and recycles it (``fleet.hangs_total``).
+  8. **crash-loop quarantine**: a slot dying ``crash_limit`` times
+     inside the window is PARKED; the remaining fleet keeps serving
+     byte-identical responses (cohortdepth's quarantine contract).
+  9. **elastic scale-up**: a deterministic backlog (injected device
+     hangs + ``max_inflight=1``) ages the router queue past target;
+     the autoscaler spawns a second worker.
+ 10. **scale-down drain**: the least-affine worker is drained while a
+     request is in flight ON it — the response lands byte-identical
+     (zero in-flight loss), THEN the worker exits.
+ 11. **shared cache tier**: with ``--shared-cache``, a request
+     replayed after its worker was SIGKILLed and restarted is served
+     from the shared ResultCache — the restarted worker performs ZERO
+     device passes — byte-identical to the original response.
+
 Run directly::
 
-    python -m goleft_tpu.fleet.smoke
+    python -m goleft_tpu.fleet.smoke           # legs 1-5
+    python -m goleft_tpu.fleet.smoke --chaos   # legs 6-11
 """
 
 from __future__ import annotations
@@ -396,6 +419,369 @@ def _leg_breaker_shed_and_quota(d, bams, fai, windows, env, verbose):
             _stop_daemon(w)
 
 
+# ---------------- supervisor chaos legs (make fleet-chaos) ----------
+
+
+def _wait_until(pred, timeout_s: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise RuntimeError(f"fleet-chaos: timed out after {timeout_s:g}s "
+                       f"waiting for {what}")
+
+
+class _SupervisedFleet:
+    """Supervisor + router in-process, workers as REAL ``goleft-tpu
+    serve`` subprocess daemons (the acceptance contract)."""
+
+    def __init__(self, n: int, env: dict, worker_args=("--no-warmup",),
+                 shared_cache: str | None = None,
+                 sup_kwargs: dict | None = None,
+                 router_kwargs: dict | None = None):
+        from ..fleet.router import RouterApp, RouterThread
+        from ..fleet.supervisor import Supervisor
+        from ..obs.metrics import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.sup = Supervisor(
+            worker_args=list(worker_args), env=env,
+            registry=self.registry, shared_cache=shared_cache,
+            interval_s=0.25, hang_timeout_s=1.0, hang_after=2,
+            spawn_timeout_s=120.0, drain_timeout_s=60.0,
+            **(sup_kwargs or {}))
+        urls = self.sup.spawn_initial(n)
+        self.app = RouterApp(urls, poll_interval_s=0.3, down_after=1,
+                             registry=self.registry,
+                             **(router_kwargs or {}))
+        self.sup.bind(self.app)
+        self._rt = RouterThread(self.app)
+
+    def __enter__(self) -> str:
+        url = self._rt.__enter__()
+        self.sup.start()
+        return url
+
+    def __exit__(self, *exc):
+        # supervisor first: it must stop restarting workers before
+        # close() SIGTERMs them; the router shuts down after
+        self.sup.close()
+        return self._rt.__exit__(*exc)
+
+    def counter(self, name: str) -> float:
+        snap = self.registry.snapshot()
+        return snap["counters"].get(name, 0)
+
+
+def _chaos_lifecycle_legs(d, bams, fai, env, verbose):
+    """Legs 6-8 on ONE supervised 2-worker fleet: SIGKILL storm,
+    SIGSTOP hang recycle, crash-loop quarantine. Death budget per
+    slot across the legs: storm costs each slot 1, the hang costs
+    slot B 1 more, then two kills push slot A to crash_limit=3."""
+    from ..commands.depth import run_depth
+    from ..serve.client import ServeClient
+
+    dp, _ = run_depth(bams[0], os.path.join(d, "ref-chaos"),
+                      fai=fai, window=190)
+    with open(dp) as fh:
+        ref_bed = fh.read()
+
+    fleet = _SupervisedFleet(
+        2, env,
+        sup_kwargs={"min_workers": 2, "max_workers": 2,
+                    "crash_limit": 3, "crash_window_s": 600.0})
+    with fleet as url:
+        client = ServeClient(url, timeout_s=120.0, retries=3,
+                             retry_cap_s=2.0)
+        r = client.depth(bams[0], fai=fai, window=190)
+        if r["depth_bed"] != ref_bed:
+            raise RuntimeError("pre-chaos response != CLI bytes")
+
+        # ---- leg 6: SIGKILL storm — every worker dies at once ----
+        slots = fleet.sup.slots()
+        pids = {s.index: s.proc.pid for s in slots}
+        for s in slots:
+            s.proc.kill()
+            s.proc.wait(timeout=10)
+        # wait on the restart COUNTER, not capacity: capacity only
+        # dips once the supervisor notices the deaths, so a
+        # capacity==2 wait could pass before anything happened
+        _wait_until(
+            lambda: fleet.counter("fleet.restarts_total") >= 2
+            and fleet.sup.capacity == 2, 180.0,
+            "capacity restored after SIGKILL storm")
+        for s in fleet.sup.slots():
+            if s.proc.pid == pids.get(s.index):
+                raise RuntimeError("worker not actually respawned")
+        _wait_until(
+            lambda: len(fleet.app.pool.eligible("depth")) == 2,
+            30.0, "router to readmit restarted workers")
+        r = client.depth(bams[0], fai=fai, window=190,
+                         cache_buster="post-storm")
+        if r["depth_bed"] != ref_bed:
+            raise RuntimeError("post-storm response != CLI bytes")
+        if verbose:
+            print("fleet-chaos: SIGKILL storm — supervisor restored "
+                  "full capacity unaided "
+                  f"(restarts_total="
+                  f"{fleet.counter('fleet.restarts_total'):g}), "
+                  "byte-identical 200")
+
+        # ---- leg 7: SIGSTOP hang detected and recycled ----
+        slot_b = fleet.sup.slots()[1]
+        restarts_before = slot_b.restarts
+        hung_pid = slot_b.proc.pid
+        os.kill(hung_pid, signal.SIGSTOP)
+        _wait_until(
+            lambda: slot_b.restarts > restarts_before
+            and slot_b.state == "healthy", 120.0,
+            "hung worker to be recycled")
+        if fleet.counter("fleet.hangs_total") < 1:
+            raise RuntimeError("hang not counted")
+        if slot_b.proc.pid == hung_pid:
+            raise RuntimeError("hung worker was not replaced")
+        r = client.depth(bams[0], fai=fai, window=190,
+                         cache_buster="post-hang")
+        if r["depth_bed"] != ref_bed:
+            raise RuntimeError("post-hang response != CLI bytes")
+        if verbose:
+            print("fleet-chaos: SIGSTOP hang detected via healthz "
+                  "timeout, worker SIGKILLed + recycled "
+                  f"(hangs_total="
+                  f"{fleet.counter('fleet.hangs_total'):g})")
+
+        # ---- leg 8: crash-looper quarantined after K deaths ----
+        slot_a = fleet.sup.slots()[0]
+        deadline = time.monotonic() + 240.0
+        while slot_a.state != "quarantined":
+            if time.monotonic() > deadline:
+                raise RuntimeError("slot never quarantined")
+            if slot_a.state == "healthy" \
+                    and slot_a.proc.poll() is None:
+                slot_a.proc.kill()
+                slot_a.proc.wait(timeout=10)
+            time.sleep(0.1)
+        if fleet.counter("fleet.slot_quarantines") != 1 \
+                or len(fleet.sup.quarantine) != 1:
+            raise RuntimeError("quarantine not recorded")
+        if fleet.sup.capacity != 1:
+            raise RuntimeError(
+                f"want degraded capacity 1, got {fleet.sup.capacity}")
+        # the remaining fleet keeps serving, byte-identically
+        r = client.depth(bams[0], fai=fai, window=190,
+                         cache_buster="post-quarantine")
+        if r["depth_bed"] != ref_bed:
+            raise RuntimeError(
+                "degraded-fleet response != CLI bytes")
+        man = os.path.join(d, "slot_quarantine.json")
+        fleet.sup.quarantine.write(man)
+        with open(man) as fh:
+            entries = json.load(fh)["quarantined"]
+        if len(entries) != 1 \
+                or entries[0]["classification"] != "crash-loop":
+            raise RuntimeError(f"bad quarantine manifest: {entries}")
+        if verbose:
+            print("fleet-chaos: crash-looper quarantined after "
+                  "3 deaths — fleet serves degraded at capacity 1, "
+                  "byte-identical 200s, manifest written")
+
+
+def _chaos_scaling_legs(d, bams, fai, env, verbose):
+    """Legs 9-10: autoscale up under deterministic backlog, then a
+    manual scale-down whose drain completes an in-flight request
+    byte-identically before the worker exits."""
+    import shutil
+
+    from ..commands.depth import run_depth
+    from ..serve.client import ServeClient
+
+    dp, _ = run_depth(bams[1], os.path.join(d, "ref-scale"),
+                      fai=fai, window=185)
+    with open(dp) as fh:
+        ref_bed = fh.read()
+
+    # every worker device pass hangs 1.0s (deterministic service
+    # time); max_inflight=1 serializes forwards so concurrent
+    # requests age in the router queue — the backlog signal
+    wenv = dict(env,
+                GOLEFT_TPU_FAULTS="device:every=1:hang=1.0:times=50")
+    fleet = _SupervisedFleet(
+        1, wenv,
+        sup_kwargs={"min_workers": 1, "max_workers": 2,
+                    "target_queue_age_s": 0.4,
+                    "scale_cooldown_s": 0.5,
+                    # auto scale-down disabled (huge hysteresis): leg
+                    # 10 drives the drain deterministically instead
+                    "scale_down_idle_ticks": 10_000},
+        router_kwargs={"max_inflight": 1})
+    with fleet as url:
+        client = ServeClient(url, timeout_s=300.0)
+
+        # ---- leg 9: synthetic backlog -> autoscaler spawns #2 ----
+        outs: list = []
+        errs: list = []
+
+        def fire(i):
+            try:
+                outs.append(client.depth(
+                    bams[1], fai=fai, window=185,
+                    cache_buster=f"backlog{i}"))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        _wait_until(lambda: fleet.sup.capacity == 2, 180.0,
+                    "autoscaler to add a worker under backlog")
+        if fleet.counter("fleet.scale_up_total") < 1 \
+                or fleet.counter("fleet.scale_events") < 1:
+            raise RuntimeError("scale-up not counted")
+        for t in threads:
+            t.join(timeout=300)
+        if errs:
+            raise RuntimeError(
+                f"requests failed during scale-up: {errs}")
+        if any(o["depth_bed"] != ref_bed for o in outs):
+            raise RuntimeError("scale-up responses != CLI bytes")
+        if verbose:
+            print("fleet-chaos: backlog aged past target; autoscaler "
+                  "scaled 1 -> 2 workers, all responses "
+                  "byte-identical")
+
+        # ---- leg 10: scale-down drains in-flight work first ----
+        victim = fleet.sup.pick_scale_down_victim()
+        # mint a bam homed on the victim (path is part of content
+        # identity: copies re-roll the ring position)
+        probe = None
+        for i in range(32):
+            cand = bams[2] if i == 0 \
+                else os.path.join(d, f"drain{i}.bam")
+            if i > 0:
+                shutil.copy(bams[2], cand)
+                shutil.copy(bams[2] + ".bai", cand + ".bai")
+            if client.route_plan(
+                    "depth", bam=cand)[0] == victim.url:
+                probe = cand
+                break
+        if probe is None:
+            raise RuntimeError("could not mint a bam homed on the "
+                               "scale-down victim")
+        pd, _ = run_depth(probe, os.path.join(d, "ref-drain"),
+                          fai=fai, window=185)
+        with open(pd) as fh:
+            probe_ref = fh.read()
+        box: dict = {}
+
+        def fire_probe():
+            try:
+                box["r"] = client.depth(probe, fai=fai, window=185)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                box["e"] = e
+
+        t = threading.Thread(target=fire_probe)
+        t.start()
+        _wait_until(
+            lambda: fleet.app.pool.inflight(victim.url) > 0, 30.0,
+            "probe request to be in flight on the victim")
+        gone = fleet.sup.scale_down(reason="chaos leg")
+        t.join(timeout=300)
+        if gone != victim.url:
+            raise RuntimeError(
+                f"scale-down retired {gone}, wanted {victim.url} "
+                "(least-affine)")
+        if "e" in box:
+            raise RuntimeError(
+                f"in-flight request lost during drain: {box['e']}")
+        if box["r"]["depth_bed"] != probe_ref:
+            raise RuntimeError(
+                "drained response != CLI bytes")
+        if victim.proc.poll() is None:
+            raise RuntimeError("victim worker still running")
+        if fleet.sup.capacity != 1 \
+                or fleet.counter("fleet.scale_down_total") != 1:
+            raise RuntimeError("scale-down not recorded")
+        if verbose:
+            print("fleet-chaos: scale-down drained the least-affine "
+                  "worker — in-flight request completed "
+                  "byte-identically, THEN the worker exited")
+
+
+def _chaos_shared_cache_leg(d, bams, fai, env, verbose):
+    """Leg 11: shared cache tier — after SIGKILL + restart the replay
+    is a cache hit: ZERO device passes on the restarted worker,
+    byte-identical body."""
+    from ..serve.client import ServeClient
+
+    cache_dir = os.path.join(d, "shared-cache")
+    fleet = _SupervisedFleet(
+        1, env, shared_cache=cache_dir,
+        sup_kwargs={"min_workers": 1, "max_workers": 1,
+                    "crash_limit": 5})
+    with fleet as url:
+        client = ServeClient(url, timeout_s=120.0, retries=3,
+                             retry_cap_s=2.0)
+        slot = fleet.sup.slots()[0]
+        wdirect = ServeClient(slot.url, timeout_s=60.0)
+        if wdirect.healthz().get("cache") != "shared":
+            raise RuntimeError("worker does not report the shared "
+                               "cache tier")
+        first = client.depth(bams[0], fai=fai, window=170)
+        if first.get("cached"):
+            raise RuntimeError("first request unexpectedly cached")
+        restarts_before = slot.restarts
+        slot.proc.kill()
+        slot.proc.wait(timeout=10)
+        _wait_until(lambda: slot.restarts > restarts_before
+                    and slot.state == "healthy", 180.0,
+                    "worker restart after SIGKILL")
+        _wait_until(
+            lambda: len(fleet.app.pool.eligible("depth")) == 1,
+            30.0, "router to readmit the restarted worker")
+        second = client.depth(bams[0], fai=fai, window=170)
+        if not second.get("cached"):
+            raise RuntimeError(
+                "replay after restart was not a shared-cache hit")
+        if second["depth_bed"] != first["depth_bed"] \
+                or second["callable_bed"] != first["callable_bed"]:
+            raise RuntimeError("cache replay not byte-identical")
+        prom = ServeClient(fleet.sup.slots()[0].url,
+                           timeout_s=60.0).metrics_prometheus()
+        if _prom_counter(prom, "serve_device_passes_total") != 0:
+            raise RuntimeError(
+                "restarted worker recomputed on the device despite "
+                "the shared cache")
+        if verbose:
+            print("fleet-chaos: SIGKILL + restart replayed from the "
+                  "shared cache tier (0 device passes on the new "
+                  "worker, byte-identical body)")
+
+
+def run_chaos(timeout_s: float = 900.0, verbose: bool = True) -> int:
+    """The ``make fleet-chaos`` body. Returns 0 on success; raises on
+    any failed leg."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",     # CI has no accelerator
+               GOLEFT_TPU_PROBE="0")    # don't pay a probe timeout
+    env.pop("GOLEFT_TPU_FAULTS", None)  # hermetic
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="goleft_chaos_") as d:
+        bams, fai, _bed = _make_cohort(d, ref_len=20_000)
+        _chaos_lifecycle_legs(d, bams, fai, env, verbose)
+        _chaos_scaling_legs(d, bams, fai, env, verbose)
+        _chaos_shared_cache_leg(d, bams, fai, env, verbose)
+        if time.monotonic() - t0 > timeout_s:
+            raise RuntimeError(
+                f"fleet-chaos exceeded its {timeout_s:g}s budget")
+        if verbose:
+            print(f"fleet-chaos: PASS "
+                  f"({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
 def run_smoke(timeout_s: float = 600.0, verbose: bool = True) -> int:
     """Returns 0 on success; raises on any failed step."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -424,4 +810,6 @@ def run_smoke(timeout_s: float = 600.0, verbose: bool = True) -> int:
 
 
 if __name__ == "__main__":
+    if "--chaos" in sys.argv[1:]:
+        sys.exit(run_chaos())
     sys.exit(run_smoke())

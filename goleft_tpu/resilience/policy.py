@@ -247,12 +247,21 @@ class Quarantine:
         os.replace(tmp, path)
 
     def exit_summary(self) -> str:
-        """The CLI's stderr epilogue for a degraded run."""
+        """The CLI's stderr epilogue for a degraded run. The same
+        contract serves two consumers: cohortdepth's quarantined
+        SAMPLES (phase 'open'/'decode') and the fleet supervisor's
+        quarantined worker SLOTS (phase 'serve' — crash-looping
+        workers parked so the rest of the fleet keeps serving)."""
         entries = self.summary()["quarantined"]
-        lines = [f"resilience: {len(entries)} sample(s) quarantined — "
-                 "cohort completed without them (exit 3)"]
+        what = ("worker slot(s)" if all(e["phase"] == "serve"
+                                        for e in entries)
+                else "sample(s)")
+        lines = [f"resilience: {len(entries)} {what} quarantined — "
+                 "run completed degraded without them (exit 3)"]
         for e in entries:
             effect = ("column dropped" if e["phase"] == "open"
+                      else "slot parked; fleet capacity reduced"
+                      if e["phase"] == "serve"
                       else "remaining shards zero-filled")
             lines.append(
                 f"  {e['sample']} ({e['source']}): {e['error']} "

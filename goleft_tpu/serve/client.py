@@ -14,10 +14,13 @@ Routing-aware behavior (what the fleet layer leans on):
     once per hop, re-POSTing the same body. urllib alone refuses to
     follow redirected POSTs; this client implements them explicitly.
   - **retry_after honor** (``retries > 0``): a 429 (quota) or 503
-    (breaker open, worker draining, fleet shedding) carrying
-    ``retry_after_s`` is retried after sleeping that hint (never more
-    than ``retry_cap_s``), up to ``retries`` times. Responses without
-    the hint fail immediately — the server didn't promise recovery.
+    (breaker open, worker draining during a restart/resize window,
+    fleet shedding) carrying ``retry_after_s`` is retried after
+    sleeping that hint (never more than ``retry_cap_s``), up to
+    ``retries`` times AND within ``retry_budget_s`` total wall clock
+    — the budget bounds the worst case where every attempt lands in
+    a long drain window re-hinting "soon". Responses without the
+    hint fail immediately — the server didn't promise recovery.
 """
 
 from __future__ import annotations
@@ -44,11 +47,15 @@ class ServeError(RuntimeError):
 class ServeClient:
     def __init__(self, base_url: str, timeout_s: float = 120.0,
                  retries: int = 0, retry_cap_s: float = 30.0,
+                 retry_budget_s: float | None = None,
                  max_redirects: int = 4):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.retries = retries
         self.retry_cap_s = retry_cap_s
+        # total wall-clock retry budget across ALL attempts of one
+        # request (None: bounded by retries × retry_cap_s only)
+        self.retry_budget_s = retry_budget_s
         self.max_redirects = max_redirects
 
     def _post_once(self, url: str, data: bytes | None,
@@ -90,6 +97,7 @@ class ServeClient:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
         attempt = 0
+        t0 = time.monotonic()
         while True:
             try:
                 return self._post_once(url, data, headers)
@@ -98,9 +106,17 @@ class ServeClient:
                         or e.status not in _RETRYABLE \
                         or e.retry_after_s is None:
                     raise
+                delay = min(max(0.0, e.retry_after_s),
+                            self.retry_cap_s)
+                if self.retry_budget_s is not None and (
+                        time.monotonic() - t0 + delay
+                        > self.retry_budget_s):
+                    # honoring the hint would overspend the budget:
+                    # fail with the server's last answer rather than
+                    # sleep past what the caller was willing to wait
+                    raise
                 attempt += 1
-                time.sleep(min(max(0.0, e.retry_after_s),
-                               self.retry_cap_s))
+                time.sleep(delay)
 
     # ---- operability ----
 

@@ -84,7 +84,8 @@ class ServeApp:
                  breaker_threshold: int = 5,
                  breaker_cooldown_s: float = 30.0,
                  checkpoint_root: str | None = None,
-                 batch_mode: str = "continuous"):
+                 batch_mode: str = "continuous",
+                 cache_shared: bool = False):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
@@ -128,12 +129,22 @@ class ServeApp:
 
         self.breakers = {kind: _make_breaker(kind)
                          for kind in self.executors}
+        # cache_shared marks the directory as a FLEET-shared tier
+        # (fleet --shared-cache): keys are full content identity and
+        # writes are tmp-file + atomic rename, so many workers can
+        # share one directory safely by construction — the flag only
+        # changes what this worker reports (healthz cache block, the
+        # serve.cache.shared gauge), so operators and the smoke can
+        # tell a private session cache from the shared tier
         self.cache = None
+        self.cache_shared = bool(cache_shared)
         if cache_dir:
             from ..parallel.scheduler import ResultCache
 
             self.cache = ResultCache(cache_dir,
                                      max_bytes=cache_max_bytes)
+            self.metrics.registry.gauge("serve.cache.shared").set(
+                1 if self.cache_shared else 0)
         # continuous batching is the default: every dispatch admits
         # whatever compatible work is queued (the in-flight pass is the
         # coalescing horizon); "window" keeps the PR-2 fixed-window
@@ -288,6 +299,9 @@ class ServeApp:
         rec = {"status": "draining" if self.draining else "ok",
                "uptime_s": round(time.time() - self.metrics.started,
                                  1)}
+        if self.cache is not None:
+            rec["cache"] = "shared" if self.cache_shared \
+                else "private"
         try:
             import jax
 
@@ -449,7 +463,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         kind = self.path[len("/v1/"):].strip("/")
         if self.app.draining:
-            self._respond(503, {"error": "server is draining"})
+            # carry a retry hint: a drain is a WINDOW (restart,
+            # scale-down, fleet resize), not a verdict — a
+            # retry-aware client (serve/client.py retries>0) rides
+            # it out instead of failing the request
+            self._respond(503, {"error": "server is draining",
+                                "retry_after_s": 1.0})
             return
         try:
             n = int(self.headers.get("Content-Length", "0"))

@@ -137,6 +137,69 @@ def test_ring_spreads_and_moves_minimally():
             assert small.candidates(key)[0] == home
 
 
+def test_ring_resize_moves_only_the_resized_nodes_keys():
+    """Dynamic membership: adding a node moves ONLY keys the new node
+    now owns; removing it moves ONLY its keys back — and surviving
+    nodes keep their exact candidate order (the byte-identity /
+    cache-locality contract across fleet resizes)."""
+    nodes = [f"http://w{i}" for i in range(3)]
+    ring = HashRing(nodes)
+    keys = [f"f{i}.bam" for i in range(400)]
+    homes = {k: ring.candidates(k)[0] for k in keys}
+
+    grown = ring.with_node("http://w3")
+    moved = [k for k in keys if grown.candidates(k)[0] != homes[k]]
+    # every moved key moved TO the new node, nowhere else
+    assert all(grown.candidates(k)[0] == "http://w3" for k in moved)
+    # ~1/4 of the keyspace, generously bounded (64 vnodes of wobble)
+    assert 0 < len(moved) / len(keys) < 0.45
+    # candidate order over the ORIGINAL nodes is unchanged for all
+    for k in keys:
+        assert [n for n in grown.candidates(k) if n != "http://w3"] \
+            == ring.candidates(k)
+
+    # removal is the exact inverse: back to the original assignment
+    shrunk = grown.without_node("http://w3")
+    assert all(shrunk.candidates(k) == ring.candidates(k)
+               for k in keys)
+
+    # membership ops are idempotent + copy-on-write
+    assert grown.with_node("http://w3") is grown
+    assert ring.without_node("http://nope") is ring
+    only = HashRing(["http://solo"])
+    assert only.without_node("http://solo") is only  # never empty
+
+
+def test_ring_ownership_fractions():
+    ring = HashRing([f"http://w{i}" for i in range(4)])
+    owned = ring.ownership()
+    assert set(owned) == set(ring.nodes)
+    assert sum(owned.values()) == pytest.approx(1.0)
+    assert all(v > 0 for v in owned.values())
+
+
+def test_ring_candidates_deterministic_across_processes():
+    """The supervisor and the smoke rely on every process computing
+    the same plan from the same membership: ring positions are pure
+    sha256 of (node, vnode), nothing process-local."""
+    import subprocess
+    import sys
+
+    nodes = [f"http://w{i}" for i in range(3)]
+    keys = ["a.bam", "b.bam", "c.bam", "d.bam"]
+    local = [HashRing(nodes).candidates(k) for k in keys]
+    code = (
+        "import json\n"
+        "from goleft_tpu.fleet.router import HashRing\n"
+        f"ring = HashRing({nodes!r})\n"
+        f"print(json.dumps([ring.candidates(k) for k in {keys!r}]))\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout) == local
+
+
 # ---------------- token buckets / quotas ----------------
 
 
@@ -398,6 +461,92 @@ def test_router_plan_endpoint(two_workers, tmp_path):
         assert client.depth(str(f))["worker"] == next(
             w.state["name"] for w in two_workers
             if w.url == plan[0])
+
+
+def test_router_dynamic_add_and_drain_worker(two_workers, tmp_path):
+    """Supervisor levers: a worker added at runtime starts receiving
+    its share of traffic; a drained worker stops receiving NEW
+    traffic while staying in the pool until removed."""
+    app = _router(two_workers)
+    w2 = _StubWorker("w2")
+    try:
+        with RouterThread(app) as url:
+            client = ServeClient(url, timeout_s=10)
+            app.add_worker(w2.url)
+            assert w2.url in app.ring.nodes
+            assert w2.url in app.pool.eligible("depth")
+            # with enough distinct keys the new worker gets traffic
+            names = set()
+            for i in range(36):
+                f = tmp_path / f"g{i}.bam"
+                f.write_bytes(bytes([i]) * (40 + i))
+                names.add(client.depth(str(f))["worker"])
+            assert names == {"w0", "w1", "w2"}
+            # drain w2: new traffic avoids it, it stays known
+            app.drain_worker(w2.url)
+            assert w2.url not in app.pool.eligible("depth")
+            assert w2.url in app.pool.workers
+            assert app.pool.inflight(w2.url) == 0
+            before = len(w2.requests())
+            for i in range(12):
+                f = tmp_path / f"h{i}.bam"
+                f.write_bytes(bytes([100 + i]) * 30)
+                assert client.depth(str(f))["worker"] in ("w0", "w1")
+            assert len(w2.requests()) == before
+            # remove: gone from ring and pool
+            app.remove_worker(w2.url)
+            assert w2.url not in app.ring.nodes
+            assert w2.url not in app.pool.workers
+    finally:
+        w2.kill()
+
+
+def test_client_retry_budget_bounds_total_wait(two_workers, tmp_path):
+    """A client with a retry budget stops honoring retry_after_s
+    hints once sleeping again would overspend the budget — even with
+    retries left."""
+    f = tmp_path / "b.bam"
+    f.write_bytes(b"b" * 40)
+    for w in two_workers:
+        w.state["shed_kinds"] = {"depth"}  # all workers shed: 503s
+    app = _router(two_workers, poll_interval_s=30.0)
+    with RouterThread(app) as url:
+        patient = ServeClient(url, timeout_s=10, retries=50,
+                              retry_budget_s=0.6)
+        t0 = time.monotonic()
+        with pytest.raises(ServeError) as ei:
+            patient.depth(str(f))
+        assert ei.value.status == 503
+        # the stub hints 0.5s per retry; a 50-retry client without
+        # the budget would sleep ~25s — the budget caps it
+        assert time.monotonic() - t0 < 2.0
+
+
+def test_client_rides_out_draining_window(two_workers, tmp_path):
+    """The serve daemon's draining 503 carries retry_after_s; a
+    retry-aware client rides out the window (restart/resize) and
+    lands the 200 when shedding clears."""
+    f = tmp_path / "r.bam"
+    f.write_bytes(b"r" * 52)
+    app = _router(two_workers, poll_interval_s=30.0)
+    with RouterThread(app) as url:
+        client = ServeClient(url, timeout_s=10, retries=8,
+                             retry_cap_s=1.0, retry_budget_s=10.0)
+        for w in two_workers:
+            w.state["shed_kinds"] = {"depth"}
+
+        def clear():
+            time.sleep(0.7)
+            for w in two_workers:
+                w.state["shed_kinds"] = set()
+
+        t = threading.Thread(target=clear)
+        t.start()
+        try:
+            r = client.depth(str(f))  # 503s, sleeps, then 200
+            assert r["worker"] in ("w0", "w1")
+        finally:
+            t.join()
 
 
 # ---------------- continuous batcher ----------------
