@@ -7,7 +7,7 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        perf-gate lint lint-changed plan-lint check clean
+        decode-smoke perf-gate lint lint-changed plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -119,9 +119,19 @@ fleet-smoke:
 fleet-chaos:
 	python -m goleft_tpu.fleet.smoke --chaos
 
+# device-resident entropy decode end-to-end: a CRAM cohort (two
+# ORDER0 samples, one ORDER1 forcing the per-block host fallback)
+# through real cohortdepth subprocesses — the --decode-device matrix
+# is byte-identical to the default path, the run manifest carries the
+# decode counters (device blocks, fallbacks, wire bytes compressed vs
+# inflated), and an injected transient fault at the decode site is
+# retried to identical bytes. Host-pinned like the other smokes.
+decode-smoke:
+	python -m goleft_tpu.ops.decode_smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
-# the test suite, then the fleet end-to-end proofs
-check: lint plan-lint test fleet-smoke fleet-chaos
+# the test suite, then the end-to-end proofs
+check: lint plan-lint test decode-smoke fleet-smoke fleet-chaos
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -87,6 +87,7 @@ def cohort_matrix_blocks(
     checkpoint=None,
     quarantine=None,
     policy=None,
+    decode_device: bool = False,
 ):
     """(sample_names, total_windows, block generator) for the cohort
     depth matrix. ``bed`` restricts to the file's regions (the cohort
@@ -213,6 +214,27 @@ def cohort_matrix_blocks(
                     + "; ".join(
                         f"{e['source']}: {e['error']}"
                         for e in quarantine.summary()["quarantined"]))
+    if decode_device:
+        # device-resident entropy decode for the CRAM-backed cohort
+        # path: compressed block bytes + table arrays cross the wire,
+        # the rANS Nx16 state machine runs next to the coverage
+        # kernels, unsupported flag combos (ORDER1/STRIPE) fall back
+        # per-block to host decode (decode.device_fallback_total) —
+        # matrix bytes identical either way (docs/decode.md)
+        from ..obs import get_logger
+        from ..ops.rans_device import DeviceBlockDecoder
+
+        dec = DeviceBlockDecoder(policy=policy)
+        n_cram = 0
+        for h in handles:
+            if getattr(h, "is_cram", False):
+                h.set_block_decoder(dec)
+                n_cram += 1
+        if n_cram == 0:
+            get_logger("cohortdepth").warning(
+                "--decode-device: no CRAM inputs in this cohort — "
+                "BAM/BGZF inflate stays host-side (ROADMAP wire-gap "
+                "item); flag is a no-op")
     max_span = max(e - (s // window) * window for _, s, e in regions)
     length = (max_span + window - 1) // window * window
     cap = np.int32(DEPTH_CAP_EXTRA)
@@ -554,6 +576,7 @@ def run_cohortdepth(
     checkpoint_dir: str | None = None,
     resume: bool = False,
     resilient: bool = True,
+    decode_device: bool = False,
 ):
     """Returns the process exit code: 0 on a clean run, 3 when the
     cohort completed degraded (one or more samples quarantined — the
@@ -612,6 +635,7 @@ def run_cohortdepth(
             bed=bed, prefetch_depth=prefetch_depth,
             stage_timer=stage_timer, checkpoint=checkpoint,
             quarantine=quarantine, policy=policy,
+            decode_device=decode_device,
         )
     from ..io import native
 
@@ -669,6 +693,13 @@ def main(argv=None):
                         "transfer up to N shards ahead of the shard "
                         "being computed (0 = serial path, identical "
                         "output)")
+    p.add_argument("--decode-device", action="store_true",
+                   help="CRAM inputs: ship compressed rANS-Nx16 block "
+                        "bytes + table arrays over the wire and run "
+                        "the entropy decode on the device next to the "
+                        "coverage kernels (ORDER1/STRIPE blocks fall "
+                        "back to host decode per-block; output bytes "
+                        "identical — docs/decode.md)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="atomic sharded checkpoint store: per-region "
                         "per-sample column blocks + fsync'd journal "
@@ -696,6 +727,7 @@ def main(argv=None):
                    else a.processes),
         engine=a.engine, bed=a.bed, prefetch_depth=a.prefetch_depth,
         checkpoint_dir=a.checkpoint_dir, resume=a.resume,
+        decode_device=a.decode_device,
     )
 
 

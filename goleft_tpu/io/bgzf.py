@@ -59,26 +59,40 @@ def _parse_block_header(buf: bytes, off: int) -> tuple[int, int]:
 
 
 def bgzf_decompress(data: bytes) -> bytes:
-    """Inflate an entire in-memory BGZF stream to one bytes object."""
-    out = []
-    off = 0
+    """Inflate an entire in-memory BGZF stream to one bytes object.
+
+    Two passes: the headers are walked first (each block's ISIZE
+    trailer is at a known offset, so the exact output size is the sum
+    of trailers — O(#blocks), no inflation), then every block inflates
+    directly into ONE preallocated buffer through memoryview slices.
+    The previous accumulate-then-join held every block's bytes object
+    alive simultaneously and paid a second full-size copy at the join
+    — real alloc churn on multi-GB whole-file fallbacks."""
     n = len(data)
+    spans = []
+    off = 0
+    total = 0
     while off < n:
-        _faults.maybe_fail("bgzf", off)
         bsize, xlen = _parse_block_header(data, off)
+        crc, isize = struct.unpack_from("<II", data, off + bsize - 8)
+        spans.append((off, bsize, xlen, crc, isize, total))
+        total += isize
+        off += bsize
+    out = bytearray(total)
+    view = memoryview(out)
+    for off, bsize, xlen, crc, isize, w in spans:
+        _faults.maybe_fail("bgzf", off)
         cdata_off = off + 12 + xlen
         cdata_len = bsize - 12 - xlen - 8  # minus header and crc32+isize
         raw = zlib.decompress(
             data[cdata_off : cdata_off + cdata_len], wbits=-15
         )
-        crc, isize = struct.unpack_from("<II", data, off + bsize - 8)
         if len(raw) != isize:
             raise ValueError("bgzf: ISIZE mismatch")
         if zlib.crc32(raw) & 0xFFFFFFFF != crc:
             raise ValueError("bgzf: CRC mismatch (corrupt block)")
-        out.append(raw)
-        off += bsize
-    return b"".join(out)
+        view[w : w + isize] = raw
+    return bytes(out)
 
 
 class BgzfReader:

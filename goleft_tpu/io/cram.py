@@ -493,8 +493,25 @@ class Block:
     data: bytes  # uncompressed
 
 
-def read_block(buf: memoryview, pos: int,
-               v2: bool = False) -> tuple[Block, int]:
+@dataclass
+class RawBlock:
+    """One block as stored: compressed payload + frame, not decoded.
+
+    The raw-access surface the device decode path needs — a container's
+    blocks are collected first, then the entropy stage runs wherever
+    the installed block decoder puts it (ops/rans_device.py ships
+    these bytes compressed over the wire)."""
+
+    method: int
+    content_type: int
+    content_id: int
+    raw: bytes   # compressed payload as stored
+    rsize: int   # declared uncompressed size
+
+
+def read_block_raw(buf: memoryview, pos: int,
+                   v2: bool = False) -> tuple[RawBlock, int]:
+    """Parse one block's frame + CRC without decompressing."""
     start = pos
     method = buf[pos]
     ctype = buf[pos + 1]
@@ -513,10 +530,23 @@ def read_block(buf: memoryview, pos: int,
         pos += 4
         if got_crc != want_crc:
             raise ValueError("cram: block CRC mismatch")
-    data = _decompress(method, raw, rsize)
-    if len(data) != rsize:
+    return RawBlock(method, ctype, cid, raw, rsize), pos
+
+
+def decode_raw_block(rb: RawBlock, data: bytes | None = None) -> Block:
+    """RawBlock → Block, with the shared size validation. ``data``
+    injects already-decoded bytes (the device decode path)."""
+    if data is None:
+        data = _decompress(rb.method, rb.raw, rb.rsize)
+    if len(data) != rb.rsize:
         raise ValueError("cram: block size mismatch after decompression")
-    return Block(method, ctype, cid, data), pos
+    return Block(rb.method, rb.content_type, rb.content_id, data)
+
+
+def read_block(buf: memoryview, pos: int,
+               v2: bool = False) -> tuple[Block, int]:
+    rb, pos = read_block_raw(buf, pos, v2)
+    return decode_raw_block(rb), pos
 
 
 def write_block(method: int, ctype: int, cid: int, data: bytes,
@@ -1266,26 +1296,56 @@ class ContainerHeader:
         return head + struct.pack("<I", zlib.crc32(head))
 
 
+def _container_blocks(buf: memoryview, pos: int, end: int,
+                      v2: bool, block_decoder) -> list[Block]:
+    """All of a container's blocks, decoded.
+
+    Without a decoder this is the sequential read-and-inflate walk.
+    With one (``--decode-device``), the frames are parsed first —
+    still-compressed payloads — and the whole container's entropy
+    decode runs as ONE batched call, so supported blocks share a
+    single bucketed device dispatch instead of N host loops."""
+    if block_decoder is None:
+        blocks = []
+        while pos < end:
+            b, pos = read_block(buf, pos, v2)
+            blocks.append(b)
+        return blocks
+    raws = []
+    while pos < end:
+        rb, pos = read_block_raw(buf, pos, v2)
+        raws.append(rb)
+    datas = block_decoder.decode_blocks(raws)
+    return [decode_raw_block(rb, data=d) for rb, d in zip(raws, datas)]
+
+
 def _container_records(buf: memoryview, pos: int,
                        hdr: ContainerHeader,
-                       v2: bool = False) -> list[CramRecord]:
+                       v2: bool = False,
+                       block_decoder=None) -> list[CramRecord]:
     """Decode every record in the container starting at its first block."""
     end = pos + hdr.length
     try:
-        block, pos = read_block(buf, pos, v2)
-        if block.content_type != CT_COMP_HEADER:
+        blocks = iter(_container_blocks(buf, pos, end, v2,
+                                        block_decoder))
+        block = next(blocks, None)
+        if block is None or block.content_type != CT_COMP_HEADER:
             raise ValueError("cram: expected compression header block")
         comp = CompressionHeader.parse(block.data)
         records: list[CramRecord] = []
-        while pos < end:
-            sh_block, pos = read_block(buf, pos, v2)
+        while True:
+            sh_block = next(blocks, None)
+            if sh_block is None:
+                break
             if sh_block.content_type != CT_SLICE_HEADER:
                 raise ValueError("cram: expected slice header block")
             sl = SliceHeader.parse(sh_block.data, v2)
             core = b""
             externals: dict[int, bytes] = {}
             for _ in range(sl.n_blocks):
-                b, pos = read_block(buf, pos, v2)
+                b = next(blocks, None)
+                if b is None:
+                    raise IndexError("slice block past container end")
                 if b.content_type == CT_CORE:
                     core = b.data
                 elif b.content_type == CT_EXTERNAL:
@@ -1365,8 +1425,17 @@ class CramFile:
         self._crai = None
         self._all_records = None  # no-.crai fallback decode cache
         self._cache_lock = threading.Lock()
+        # pluggable per-container block decode (ops/rans_device.py's
+        # DeviceBlockDecoder under --decode-device); None = host codecs
+        self.block_decoder = None
         if crai_path:
             self._crai = _load_crai_entries(crai_path)
+
+    def set_block_decoder(self, decoder) -> None:
+        """Install a batch block decoder (``decode_blocks(raws) ->
+        list[bytes]``) used for every container this handle decodes —
+        byte-identical output is the decoder's contract."""
+        self.block_decoder = decoder
 
     @classmethod
     def from_file(cls, path: str, lazy: bool = True) -> "CramFile":
@@ -1404,7 +1473,8 @@ class CramFile:
     def records(self, offset: int | None = None):
         for hdr, body in self._iter_containers(offset):
             yield from _container_records(self._buf, body, hdr,
-                                          self._v2)
+                                          self._v2,
+                                          self.block_decoder)
 
     def _region_offsets(self, tid: int, start: int, end: int):
         """Container offsets overlapping 0-based [start, end) from the
@@ -1437,8 +1507,9 @@ class CramFile:
                     if body in seen:
                         break
                     seen.add(body)
-                    recs.extend(_container_records(self._buf, body, hdr,
-                                                   self._v2))
+                    recs.extend(_container_records(
+                        self._buf, body, hdr, self._v2,
+                        self.block_decoder))
                     break  # one container per crai offset
         else:
             # no .crai: decode the whole file ONCE and answer every
@@ -1501,7 +1572,7 @@ class CramFile:
         """Per-container column chunks (bounded by container size)."""
         for hdr, body in self._iter_containers():
             recs = _container_records(self._buf, body, hdr,
-                                      self._v2)
+                                      self._v2, self.block_decoder)
             cols = _records_to_columns(recs, None, 0, 1 << 60)
             if cols.n_reads:
                 yield cols

@@ -30,6 +30,7 @@ expansion, then PACK expansion, mirroring the encoder's PACK→RLE→rans.
 from __future__ import annotations
 
 import struct
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -133,18 +134,27 @@ def _read_freqs0(buf, pos: int):
     return freqs, pos
 
 
-def _decode_rans0(buf, pos: int, out_len: int, n_states: int) -> bytes:
-    from . import native
-
-    fast = native.ransnx16_decode0(buf, pos, out_len, n_states)
-    if fast is not None:
-        return fast
-    freqs, pos = _read_freqs0(buf, pos)
-    cum = np.zeros(257, dtype=np.int64)
-    np.cumsum(freqs, out=cum[1:])
+def _slot_lut(freqs: np.ndarray, cum: np.ndarray) -> np.ndarray:
+    """(4096,) slot → symbol table, exactly the per-slot coverage the
+    scalar decoder uses (slots not covered by any present symbol stay
+    0 — only reachable on corrupt tables)."""
     lut = np.zeros(TOTFREQ, dtype=np.uint8)
     for s in np.nonzero(freqs)[0]:
         lut[cum[s]:cum[s + 1]] = s
+    return lut
+
+
+#: lane count at or above which the vectorized state-stepping loop
+#: beats the scalar loop. Measured on the growth container (numpy
+#: ~2µs/op dispatch): X32 vectorized is 1.3-1.6x the scalar loop, but
+#: N=4 rounds pay ~10 numpy dispatches for 4 symbols and LOSE ~3x —
+#: the per-op overhead needs ≥ ~16 lanes to amortize. The device
+#: decoder (ops/rans_device.py) is the real answer for N=4 blocks.
+VEC_MIN_STATES = 32
+
+
+def _rans0_loop_scalar(buf, pos, out_len, n_states, freqs, cum, lut):
+    """Reference per-symbol loop (exact Python-int arithmetic)."""
     R = list(struct.unpack_from(f"<{n_states}I", buf, pos))
     pos += 4 * n_states
     out = bytearray(out_len)
@@ -162,6 +172,84 @@ def _decode_rans0(buf, pos: int, out_len: int, n_states: int) -> bytes:
             pos += 2
         R[j] = x
     return bytes(out)
+
+
+def _rans0_loop_vec(buf, pos, out_len, n_states, freqs, cum, lut):
+    """All N states stepped per iteration: one packed-table gather and
+    a handful of (N,)-wide ops per round instead of N per-symbol Python
+    steps. Byte-identical to the scalar loop on every stream: states
+    are int64 (Python-int-exact — a corrupt initial state can reach
+    ~2^32, never beyond cum growth bounds), and the renorm keeps the
+    scalar loop's sequential byte order inside a round via the
+    exclusive rank of each lane's pending 16-bit read — lane j's read
+    lands at pos + 2·#(earlier lanes reading this round), and the
+    bytes-left guard truncates at the same lane the scalar loop would
+    stop at (a lane denied bytes leaves every later lane denied too,
+    so the closed form needs no intra-round scan)."""
+    R = np.array(struct.unpack_from(f"<{n_states}I", buf, pos),
+                 dtype=np.int64)
+    pos += 4 * n_states
+    n = len(buf)
+    mask = TOTFREQ - 1
+    li = lut.astype(np.int64)
+    # packed per-slot table: freq<<20 | (m - cum[sym])<<8 | sym — one
+    # gather per round replaces three (bias = m - cum[sym] ≥ 0 because
+    # lut only assigns a slot m inside [cum[s], cum[s+1]))
+    T = ((freqs[li] << 20)
+         | ((np.arange(TOTFREQ, dtype=np.int64) - cum[li]) << 8) | li)
+    byts = np.frombuffer(buf, dtype=np.uint8).astype(np.int64)
+    b16 = byts[:-1].copy() if n > 1 else np.zeros(0, np.int64)
+    if n > 1:
+        b16 |= byts[1:] << 8  # LE 16-bit word at every byte offset
+    N = n_states
+    rounds = out_len // N
+    tail = out_len - rounds * N
+    out2 = np.empty((max(rounds, 1), N), dtype=np.int64)
+    for r in range(rounds):
+        t = T[R & mask]
+        R = (t >> 20) * (R >> TF_SHIFT) + ((t >> 8) & mask)
+        out2[r] = t
+        want = R < RANS_LOW
+        nw = int(want.sum())
+        if nw:
+            avail = (n - pos) >> 1
+            if nw > avail:
+                want &= (np.cumsum(want) - want) < avail
+                nw = int(want.sum())
+            w = np.flatnonzero(want)
+            R[w] = (R[w] << 16) | b16[pos + 2 * np.arange(nw)]
+            pos += 2 * nw
+    out = np.empty(out_len, dtype=np.uint8)
+    out[:rounds * N] = (out2 & 0xFF).reshape(-1)[:rounds * N] \
+        .astype(np.uint8)
+    if tail:  # final partial round: lanes j < tail, scalar order
+        base = rounds * N
+        for j in range(tail):
+            x = int(R[j])
+            m = x & mask
+            s = int(lut[m])
+            out[base + j] = s
+            x = int(freqs[s]) * (x >> TF_SHIFT) + m - int(cum[s])
+            if x < RANS_LOW and pos + 1 < n:
+                x = (x << 16) | buf[pos] | (buf[pos + 1] << 8)
+                pos += 2
+            R[j] = x
+    return bytes(out)
+
+
+def _decode_rans0(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    from . import native
+
+    fast = native.ransnx16_decode0(buf, pos, out_len, n_states)
+    if fast is not None:
+        return fast
+    freqs, pos = _read_freqs0(buf, pos)
+    cum = np.zeros(257, dtype=np.int64)
+    np.cumsum(freqs, out=cum[1:])
+    lut = _slot_lut(freqs, cum)
+    loop = _rans0_loop_vec if n_states >= VEC_MIN_STATES \
+        else _rans0_loop_scalar
+    return loop(buf, pos, out_len, n_states, freqs, cum, lut)
 
 
 def _encode_rans0(data: bytes, n_states: int = 4) -> bytes:
@@ -536,6 +624,166 @@ def decode(data: bytes, expected_len: int | None = None) -> bytes:
     if len(payload) != final_len:
         raise ValueError("rans-nx16: output length mismatch")
     return payload
+
+
+# ------------------------------------------------- parsed-stream access
+#
+# The device decoder (ops/rans_device.py) needs the stream's LAYOUT —
+# table arrays, state seeds, transform metadata and the compressed
+# payload span — without the bytes being decoded here. parse_nx16 is
+# that surface: it performs exactly decode()'s header walk (varints,
+# alphabet, frequency normalization, RLE metadata — all host-cheap,
+# O(table) not O(payload)) and leaves the entropy-coded payload
+# untouched for the wire.
+
+@dataclass
+class ParsedNx16:
+    """Layout of one rANS-Nx16 stream whose flag combo the device
+    decoder supports (ORDER0 × CAT × PACK × RLE × NOSZ, N=4/32).
+
+    ``payload`` is the still-compressed byte span (the rANS renorm
+    stream, or the raw bytes for CAT) — what actually crosses the
+    wire under ``--decode-device``; ``freq``/``cum`` are the shipped
+    int32 table arrays the device expands into its 4096-entry slot
+    tables. ``table_bytes`` counts the shipped table/metadata arrays
+    for wire accounting."""
+
+    flags: int
+    n_states: int
+    cat: bool
+    final_len: int            # decode()'s return length
+    inner_len: int            # rANS/CAT output length (pre-RLE/PACK)
+    payload: np.ndarray       # (P,) uint8, compressed (or CAT raw)
+    states: np.ndarray | None  # (N,) uint32 (None for CAT)
+    freq: np.ndarray | None    # (256,) int32
+    cum: np.ndarray | None     # (257,) int32
+    rle: bool = False
+    rle_tab: np.ndarray | None = None   # (256,) bool marked symbols
+    rle_runs: np.ndarray | None = None  # (k,) int32 run extensions
+    rle_out_len: int = 0      # post-RLE length
+    pack: bool = False
+    pack_bits: int = 0
+    pack_map: np.ndarray | None = None  # (16,) int32 (padded)
+    pack_nsym: int = 0
+
+    @property
+    def table_bytes(self) -> int:
+        """Logical bytes of the table/metadata arrays as they ship
+        over the wire: freq goes int16 and cum is expanded on device
+        (a cumsum), so a non-CAT block pays ~0.5KB of table."""
+        n = 0
+        if self.states is not None:
+            n += int(self.states.nbytes)
+        if self.freq is not None:
+            n += 256 * 2  # int16 on the wire; cum derives on device
+        if self.rle_tab is not None:
+            n += int(self.rle_tab.nbytes)
+        if self.rle_runs is not None:
+            n += int(self.rle_runs.nbytes)
+        if self.pack_map is not None:
+            n += int(self.pack_map.nbytes)
+        return n
+
+
+def parse_nx16(data: bytes,
+               expected_len: int | None = None) -> ParsedNx16 | None:
+    """Parse one stream's layout for device decode; None when the
+    combo stays host-side (ORDER1, STRIPE, missing external size, or
+    any inconsistency the host decoder would surface its own way —
+    returning None always degrades to the host path, so a foreign or
+    corrupt stream decodes (or fails) exactly as before."""
+    try:
+        buf = memoryview(data)
+        pos = 0
+        flags = buf[pos]
+        pos += 1
+        if flags & (F_ORDER1 | F_STRIPE):
+            return None
+        if flags & F_NOSZ:
+            if expected_len is None:
+                return None
+            out_len = expected_len
+        else:
+            out_len, pos = read_uint7(buf, pos)
+            if expected_len is not None and out_len != expected_len:
+                return None  # host raises the canonical error
+        n_states = 32 if flags & F_X32 else 4
+
+        parsed = ParsedNx16(
+            flags=flags, n_states=n_states, cat=bool(flags & F_CAT),
+            final_len=out_len, inner_len=out_len,
+            payload=np.zeros(0, np.uint8), states=None, freq=None,
+            cum=None)
+        if flags & F_PACK:
+            nsym = buf[pos]
+            pos += 1
+            if nsym == 0 or nsym > 16:
+                return None  # host path raises / spills past pmap[15]
+            pmap = np.zeros(16, dtype=np.int32)
+            pmap[:nsym] = np.frombuffer(buf[pos:pos + nsym], np.uint8)
+            pos += nsym
+            out_len, pos = read_uint7(buf, pos)  # packed byte count
+            parsed.pack = True
+            parsed.pack_bits = _pack_bits(nsym)
+            parsed.pack_map = pmap
+            parsed.pack_nsym = nsym
+        if flags & F_RLE:
+            mlen, pos = read_uint7(buf, pos)
+            raw = mlen & 1
+            body_len = mlen >> 1
+            rle_out_len = out_len
+            out_len, pos = read_uint7(buf, pos)  # literal count
+            if raw:
+                meta = bytes(buf[pos:pos + body_len])
+                if len(meta) < body_len:
+                    return None
+                pos += body_len
+            else:
+                um, pos = read_uint7(buf, pos)
+                if um > 10 * rle_out_len + 4096:
+                    return None
+                meta = _decode_rans0(buf, pos, um, 4)
+                pos += body_len
+            mpos = 0
+            ns = meta[mpos]
+            mpos += 1
+            if ns == 0:
+                ns = 256
+            tab = np.zeros(256, dtype=bool)
+            tab[list(meta[mpos:mpos + ns])] = True
+            mpos += ns
+            runs = []
+            while mpos < len(meta):
+                r, mpos = read_uint7(meta, mpos)
+                runs.append(r)
+            parsed.rle = True
+            parsed.rle_tab = tab
+            parsed.rle_runs = np.asarray(runs, dtype=np.int32)
+            parsed.rle_out_len = rle_out_len
+        parsed.inner_len = out_len
+
+        if flags & F_CAT:
+            payload = np.frombuffer(buf[pos:pos + out_len], np.uint8)
+            if payload.shape[0] < out_len:
+                return None  # truncated: host fails its own way
+            parsed.payload = payload.copy()
+        else:
+            freqs, pos = _read_freqs0(buf, pos)
+            cum = np.zeros(257, dtype=np.int64)
+            np.cumsum(freqs, out=cum[1:])
+            if int(cum[256]) != TOTFREQ:
+                return None  # corrupt table: keep host semantics
+            states = np.array(
+                struct.unpack_from(f"<{n_states}I", buf, pos),
+                dtype=np.uint32)
+            pos += 4 * n_states
+            parsed.freq = freqs.astype(np.int32)
+            parsed.cum = cum.astype(np.int32)
+            parsed.states = states
+            parsed.payload = np.frombuffer(buf[pos:], np.uint8).copy()
+        return parsed
+    except (IndexError, ValueError, struct.error):
+        return None
 
 
 def encode(data: bytes, order: int = 0, use_rle: bool = False,

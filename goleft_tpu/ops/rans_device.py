@@ -1,0 +1,594 @@
+"""Device-resident rANS Nx16 entropy decode (CRAM 3.1 method 5).
+
+Round-2 numbers put device-resident coverage compute at 51.7 Gbases/s
+but only 0.155 Gbases/s over the packed wire: host entropy decode plus
+H2D transfer is THE speed ceiling (ROADMAP "Close the wire gap"), and
+GenPIP's (PAPERS.md) whole thesis is that fusing decode with compute
+kills the data-movement wall. This module moves the CRAM block decode
+next to the coverage kernels: *compressed* block bytes cross the wire
+and the interleaved-rANS state machine runs on the device.
+
+The decoder state machine as a ``lax.scan``
+-------------------------------------------
+An Nx16 stream decodes round-robin: out[i] advances state i mod N
+(N = 4 or 32). One *round* therefore advances all N states — the N
+lanes are data-independent within a round except for the shared renorm
+byte stream. The scan runs over rounds with carry (R[N] states, read
+pointer); each round is pure vector math plus gathers:
+
+  - slot lookup: ``m = R & 0xFFF`` indexes the 4096-entry slot tables
+    (symbol / freq / bias), expanded ON DEVICE from the shipped
+    (freq[256], cum[257]) int32 arrays by a vectorized searchsorted —
+    the wire carries ~2KB of table per block instead of the 48KB
+    materialized slot arrays
+  - 16-bit renorm as masked gathers: a lane whose next state drops
+    below 2^15 reads a little-endian 16-bit word from the shared byte
+    stream. Within a round the scalar decoder reads lanes in order, so
+    lane j's word sits at ``pos + 2*rank(j)`` where rank counts
+    earlier lanes renormalizing this round (an exclusive cumsum); the
+    bytes-left guard truncates at the same lane the scalar loop stops
+    at, because a denied lane leaves every later lane denied too.
+
+CAT blocks skip the scan (payload = literals); RLE and PACK expansion
+run as vectorized gathers on the scan/CAT output (cumsum + searchsorted
+for run expansion, shift/mask gathers for bit-unpacking), completing
+the supported combo matrix ORDER0 × CAT × PACK × RLE × NOSZ for both
+N=4 and X32. ORDER1 and STRIPE stay host-side this PR (counted in
+``decode.device_fallback_total``).
+
+Parallelism and compiles: one block is only N lanes wide, so the real
+vector width comes from vmapping over many blocks at once. Blocks pad
+to power-of-two bucket signatures (payload length, round count,
+expansion caps) exactly like ops/pairhmm.py's length bucketing, so a
+whole cohort compiles O(#buckets) programs, not O(#shapes).
+
+An experimental Pallas variant (``pallas_decode0``) mirrors
+ops/pallas_coverage.py — one block per sequential grid step, lanes as
+a VMEM vector, the same round loop as a ``fori_loop``; correctness is
+pinned in interpret mode (this container is CPU-only), the XLA scan is
+the product path.
+
+``DeviceBlockDecoder`` is the CRAM-facing object: io/cram.py hands it
+a container's raw (still compressed) blocks, supported rANS blocks
+batch-decode on device through a content-keyed plan Step at the
+``decode`` fault site (retry/quarantine compose exactly like every
+other dispatch), everything else falls back per-block to the host
+codecs, byte-identically.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..io import rans_nx16 as _rx
+from ..io.rans_nx16 import ParsedNx16, parse_nx16
+from ..obs import get_registry
+
+TF_SHIFT = _rx.TF_SHIFT
+TOTFREQ = _rx.TOTFREQ
+RANS_LOW = _rx.RANS_LOW
+
+#: minimum pad bucket for payload/output axes (pow-2 above, like
+#: pairhmm's BUCKET: arbitrary block sizes compile O(#buckets))
+MIN_BUCKET = 64
+
+
+def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ------------------------------------------------------------ XLA path
+
+# jax.jit is applied lazily in _jitted() — this module must import
+# without jax (the jax-free fleet/router processes import the package)
+def _decode_bucket_impl(payload, plen, states, freq, inner_len,
+                        rle_tab, runs, rle_out, pmap, bits, final_len,
+                        *, rounds, n_states, cat, rle, pack, lit_cap,
+                        mid_cap, out_cap):
+    """One padded bucket: (B, …) arrays → ((B, out_cap) uint8 bytes,
+    (B, 3) int32 diagnostics [rle_total, marked_total, pack_vmax]).
+
+    Static flags (cat/rle/pack) specialize the program per combo; the
+    identity stages compile away. All shapes are the bucket caps, all
+    true lengths are traced scalars — one compile per signature.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    N = n_states
+    lanes = jnp.arange(N, dtype=jnp.int32)
+    ms = jnp.arange(TOTFREQ, dtype=jnp.int32)
+
+    def one(payload, plen, R0, freq, inner_len, rle_tab, runs,
+            rle_out, pmap, bits, final_len):
+        P = payload.shape[0]
+        if cat:
+            lit = payload[:lit_cap]
+        else:
+            # the wire ships only the int16 frequency row (~0.5KB);
+            # cum and the 4096-entry slot tables expand on device. The
+            # largest s with cum[s] <= m is the scalar decoder's lut
+            # for every normalized table (zero-freq symbols collapse
+            # to equal cum entries, skipped by side="right")
+            cum = jnp.concatenate([
+                jnp.zeros(1, jnp.int32),
+                jnp.cumsum(freq, dtype=jnp.int32)])
+            sym = jnp.clip(
+                jnp.searchsorted(cum, ms, side="right").astype(
+                    jnp.int32) - 1, 0, 255)
+            sfreq = freq[sym].astype(jnp.uint32)  # freq ≤ 4096: exact
+            sbias = (ms - cum[sym]).astype(jnp.uint32)
+
+            def round_fn(carry, r):
+                R, pos = carry
+                active = (r * N + lanes) < inner_len
+                m = (R & jnp.uint32(TOTFREQ - 1)).astype(jnp.int32)
+                s = sym[m]
+                x = sfreq[m] * (R >> jnp.uint32(TF_SHIFT)) + sbias[m]
+                want = active & (x < jnp.uint32(RANS_LOW))
+                avail = jnp.maximum(jnp.int32(0), (plen - pos) // 2)
+                wi = want.astype(jnp.int32)
+                rank = jnp.cumsum(wi, dtype=jnp.int32) - wi
+                need = want & (rank < avail)
+                offs = pos + 2 * rank
+                b0 = payload[jnp.clip(offs, 0, P - 1)] \
+                    .astype(jnp.uint32)
+                b1 = payload[jnp.clip(offs + 1, 0, P - 1)] \
+                    .astype(jnp.uint32)
+                xr = (x << jnp.uint32(16)) | b0 | (b1 << jnp.uint32(8))
+                x = jnp.where(need, xr, x)
+                R = jnp.where(active, x, R)
+                pos = pos + 2 * jnp.sum(need, dtype=jnp.int32)
+                return (R, pos), s.astype(jnp.uint8)
+
+            (_, _), syms = lax.scan(
+                round_fn, (R0, jnp.int32(0)),
+                jnp.arange(rounds, dtype=jnp.int32))
+            lit = syms.reshape(rounds * N)[:lit_cap]
+
+        # ---- RLE expansion: each marked literal repeats 1 + its run
+        # extension; output position p maps back to the literal whose
+        # cumulative start covers it (searchsorted over the exclusive
+        # cumsum — the vectorized form of the host's sequential walk)
+        if rle:
+            idx = jnp.arange(lit_cap, dtype=jnp.int32)
+            in_range = idx < inner_len
+            marked = rle_tab[lit.astype(jnp.int32)] & in_range
+            mi = marked.astype(jnp.int32)
+            rank = jnp.cumsum(mi, dtype=jnp.int32) - mi
+            rcap = runs.shape[0]
+            rep = jnp.where(
+                in_range,
+                1 + jnp.where(marked,
+                              runs[jnp.clip(rank, 0, rcap - 1)], 0),
+                0)
+            starts = jnp.cumsum(rep, dtype=jnp.int32) - rep
+            rle_total = starts[-1] + rep[-1]
+            marked_total = jnp.sum(mi, dtype=jnp.int32)
+            posn = jnp.arange(mid_cap, dtype=jnp.int32)
+            src = jnp.clip(
+                jnp.searchsorted(starts, posn, side="right").astype(
+                    jnp.int32) - 1, 0, lit_cap - 1)
+            mid = jnp.where(posn < rle_out, lit[src],
+                            jnp.uint8(0))
+            mid_len = rle_out
+        else:
+            mid = lit
+            mid_len = inner_len
+            rle_total = inner_len
+            marked_total = jnp.int32(0)
+
+        # ---- PACK expansion: shift/mask gathers (bits ∈ {0,1,2,4},
+        # LSB-first like the host's _unpack)
+        if pack:
+            i = jnp.arange(out_cap, dtype=jnp.int32)
+            per = 8 // jnp.maximum(bits, 1)
+            idxp = jnp.clip(i // per, 0, mid_cap - 1)
+            sh = bits * (i % per)
+            maskb = (jnp.int32(1) << bits) - 1
+            v = (mid[idxp].astype(jnp.int32) >> sh) & maskb
+            vc = jnp.clip(v, 0, 15)
+            outb = jnp.where(bits == 0, pmap[0], pmap[vc]) \
+                .astype(jnp.uint8)
+            vmax = jnp.max(jnp.where((i < final_len) & (bits > 0),
+                                     v, 0))
+        else:
+            outb = mid
+            vmax = jnp.int32(0)
+        del mid_len
+        diag = jnp.stack([rle_total.astype(jnp.int32),
+                          marked_total, vmax])
+        return outb, diag
+
+    return jax.vmap(one)(payload, plen, states, freq, inner_len,
+                         rle_tab, runs, rle_out, pmap, bits, final_len)
+
+
+_JIT_CACHE: dict = {}
+
+
+def _jitted():
+    fn = _JIT_CACHE.get("xla")
+    if fn is None:
+        import jax
+
+        fn = jax.jit(_decode_bucket_impl, static_argnames=(
+            "rounds", "n_states", "cat", "rle", "pack", "lit_cap",
+            "mid_cap", "out_cap"))
+        _JIT_CACHE["xla"] = fn
+    return fn
+
+
+# --------------------------------------------------------- Pallas path
+
+def pallas_decode0(payload, plen, states, slot_sym, slot_freq,
+                   slot_bias, inner_len, *, rounds, n_states,
+                   interpret: bool = False):
+    """The rANS scan as a Pallas kernel: one block per sequential grid
+    step, the N states as a lane vector, the round loop as a
+    ``fori_loop`` with (states, read pointer, output buffer) carried —
+    the same one-item-per-grid-step pattern as
+    ops/pairhmm.py::pallas_forward_bucket. EXPERIMENTAL like its
+    siblings: interpret-mode-pinned against the XLA scan (this
+    container is CPU-only); expansions (RLE/PACK) stay in the shared
+    XLA stages either way.
+
+    payload (B, P) int32, states (B, N) int32, slots (B, 4096) int32
+    → (B, rounds*N) int32 symbols.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, P = payload.shape
+    N = n_states
+    L = rounds * N
+
+    def kernel(meta_ref, states_ref, payload_ref, sym_ref, freq_ref,
+               bias_ref, out_ref):
+        plen_b = meta_ref[0, 0]
+        inner_b = meta_ref[0, 1]
+        pay = payload_ref[0, :]
+        sym = sym_ref[0, :]
+        sfreq = freq_ref[0, :]
+        sbias = bias_ref[0, :]
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+
+        def round_fn(r, carry):
+            R, pos, outbuf = carry
+            active = (r * N + lanes) < inner_b
+            m = R & (TOTFREQ - 1)
+            s = jnp.take(sym, m[0, :], axis=0)[None, :]
+            f = jnp.take(sfreq, m[0, :], axis=0)[None, :]
+            bi = jnp.take(sbias, m[0, :], axis=0)[None, :]
+            # int32 is exact here: valid states stay < 2^31 (renorm
+            # bound) so freq*(x>>12)+bias < 2^31 and the x<<16 of a
+            # sub-2^15 state fits — the uint32 XLA path and this agree
+            # bit-for-bit on every well-formed stream
+            x = f * (R >> TF_SHIFT) + bi
+            want = active & (x < RANS_LOW)
+            avail = jnp.maximum(0, (plen_b - pos) // 2)
+            wi = want.astype(jnp.int32)
+            rank = jnp.cumsum(wi, axis=1, dtype=jnp.int32) - wi
+            need = want & (rank < avail)
+            offs = pos + 2 * rank
+            b0 = jnp.take(pay, jnp.clip(offs[0, :], 0, P - 1),
+                          axis=0)[None, :]
+            b1 = jnp.take(pay, jnp.clip(offs[0, :] + 1, 0, P - 1),
+                          axis=0)[None, :]
+            xr = (x << 16) | b0 | (b1 << 8)
+            x = jnp.where(need, xr, x)
+            R = jnp.where(active, x, R)
+            pos = pos + 2 * jnp.sum(need, dtype=jnp.int32)
+            outbuf = jax.lax.dynamic_update_slice(outbuf, s,
+                                                  (0, r * N))
+            return R, pos, outbuf
+
+        R0 = states_ref[0, :][None, :]
+        out0 = jnp.zeros((1, L), jnp.int32)
+        _, _, outbuf = jax.lax.fori_loop(
+            0, rounds, round_fn, (R0, jnp.int32(0), out0))
+        out_ref[0] = outbuf[0]
+
+    meta = jnp.stack([plen, inner_len], axis=1).astype(jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda t: (t, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, N), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TOTFREQ), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TOTFREQ), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TOTFREQ), lambda t: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, L), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, L), jnp.int32),
+        interpret=interpret,
+    )(meta, states, payload, slot_sym, slot_freq, slot_bias)
+
+
+def _pallas_scan_bytes(group: list[ParsedNx16], n_states: int,
+                       rounds: int, p_cap: int,
+                       interpret: bool) -> np.ndarray:
+    """Run a non-CAT group's rANS stage through the Pallas kernel,
+    returning (B, rounds*N) uint8 symbols (the XLA expansion stages
+    consume them unchanged)."""
+    import jax.numpy as jnp
+
+    B = len(group)
+    payload = np.zeros((B, p_cap), np.int32)
+    plen = np.zeros(B, np.int32)
+    states = np.zeros((B, n_states), np.int32)
+    ssym = np.zeros((B, TOTFREQ), np.int32)
+    sfreq = np.zeros((B, TOTFREQ), np.int32)
+    sbias = np.zeros((B, TOTFREQ), np.int32)
+    inner = np.zeros(B, np.int32)
+    ms = np.arange(TOTFREQ, dtype=np.int64)
+    for i, p in enumerate(group):
+        payload[i, :p.payload.shape[0]] = p.payload
+        plen[i] = p.payload.shape[0]
+        states[i] = p.states.astype(np.int64).astype(np.int32)
+        lut = _rx._slot_lut(p.freq.astype(np.int64),
+                            p.cum.astype(np.int64)).astype(np.int64)
+        ssym[i] = lut.astype(np.int32)
+        sfreq[i] = p.freq[lut]
+        sbias[i] = (ms - p.cum[lut]).astype(np.int32)
+        inner[i] = p.inner_len
+    got = pallas_decode0(
+        jnp.asarray(payload), jnp.asarray(plen), jnp.asarray(states),
+        jnp.asarray(ssym), jnp.asarray(sfreq), jnp.asarray(sbias),
+        jnp.asarray(inner), rounds=rounds, n_states=n_states,
+        interpret=interpret)
+    return np.asarray(got).astype(np.uint8)
+
+
+# ---------------------------------------------------------- batch glue
+
+def _signature(p: ParsedNx16) -> tuple:
+    """Pad-to-bucket compile signature (pairhmm-style): every axis
+    rounds up to a power of two so arbitrary cohorts stay O(#buckets)
+    compiles."""
+    n = p.n_states
+    lit_cap = bucket(max(p.inner_len, 1))
+    if not p.cat:
+        rounds = (lit_cap + n - 1) // n
+        lit_cap = rounds * n
+    else:
+        rounds = 0
+    p_cap = bucket(max(p.payload.shape[0], 1))
+    if p.cat:
+        p_cap = max(p_cap, lit_cap)  # CAT payload IS the literals
+    mid_cap = bucket(max(p.rle_out_len, 1)) if p.rle else lit_cap
+    out_cap = bucket(max(p.final_len, 1)) if p.pack else mid_cap
+    runs_cap = bucket(len(p.rle_runs) if p.rle_runs is not None
+                      else 0, minimum=16)
+    return (n, p.cat, p.rle, p.pack, rounds, p_cap, lit_cap, mid_cap,
+            out_cap, runs_cap)
+
+
+def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
+                  interpret: bool = False,
+                  stage=None) -> list[bytes]:
+    """Decode parsed streams on device, bucketed + vmapped; returns
+    bytes per stream, byte-identical to ``rans_nx16.decode``.
+
+    ``backend``: "scan" (the XLA product path) or "pallas" (the
+    experimental kernel for the rANS stage; expansions shared).
+    ``stage``: optional callable mapping a dict of host arrays to
+    device arrays (parallel.prefetch.stage_block_arrays — the
+    compressed-wire staging/accounting step); default stages without
+    accounting.
+    """
+    results: list[bytes | None] = [None] * len(plans)
+    groups: dict[tuple, list[int]] = {}
+    for i, p in enumerate(plans):
+        groups.setdefault(_signature(p), []).append(i)
+    for sig in sorted(groups):
+        idxs = groups[sig]
+        (n, cat, rle, pack, rounds, p_cap, lit_cap, mid_cap, out_cap,
+         runs_cap) = sig
+        grp = [plans[i] for i in idxs]
+        B = len(grp)
+        payload = np.zeros((B, p_cap), np.uint8)
+        plen = np.zeros(B, np.int32)
+        states = np.zeros((B, n), np.uint32)
+        # freq ships int16 (≤ 4096 each); cum expands on device
+        freq = np.zeros((B, 256), np.int16)
+        inner = np.zeros(B, np.int32)
+        rle_tab = np.zeros((B, 256), bool)
+        runs = np.zeros((B, runs_cap), np.int32)
+        rle_out = np.zeros(B, np.int32)
+        pmap = np.zeros((B, 16), np.int32)
+        bits = np.zeros(B, np.int32)
+        final = np.zeros(B, np.int32)
+        for j, p in enumerate(grp):
+            payload[j, :p.payload.shape[0]] = p.payload
+            plen[j] = p.payload.shape[0]
+            inner[j] = p.inner_len
+            final[j] = p.final_len
+            if not cat:
+                states[j] = p.states
+                freq[j] = p.freq.astype(np.int16)
+            if rle:
+                rle_tab[j] = p.rle_tab
+                runs[j, :len(p.rle_runs)] = p.rle_runs
+                rle_out[j] = p.rle_out_len
+            if pack:
+                pmap[j] = p.pack_map
+                bits[j] = p.pack_bits
+        host = dict(payload=payload, plen=plen, states=states,
+                    freq=freq, inner=inner, rle_tab=rle_tab,
+                    runs=runs, rle_out=rle_out, pmap=pmap, bits=bits,
+                    final=final)
+        if stage is None:
+            import jax
+
+            dev = {k: jax.device_put(v) for k, v in host.items()}
+        else:
+            dev = stage(host)
+        if backend == "pallas" and not cat:
+            lit = _pallas_scan_bytes(grp, n, rounds, p_cap, interpret)
+            # expansions reuse the XLA stages by re-entering as CAT
+            # with the scan's output as payload
+            out, diag = _jitted()(
+                lit, dev["plen"], dev["states"], dev["freq"],
+                dev["inner"], dev["rle_tab"], dev["runs"],
+                dev["rle_out"], dev["pmap"], dev["bits"],
+                dev["final"], rounds=0, n_states=n, cat=True,
+                rle=rle, pack=pack, lit_cap=lit.shape[1],
+                mid_cap=mid_cap, out_cap=out_cap)
+        else:
+            out, diag = _jitted()(
+                dev["payload"], dev["plen"], dev["states"],
+                dev["freq"], dev["inner"],
+                dev["rle_tab"], dev["runs"], dev["rle_out"],
+                dev["pmap"], dev["bits"], dev["final"],
+                rounds=rounds, n_states=n, cat=cat, rle=rle,
+                pack=pack, lit_cap=lit_cap, mid_cap=mid_cap,
+                out_cap=out_cap)
+        out = np.asarray(out)
+        diag = np.asarray(diag)
+        for j, (i, p) in enumerate(zip(idxs, grp)):
+            if rle:
+                if int(diag[j, 0]) != p.rle_out_len:
+                    raise ValueError(
+                        "rans-nx16: rle expansion length mismatch")
+                if p.rle_runs is not None \
+                        and int(diag[j, 1]) > len(p.rle_runs):
+                    raise ValueError(
+                        "rans-nx16: rle metadata exhausted")
+            if pack and p.pack_bits > 0 \
+                    and int(diag[j, 2]) >= p.pack_nsym:
+                raise ValueError(
+                    "rans-nx16: pack index out of range")
+            results[i] = bytes(out[j, :p.final_len])
+    return results
+
+
+def decode_streams(datas: list[bytes],
+                   expected_lens: list[int | None] | None = None,
+                   *, backend: str = "scan",
+                   interpret: bool = False) -> list[bytes | None]:
+    """Parse + device-decode many standalone Nx16 streams; None marks
+    a stream whose combo stays host-side (the caller falls back to
+    ``rans_nx16.decode``). The fuzz-parity surface tests pin against
+    the host oracle."""
+    if expected_lens is None:
+        expected_lens = [None] * len(datas)
+    plans, order = [], []
+    results: list[bytes | None] = [None] * len(datas)
+    for i, (d, el) in enumerate(zip(datas, expected_lens)):
+        p = parse_nx16(d, el)
+        if p is not None:
+            plans.append(p)
+            order.append(i)
+    decoded = decode_parsed(plans, backend=backend,
+                            interpret=interpret)
+    for i, b in zip(order, decoded):
+        results[i] = b
+    return results
+
+
+# ------------------------------------------------- CRAM block decoder
+
+class DeviceBlockDecoder:
+    """Per-container CRAM block decode with the entropy stage on
+    device.
+
+    io/cram.py hands :meth:`decode_blocks` one container's raw (still
+    compressed) blocks. rANS-Nx16 blocks whose flag combo the device
+    path supports batch-decode in one bucketed vmapped dispatch — a
+    content-keyed plan Step at the ``decode`` fault site, so a
+    transient device fault costs one backoff and the per-sample
+    quarantine above composes unchanged. Every other block (gzip,
+    ORDER1, STRIPE, …) decodes on host exactly as before, counted in
+    ``decode.device_fallback_total`` (rANS combos deferred this PR)
+    or ``decode.host_blocks_total`` (other codecs).
+
+    Wire accounting (the point of the exercise): compressed payload +
+    ~2KB of table arrays per block cross the link instead of the
+    inflated bytes — ``decode.wire_bytes_compressed_total`` vs
+    ``decode.wire_bytes_uncompressed_total``; the staging itself runs
+    through parallel.prefetch.stage_block_arrays so the existing
+    prefetch byte counters and stage spans record it.
+    """
+
+    def __init__(self, backend: str = "scan", interpret: bool = False,
+                 policy=None):
+        from ..plan import Executor
+        from ..resilience.policy import DEFAULT_POLICY
+
+        self.backend = backend
+        self.interpret = interpret
+        self._pex = Executor(policy=policy if policy is not None
+                             else DEFAULT_POLICY)
+        reg = get_registry()
+        self._c_dev = reg.counter("decode.device_blocks_total")
+        self._c_fall = reg.counter("decode.device_fallback_total")
+        self._c_host = reg.counter("decode.host_blocks_total")
+        self._c_wire_c = reg.counter("decode.wire_bytes_compressed_total")
+        self._c_wire_u = reg.counter(
+            "decode.wire_bytes_uncompressed_total")
+
+    def _stage(self, host_arrays: dict) -> dict:
+        from ..parallel.prefetch import stage_block_arrays
+
+        return stage_block_arrays(host_arrays)
+
+    def decode_blocks(self, raws) -> list[bytes]:
+        """raw blocks (io.cram.RawBlock) → uncompressed bytes, in
+        order; byte-identical to the host path for every block."""
+        from ..io import cram as _cram
+
+        results: list[bytes | None] = [None] * len(raws)
+        plans: list[ParsedNx16] = []
+        order: list[int] = []
+        for i, rb in enumerate(raws):
+            if rb.method == _cram.M_RANSNX16:
+                p = parse_nx16(rb.raw, rb.rsize)
+                if p is not None:
+                    plans.append(p)
+                    order.append(i)
+                    continue
+                self._c_fall.inc()
+            elif rb.method != _cram.M_RAW:
+                self._c_host.inc()
+            results[i] = _cram._decompress(rb.method, rb.raw,
+                                           rb.rsize)
+        if plans:
+            from ..plan import Step
+
+            wire_c = sum(int(p.payload.nbytes) + p.table_bytes
+                         for p in plans)
+            wire_u = sum(p.final_len for p in plans)
+            crc = 0
+            for p in plans:
+                crc = zlib.crc32(p.payload, crc)
+            key = ("decode", self.backend, len(plans), wire_c, crc)
+            decoded = self._pex.run(Step(
+                key=key, site="decode", span="decode.device",
+                attrs={"blocks": len(plans), "wire_bytes": wire_c},
+                fn=lambda: decode_parsed(
+                    plans, backend=self.backend,
+                    interpret=self.interpret, stage=self._stage)))
+            self._c_dev.inc(len(plans))
+            self._c_wire_c.inc(wire_c)
+            self._c_wire_u.inc(wire_u)
+            for i, b in zip(order, decoded):
+                results[i] = b
+        return results

@@ -201,6 +201,29 @@ class ChunkPrefetcher:
         return False
 
 
+def stage_block_arrays(host_arrays: dict) -> dict:
+    """Stage one group of host arrays to the device, counted.
+
+    The compressed-wire staging step of ``--decode-device``
+    (ops/rans_device.py): the dict holds still-compressed block
+    payloads plus their table arrays, so the bytes recorded in the
+    existing ``prefetch.bytes_staged_total`` /
+    ``prefetch.bytes_transferred_total`` counters — and visible in the
+    stage spans wrapping the caller — drop to COMPRESSED size instead
+    of the inflated blocks. ``jax.device_put`` dispatch is
+    asynchronous, same as the chunk pipeline's transfer stage.
+    """
+    import jax
+
+    reg = obs.get_registry()
+    out = {k: jax.device_put(np.ascontiguousarray(a))
+           for k, a in host_arrays.items()}
+    nbytes = sum(int(a.nbytes) for a in host_arrays.values())
+    reg.counter("prefetch.bytes_staged_total").inc(nbytes)
+    reg.counter("prefetch.bytes_transferred_total").inc(nbytes)
+    return out
+
+
 def _null_timer():
     from ..utils.profiling import StageTimer
 

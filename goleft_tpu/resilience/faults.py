@@ -26,6 +26,10 @@ Sites are plain strings; the instrumented ones are
     pairhmm the pair-HMM forward's per-bucket dispatch
             (ops/pairhmm.py forward_pairs — CLI and serve paths
             both route through it, under a RetryPolicy)
+    decode  the device-resident entropy decode's per-container batch
+            dispatch (ops/rans_device.py DeviceBlockDecoder under
+            --decode-device — a content-keyed plan Step, retried
+            under the RetryPolicy like every other dispatch)
 
 Example: ``shard:after=3:kill`` SIGKILLs the process at the 3rd shard
 execution — the chaos smoke's mid-flight death; ``bgzf:every=100:p=0``

@@ -460,7 +460,8 @@ class CohortdepthExecutor:
                 int(req.get("window", 250)), int(req.get("mapq", 1)),
                 req.get("chrom", "") or "", req.get("bed") or None,
                 req.get("engine", "auto"),
-                bool(req.get("checkpoint")))
+                bool(req.get("checkpoint")),
+                bool(req.get("decode_device")))
 
     def cache_files(self, req: dict) -> list[str]:
         return list(req["bams"])
@@ -540,6 +541,7 @@ class CohortdepthExecutor:
                 stage_timer=self.metrics.timer if self.metrics
                 else None,
                 checkpoint=store,
+                decode_device=bool(p0.get("decode_device")),
             )
             use_native_fmt = native.get_lib() is not None
             bufs = [io.StringIO() for _ in reqs]

@@ -453,3 +453,41 @@ def test_fleet_throughput_entry_ingests(tmp_path):
     assert len(back) == 1
     assert back[0]["metrics"]["router_overhead_frac"] \
         == pytest.approx(0.087)
+
+
+def test_wire_decode_entry_ingests(tmp_path):
+    """The wire_decode bench entry (host scalar/vectorized vs device
+    scan vs Pallas MB/s plus the compressed/inflated wire ratio) lands
+    in the ledger with its nested host lanes flattened to dotted
+    metrics, so `perf check` trends every decode lane separately."""
+    entry = {
+        "blocks": 24, "block_bytes": 65536,
+        "payload": "ACGT-skewed / correlated quals / run-heavy",
+        "host": {"scalar_n4_mb_s": 1.7, "scalar_x32_mb_s": 1.75,
+                 "vectorized_x32_mb_s": 2.6,
+                 "vectorized_over_scalar_x32": 1.49},
+        "device_scan_mb_s": 52.3, "device_scan_gbases_s": 0.0523,
+        "device_pallas_mb_s": 0.12,
+        "wire_bytes_compressed": 401234,
+        "wire_bytes_uncompressed": 1572864,
+        "wire_ratio": 0.2551,
+        "platform": "cpu", "device": "TFRT_CPU_0",
+        "device_kind": "cpu",
+        "note": "device lanes byte-verified vs the host oracle",
+    }
+    recs = ledger.live_run_records({"wire_decode": entry}, None)
+    rec = {r["entry"]: r for r in recs}["wire_decode"]
+    # a cpu-labeled run is host provenance — device-scan rates stay
+    # CPU-labeled until the tunnel returns (the entry's own note)
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("host.scalar_n4_mb_s", "host.vectorized_x32_mb_s",
+                "device_scan_mb_s", "device_pallas_mb_s",
+                "wire_ratio"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["device_scan_mb_s"] == pytest.approx(52.3)
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "wire_decode"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["wire_ratio"] == pytest.approx(0.2551)
