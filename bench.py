@@ -1482,16 +1482,20 @@ def host_suite(quick: bool, emit=None) -> dict:
 
 
 def _wire_decode_entry(quick: bool) -> dict:
-    """rANS-Nx16 entropy decode throughput across the three lanes the
+    """rANS-Nx16 entropy decode throughput across the lanes the
     wire-gap work opened (ops/rans_device.py): the host decoder
     (per-symbol scalar vs the all-N-states-per-round vectorized loop,
     both interleave widths), the device lax.scan path (many blocks
     vmapped per bucket — the --decode-device product path), and the
-    experimental Pallas kernel (interpret-pinned on CPU-only hosts).
-    Plus the wire accounting that motivates the feature: bytes crossing
-    the link compressed (payload + int16 tables) vs inflated. Every
-    lane's output is asserted byte-identical to the host oracle before
-    its time is reported."""
+    experimental Pallas kernel (interpret-pinned on CPU-only hosts) —
+    now for the FULL method-5 matrix: the ``order1`` lanes time the
+    per-context (ctx, slot) gather scan against both host loops, and
+    the ``stripe`` lanes time the N'-sub-stream dispatch + batched
+    transpose-interleave. Plus the wire accounting that motivates the
+    feature: bytes crossing the link compressed (payload + int16
+    tables — ORDER1's compact context rows included) vs inflated.
+    Every lane's output is asserted byte-identical to the host oracle
+    before its time is reported; all lanes are median-of-3."""
     import jax as _jax
 
     from goleft_tpu.io import rans_nx16 as rx
@@ -1551,17 +1555,23 @@ def _wire_decode_entry(quick: bool) -> dict:
     host["vectorized_over_scalar_x32"] = round(
         host["vectorized_x32_mb_s"] / host["scalar_x32_mb_s"], 2)
 
+    def time_device(encs, lens, want):
+        """Median-of-3 device-scan wall, byte-verified first (warm
+        pass pays the compile)."""
+        got = rd.decode_streams(encs, lens)
+        assert got == want, "device lane must not fall back/diverge"
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            got = rd.decode_streams(encs, lens)
+            ts.append(time.perf_counter() - t0)
+        assert got == want
+        return sorted(ts)[1]
+
     all_encs = corp["n4"] + corp["x32"]
     all_lens = [bs] * len(all_encs)
     want = datas + datas
-    got = rd.decode_streams(all_encs, all_lens)  # warm/compile
-    assert got == want
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        got = rd.decode_streams(all_encs, all_lens)
-    dt_scan = (time.perf_counter() - t0) / reps
-    assert got == want
+    dt_scan = time_device(all_encs, all_lens, want)
 
     pn = 2 if quick else 4
     pal_encs, pal_lens = all_encs[:pn], all_lens[:pn]
@@ -1573,16 +1583,62 @@ def _wire_decode_entry(quick: bool) -> dict:
                               interpret=True)
     dt_pal = time.perf_counter() - t0
 
+    # ---- ORDER1: the same corpus re-encoded with per-context tables
+    # (the shape real quality/name series overwhelmingly take). Host
+    # scalar vs vectorized per interleave width, then the device
+    # (ctx, slot)-gather scan over both widths at once.
+    corp1 = {
+        lab: [rx.encode(d, order=1, x32=x32) for d in datas]
+        for lab, x32 in (("n4", False), ("x32", True))
+    }
+    o1_host = {
+        "scalar_n4_mb_s": round(time_host(corp1["n4"], 1 << 30), 2),
+        "scalar_x32_mb_s": round(time_host(corp1["x32"], 1 << 30), 2),
+        "vectorized_x32_mb_s": round(time_host(corp1["x32"], 4), 2),
+    }
+    o1_host["vectorized_over_scalar_x32"] = round(
+        o1_host["vectorized_x32_mb_s"] / o1_host["scalar_x32_mb_s"],
+        2)
+    o1_encs = corp1["n4"] + corp1["x32"]
+    dt_o1 = time_device(o1_encs, all_lens, want)
+    order1 = {
+        **o1_host,
+        "device_scan_mb_s": round(2 * total / dt_o1 / 1e6, 2),
+    }
+
+    # ---- STRIPE: 4 byte-interleaved lanes per block, each its own
+    # complete stream — N' sub-streams through the shared buckets +
+    # one batched transpose-interleave per shape.
+    st_encs = [rx.encode(d, stripe=4) for d in datas]
+    st_lens = [bs] * len(st_encs)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st_host_out = [rx.decode(e, bs) for e in st_encs]
+        ts.append(time.perf_counter() - t0)
+    assert st_host_out == datas
+    dt_st = time_device(st_encs, st_lens, datas)
+    stripe = {
+        "host_mb_s": round(total / sorted(ts)[1] / 1e6, 2),
+        "device_scan_mb_s": round(total / dt_st / 1e6, 2),
+    }
+
+    # wire accounting over the whole matrix (payloads + shipped
+    # tables: int16 freq rows for ORDER0, compact per-context rows
+    # for ORDER1, per-lane tables for STRIPE)
     wire_c = 0
-    for e in all_encs:
+    for e in all_encs + o1_encs + st_encs:
         p = rx.parse_nx16(e, bs)
-        wire_c += int(p.payload.nbytes) + p.table_bytes
-    wire_u = len(all_encs) * bs
+        wire_c += p.payload_bytes + p.table_bytes
+    wire_u = len(all_encs + o1_encs + st_encs) * bs
     return {
         "blocks": len(all_encs), "block_bytes": bs,
         "payload": "ACGT-skewed / correlated quals / run-heavy "
-                   "low-alphabet, pure entropy-coded (order-0)",
+                   "low-alphabet, pure entropy-coded "
+                   "(order-0/order-1/stripe)",
         "host": host,
+        "order1": order1,
+        "stripe": stripe,
         "device_scan_mb_s": round(2 * total / dt_scan / 1e6, 2),
         "device_scan_gbases_s": round(2 * total / dt_scan / 1e9, 4),
         "device_pallas_mb_s": round(pn * bs / dt_pal / 1e6, 3),

@@ -550,11 +550,17 @@ def read_block(buf: memoryview, pos: int,
 
 
 def write_block(method: int, ctype: int, cid: int, data: bytes,
-                rans_order: int = 0, v2: bool = False) -> bytes:
+                rans_order: int = 0, v2: bool = False,
+                rans_stripe: int = 0) -> bytes:
     if method == M_RANSNX16:
         from .rans_nx16 import encode as nx16_encode
 
+        # STRIPE only pays (and only exercises multi-lane framing)
+        # past a few lanes' worth of bytes; tiny blocks stay plain
         comp = nx16_encode(data, order=rans_order if len(data) >= 16
+                           else 0,
+                           stripe=rans_stripe
+                           if len(data) >= 16 * max(rans_stripe, 1)
                            else 0)
     elif method == M_ARITH:
         from .arith import encode as arith_encode
@@ -1697,7 +1703,8 @@ class CramWriter:
                  block_method: int = M_GZIP, ap_delta: bool = True,
                  rans_order: int = 0, minor: int = 0, major: int = 3,
                  series_methods: dict[str, int] | None = None,
-                 core_series: tuple = (), with_tags: bool = False):
+                 core_series: tuple = (), with_tags: bool = False,
+                 rans_stripe: int = 0):
         if major not in (2, 3):
             raise ValueError("cram: writer supports major 2 and 3")
         self._fh = fh
@@ -1705,6 +1712,7 @@ class CramWriter:
         self._rpc = records_per_container
         self._method = block_method
         self._rans_order = rans_order
+        self._rans_stripe = rans_stripe
         self._ap_delta = ap_delta
         self._v2 = major == 2
         # per-series block-method overrides, e.g. the htslib 3.1 shape
@@ -1982,7 +1990,8 @@ class CramWriter:
             else:
                 blocks += write_block(method, CT_EXTERNAL, cid, payload,
                                       rans_order=self._rans_order,
-                                      v2=self._v2)
+                                      v2=self._v2,
+                                      rans_stripe=self._rans_stripe)
         comp_block = write_block(M_RAW, CT_COMP_HEADER, 0,
                                  comp.serialize(), v2=self._v2)
         body = comp_block + blocks

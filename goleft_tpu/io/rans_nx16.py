@@ -30,6 +30,7 @@ expansion, then PACK expansion, mirroring the encoder's PACK→RLE→rans.
 from __future__ import annotations
 
 import struct
+import zlib
 from dataclasses import dataclass
 
 import numpy as np
@@ -285,33 +286,12 @@ def _encode_rans0(data: bytes, n_states: int = 4) -> bytes:
 
 # ------------------------------------------------------------ order 1
 
-def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
-    from . import native
-
-    head = buf[pos]
-    pos += 1
-    shift = head >> 4
-    if head & 1:
-        # compressed table: uncompressed size first, then its
-        # compressed byte count, then a bare rans-o0 stream. A full
-        # 256x256 uint7 table tops out well under 4MB — larger claims
-        # are corruption, rejected before any allocation.
-        ulen, pos = read_uint7(buf, pos)
-        clen, pos = read_uint7(buf, pos)
-        if ulen > 1 << 22:
-            raise ValueError("rans-nx16: implausible o1 table size")
-        table = _decode_rans0(buf, pos, ulen, 4)
-        pos += clen
-        tbuf, tpos = memoryview(table), 0
-        fast = native.ransnx16_decode1(buf, pos, table, 0, False,
-                                       shift, out_len, n_states)
-    else:
-        tbuf, tpos = buf, pos
-        fast = native.ransnx16_decode1(buf, pos, None, 0, True,
-                                       shift, out_len, n_states)
-    if fast is not None:
-        return fast
-    target = 1 << shift
+def _read_freqs1_rows(tbuf, tpos: int, target: int):
+    """The ORDER1 per-context frequency-row walk (shared by the host
+    decoder and ``parse_nx16``): ascending context alphabet, one uint7
+    row per context over the same alphabet, each row normalized to
+    ``target``. Returns (syms, freqs(256,256), cums(256,257), luts,
+    pos-after-rows)."""
     syms, tpos = _read_alphabet(tbuf, tpos)
     freqs = np.zeros((256, 256), dtype=np.int64)
     cums = np.zeros((256, 257), dtype=np.int64)
@@ -329,8 +309,16 @@ def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
         for s in np.nonzero(row)[0]:
             lut[cums[c][s]:cums[c][s + 1]] = s
         luts[c] = lut
-    if not (head & 1):
-        pos = tpos
+    return syms, freqs, cums, luts, tpos
+
+
+def _rans1_loop_scalar(buf, pos, out_len, n_states, shift, freqs,
+                       cums, luts):
+    """Reference ORDER1 loop (exact Python-int arithmetic): output
+    split into N contiguous slices (the last state carries the tail),
+    one symbol per state per round, each lane's previous symbol as its
+    context (starting at 0)."""
+    target = 1 << shift
     R = list(struct.unpack_from(f"<{n_states}I", buf, pos))
     pos += 4 * n_states
     out = bytearray(out_len)
@@ -363,6 +351,120 @@ def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
         if done:
             break
     return bytes(out)
+
+
+def _rans1_loop_vec(buf, pos, out_len, n_states, shift, freqs, cums,
+                    luts):
+    """ORDER1 twin of ``_rans0_loop_vec``: all N states stepped per
+    round with one packed (ctx, slot) gather. The main ``F = out_len
+    // N`` rounds keep every lane active (lane j writes out[j*F + r]);
+    the tail — the last lane's extra ``out_len - N*F`` symbols — runs
+    the scalar walk. Byte-identical to the scalar loop on every stream
+    the gate admits: int64 states stay Python-int-exact because
+    shift == TF_SHIFT bounds state growth (freq ≤ 2^12 and x ≥
+    f·(x>>12) renorm-free adds ≤ 4095/step — the shift < 12 regime,
+    where corrupt states could genuinely overflow int64, is gated to
+    the scalar loop), and the renorm byte order inside a round uses
+    the same exclusive-rank closed form."""
+    target = 1 << shift
+    mask = target - 1
+    R = np.array(struct.unpack_from(f"<{n_states}I", buf, pos),
+                 dtype=np.int64)
+    pos += 4 * n_states
+    n = len(buf)
+    # packed (ctx, slot) table: freq<<20 | (m - cum[ctx][sym])<<8 | sym
+    # (freq ≤ 4096 above bit 20, bias < 4096 in bits 8..19); absent
+    # contexts keep a row of zeros and are caught by `valid` before
+    # any lane consumes them — the scalar loop's missing-context raise
+    valid = np.zeros(256, dtype=bool)
+    T = np.zeros((256, target), dtype=np.int64)
+    ms = np.arange(target, dtype=np.int64)
+    for c, lut in luts.items():
+        valid[c] = True
+        li = lut.astype(np.int64)
+        T[c] = (freqs[c][li] << 20) | ((ms - cums[c][li]) << 8) | li
+    byts = np.frombuffer(buf, dtype=np.uint8).astype(np.int64)
+    b16 = byts[:-1].copy() if n > 1 else np.zeros(0, np.int64)
+    if n > 1:
+        b16 |= byts[1:] << 8
+    N = n_states
+    F = out_len // N
+    last = np.zeros(N, dtype=np.int64)
+    out2 = np.empty((max(F, 1), N), dtype=np.int64)
+    for r in range(F):
+        if not valid[last].all():
+            raise ValueError("rans-nx16: missing order-1 context")
+        t = T[last, R & mask]
+        R = (t >> 20) * (R >> shift) + ((t >> 8) & mask)
+        last = t & 0xFF
+        out2[r] = last
+        want = R < RANS_LOW
+        nw = int(want.sum())
+        if nw:
+            avail = (n - pos) >> 1
+            if nw > avail:
+                want &= (np.cumsum(want) - want) < avail
+                nw = int(want.sum())
+            w = np.flatnonzero(want)
+            R[w] = (R[w] << 16) | b16[pos + 2 * np.arange(nw)]
+            pos += 2 * nw
+    out = np.empty(out_len, dtype=np.uint8)
+    # out2[r, j] is out[j*F + r]: transpose to lane-major order
+    out[:F * N] = out2[:F].T.reshape(-1).astype(np.uint8)[:F * N]
+    # tail: only the last lane remains active, scalar order
+    x = int(R[N - 1])
+    c = int(last[N - 1]) if F > 0 else 0
+    for p in range(N * F, out_len):
+        if c not in luts:
+            raise ValueError("rans-nx16: missing order-1 context")
+        m = x & mask
+        s = int(luts[c][m])
+        out[p] = s
+        x = int(freqs[c][s]) * (x >> shift) + m - int(cums[c][s])
+        if x < RANS_LOW and pos + 1 < n:
+            x = (x << 16) | buf[pos] | (buf[pos + 1] << 8)
+            pos += 2
+        c = s
+    return bytes(out)
+
+
+def _decode_rans1(buf, pos: int, out_len: int, n_states: int) -> bytes:
+    from . import native
+
+    head = buf[pos]
+    pos += 1
+    shift = head >> 4
+    if head & 1:
+        # compressed table: uncompressed size first, then its
+        # compressed byte count, then a bare rans-o0 stream. A full
+        # 256x256 uint7 table tops out well under 4MB — larger claims
+        # are corruption, rejected before any allocation.
+        ulen, pos = read_uint7(buf, pos)
+        clen, pos = read_uint7(buf, pos)
+        if ulen > 1 << 22:
+            raise ValueError("rans-nx16: implausible o1 table size")
+        table = _decode_rans0(buf, pos, ulen, 4)
+        pos += clen
+        tbuf, tpos = memoryview(table), 0
+        fast = native.ransnx16_decode1(buf, pos, table, 0, False,
+                                       shift, out_len, n_states)
+    else:
+        tbuf, tpos = buf, pos
+        fast = native.ransnx16_decode1(buf, pos, None, 0, True,
+                                       shift, out_len, n_states)
+    if fast is not None:
+        return fast
+    target = 1 << shift
+    syms, freqs, cums, luts, tpos = _read_freqs1_rows(tbuf, tpos,
+                                                      target)
+    if not (head & 1):
+        pos = tpos
+    # the vectorized loop is exact only in the shift == TF_SHIFT
+    # regime (see its docstring); foreign shifts keep the scalar oracle
+    loop = _rans1_loop_vec if (n_states >= VEC_MIN_STATES
+                               and shift == TF_SHIFT) \
+        else _rans1_loop_scalar
+    return loop(buf, pos, out_len, n_states, shift, freqs, cums, luts)
 
 
 def _encode_rans1(data: bytes, n_states: int = 4) -> bytes:
@@ -639,14 +741,20 @@ def decode(data: bytes, expected_len: int | None = None) -> bytes:
 @dataclass
 class ParsedNx16:
     """Layout of one rANS-Nx16 stream whose flag combo the device
-    decoder supports (ORDER0 × CAT × PACK × RLE × NOSZ, N=4/32).
+    decoder supports (ORDER0/ORDER1 × CAT × PACK × RLE × NOSZ,
+    N=4/32, plus STRIPE containers of supported sub-streams).
 
     ``payload`` is the still-compressed byte span (the rANS renorm
     stream, or the raw bytes for CAT) — what actually crosses the
     wire under ``--decode-device``; ``freq``/``cum`` are the shipped
     int32 table arrays the device expands into its 4096-entry slot
-    tables. ``table_bytes`` counts the shipped table/metadata arrays
-    for wire accounting."""
+    tables. ORDER1 ships the COMPACT per-context rows instead:
+    ``ctx_freq`` holds one int32 row per context present in the
+    alphabet and ``ctx_index`` maps context symbol → row (−1 marks an
+    absent context, the device diag for the host's missing-context
+    error). A STRIPE stream is a container: ``children`` holds one
+    ParsedNx16 per byte-interleaved lane. ``table_bytes`` counts the
+    shipped table/metadata arrays for wire accounting."""
 
     flags: int
     n_states: int
@@ -665,17 +773,33 @@ class ParsedNx16:
     pack_bits: int = 0
     pack_map: np.ndarray | None = None  # (16,) int32 (padded)
     pack_nsym: int = 0
+    order1: bool = False
+    shift: int = TF_SHIFT     # ORDER1 frequency precision (target=2^s)
+    n_ctx: int = 0            # contexts present in the alphabet
+    ctx_index: np.ndarray | None = None  # (256,) int16 ctx → row | -1
+    ctx_freq: np.ndarray | None = None   # (n_ctx, 256) int32 rows
+    stripe: bool = False
+    n_lanes: int = 0
+    children: list["ParsedNx16"] | None = None
 
     @property
     def table_bytes(self) -> int:
         """Logical bytes of the table/metadata arrays as they ship
         over the wire: freq goes int16 and cum is expanded on device
-        (a cumsum), so a non-CAT block pays ~0.5KB of table."""
+        (a cumsum), so a non-CAT ORDER0 block pays ~0.5KB of table
+        while an ORDER1 block pays ~(n_ctx+2)·0.5KB for its compact
+        context rows plus the ctx→row map."""
+        if self.stripe:
+            return sum(ch.table_bytes for ch in self.children or [])
         n = 0
         if self.states is not None:
             n += int(self.states.nbytes)
         if self.freq is not None:
             n += 256 * 2  # int16 on the wire; cum derives on device
+        if self.ctx_freq is not None:
+            # compact int16 rows + the int16 ctx→row map; per-context
+            # cum rows and slot tables derive on device
+            n += self.ctx_freq.shape[0] * 256 * 2 + 256 * 2
         if self.rle_tab is not None:
             n += int(self.rle_tab.nbytes)
         if self.rle_runs is not None:
@@ -684,21 +808,54 @@ class ParsedNx16:
             n += int(self.pack_map.nbytes)
         return n
 
+    @property
+    def payload_bytes(self) -> int:
+        """Compressed payload bytes crossing the wire (children's for
+        a STRIPE container)."""
+        if self.stripe:
+            return sum(ch.payload_bytes for ch in self.children or [])
+        return int(self.payload.nbytes)
+
+    def payload_crc(self, crc: int = 0) -> int:
+        if self.stripe:
+            for ch in self.children or []:
+                crc = ch.payload_crc(crc)
+            return crc
+        return zlib.crc32(self.payload, crc)
+
+    def table_crc(self, crc: int = 0) -> int:
+        """CRC over every shipped table/metadata array — joins the
+        decode Step's content key so two blocks with identical
+        payloads but different tables never alias."""
+        if self.stripe:
+            for ch in self.children or []:
+                crc = ch.table_crc(crc)
+            return crc
+        for a in (self.states, self.freq, self.ctx_index,
+                  self.ctx_freq, self.rle_tab, self.rle_runs,
+                  self.pack_map):
+            if a is not None:
+                crc = zlib.crc32(np.ascontiguousarray(a).tobytes(),
+                                 crc)
+        return crc
+
 
 def parse_nx16(data: bytes,
                expected_len: int | None = None) -> ParsedNx16 | None:
     """Parse one stream's layout for device decode; None when the
-    combo stays host-side (ORDER1, STRIPE, missing external size, or
-    any inconsistency the host decoder would surface its own way —
-    returning None always degrades to the host path, so a foreign or
-    corrupt stream decodes (or fails) exactly as before."""
+    combo stays host-side (missing external size, shifts outside the
+    device table range, or any inconsistency the host decoder would
+    surface its own way — returning None always degrades to the host
+    path, so a foreign or corrupt stream decodes (or fails) exactly
+    as before). ORDER1 tables parse here (CRAM serializes them
+    order-0-compressed — a host-cheap O(table) walk); STRIPE parses
+    each byte-interleaved lane recursively and is supported exactly
+    when every lane is."""
     try:
         buf = memoryview(data)
         pos = 0
         flags = buf[pos]
         pos += 1
-        if flags & (F_ORDER1 | F_STRIPE):
-            return None
         if flags & F_NOSZ:
             if expected_len is None:
                 return None
@@ -707,6 +864,32 @@ def parse_nx16(data: bytes,
             out_len, pos = read_uint7(buf, pos)
             if expected_len is not None and out_len != expected_len:
                 return None  # host raises the canonical error
+        if flags & F_STRIPE:
+            # mirrors decode(): the stripe container ignores PACK/RLE
+            # bits; each lane is its own complete Nx16 stream
+            n_lanes = buf[pos]
+            pos += 1
+            if n_lanes == 0:
+                return None  # host raises (or yields b"" for len 0)
+            clens = []
+            for _ in range(n_lanes):
+                c, pos = read_uint7(buf, pos)
+                clens.append(c)
+            children = []
+            for j in range(n_lanes):
+                lane_len = (out_len - j + n_lanes - 1) // n_lanes
+                ch = parse_nx16(bytes(buf[pos:pos + clens[j]]),
+                                lane_len)
+                if ch is None:
+                    return None  # one host-side lane → whole block
+                children.append(ch)
+                pos += clens[j]
+            return ParsedNx16(
+                flags=flags, n_states=0, cat=False,
+                final_len=out_len, inner_len=out_len,
+                payload=np.zeros(0, np.uint8), states=None,
+                freq=None, cum=None, stripe=True, n_lanes=n_lanes,
+                children=children)
         n_states = 32 if flags & F_X32 else 4
 
         parsed = ParsedNx16(
@@ -767,6 +950,47 @@ def parse_nx16(data: bytes,
             if payload.shape[0] < out_len:
                 return None  # truncated: host fails its own way
             parsed.payload = payload.copy()
+        elif flags & F_ORDER1:
+            head = buf[pos]
+            pos += 1
+            shift = head >> 4
+            if not (1 <= shift <= TF_SHIFT):
+                # target beyond 4096 (foreign) would blow the device
+                # slot-table shape; host handles it
+                return None
+            target = 1 << shift
+            if head & 1:
+                ulen, pos = read_uint7(buf, pos)
+                clen, pos = read_uint7(buf, pos)
+                if ulen > 1 << 22:
+                    return None  # host raises the canonical error
+                table = _decode_rans0(buf, pos, ulen, 4)
+                pos += clen
+                syms, freqs, cums, _, _ = _read_freqs1_rows(
+                    memoryview(table), 0, target)
+            else:
+                syms, freqs, cums, _, pos = _read_freqs1_rows(
+                    buf, pos, target)
+            ctx_index = np.full(256, -1, dtype=np.int16)
+            rows = []
+            for k, c in enumerate(syms):
+                if int(cums[c][256]) != target:
+                    # zero/degenerate row: the host's lut-of-zeros
+                    # semantics aren't reproducible by the device
+                    # searchsorted expansion — keep host semantics
+                    return None
+                ctx_index[c] = k
+                rows.append(freqs[c])
+            parsed.order1 = True
+            parsed.shift = shift
+            parsed.n_ctx = len(syms)
+            parsed.ctx_index = ctx_index
+            parsed.ctx_freq = np.stack(rows).astype(np.int32)
+            parsed.states = np.array(
+                struct.unpack_from(f"<{n_states}I", buf, pos),
+                dtype=np.uint32)
+            pos += 4 * n_states
+            parsed.payload = np.frombuffer(buf[pos:], np.uint8).copy()
         else:
             freqs, pos = _read_freqs0(buf, pos)
             cum = np.zeros(257, dtype=np.int64)

@@ -1,15 +1,21 @@
 """Device-decode smoke: the ``make decode-smoke`` body.
 
 Real ``goleft-tpu cohortdepth`` subprocesses over a hermetic CRAM
-cohort whose blocks are rANS-Nx16 — two samples device-decodable
-(ORDER0) and one that forces the per-block host fallback (ORDER1):
+cohort whose blocks are rANS-Nx16 spanning the full method-5 matrix —
+ORDER0, ORDER1 (per-context tables), and STRIPE samples, ALL
+device-decodable since the ORDER1/STRIPE scan landed:
 
   1. the default run and the ``--decode-device`` run produce
      BYTE-IDENTICAL matrices (the tentpole's contract: the wire format
      changed, the bytes did not);
   2. the ``--decode-device`` run's ``--metrics-out`` manifest carries
-     the decode counters — device blocks > 0, fallbacks > 0 (the
-     ORDER1 sample), wire bytes compressed < uncompressed visible;
+     the decode counters — device blocks > 0, fallbacks == 0 (the
+     ORDER1 sample that used to force per-block host fallbacks now
+     decodes on device; any fallback is a matrix regression), wire
+     byte counters and the ORDER1 table share
+     (``decode.table_bytes_total``) visible (on tiny fixture blocks
+     the per-block table floor dominates — the ratio only wins at
+     CRAM-typical block sizes, which the bench records);
   3. an injected transient fault at the ``decode`` site is retried
      under the RetryPolicy to the same byte-identical output (the
      decode step is a real plan Step, not a bare device call).
@@ -30,9 +36,11 @@ import tempfile
 
 def make_cram_cohort(d: str, ref_len: int = 50_000,
                      n_reads: int = 400) -> tuple[list[str], str]:
-    """(cram paths, fai): three single-chromosome CRAMs with .crai,
-    rANS-Nx16 blocks; the third is written ORDER1 so its data-series
-    blocks exercise the host-fallback path under --decode-device."""
+    """(cram paths, fai): four single-chromosome CRAMs with .crai,
+    rANS-Nx16 blocks spanning the method-5 matrix — two ORDER0, one
+    ORDER1 (per-context tables, order-0-compressed on the wire) and
+    one STRIPE (4 byte-interleaved lanes per block), so
+    --decode-device exercises every device decode shape."""
     import numpy as np
 
     from ..io import cram
@@ -40,7 +48,8 @@ def make_cram_cohort(d: str, ref_len: int = 50_000,
 
     rng = np.random.default_rng(7)
     paths = []
-    for i, order in enumerate((0, 0, 1)):
+    for i, (order, stripe) in enumerate(
+            ((0, 0), (0, 0), (1, 0), (0, 4))):
         hdr = f"@HD\tVN:1.6\tSO:coordinate\n@RG\tID:r\tSM:cr{i}\n"
         p = os.path.join(d, f"cr{i}.cram")
         reads = sorted(
@@ -50,7 +59,8 @@ def make_cram_cohort(d: str, ref_len: int = 50_000,
             with cram.CramWriter(fh, hdr, ["chr1"], [ref_len],
                                  records_per_container=150,
                                  block_method=cram.M_RANSNX16,
-                                 rans_order=order, minor=1) as w:
+                                 rans_order=order, minor=1,
+                                 rans_stripe=stripe) as w:
                 for j, (tid, pos, cig, mq, fl) in enumerate(reads):
                     w.write_record(tid, pos, parse_cigar(cig),
                                    mapq=mq, flag=fl, name=f"r{j:04d}")
@@ -104,25 +114,30 @@ def run_smoke(timeout_s: float = 240.0, verbose: bool = True) -> int:
         wire_c = counters.get("decode.wire_bytes_compressed_total", 0)
         wire_u = counters.get(
             "decode.wire_bytes_uncompressed_total", 0)
+        table_b = counters.get("decode.table_bytes_total", 0)
         if dev <= 0:
             raise RuntimeError(
                 "manifest shows no device-decoded blocks "
                 f"(counters: {sorted(counters)[:12]})")
-        if fall <= 0:
+        if fall != 0:
             raise RuntimeError(
-                "ORDER1 sample produced no host fallbacks — the "
-                "fallback path did not engage")
+                f"{fall} host fallbacks on a fully-supported cohort "
+                "— the ORDER1/STRIPE device matrix regressed")
         if not (0 < wire_c and 0 < wire_u):
             raise RuntimeError("wire byte counters missing")
+        if table_b <= 0:
+            raise RuntimeError(
+                "decode.table_bytes_total missing — ORDER1 table "
+                "wire accounting not recorded")
         if verbose:
             print(f"decode-smoke: manifest ok (device blocks={dev}, "
                   f"fallbacks={fall}, wire {wire_c}B compressed / "
-                  f"{wire_u}B inflated)")
+                  f"{wire_u}B inflated, {table_b}B tables)")
 
         fault_env = dict(env,
                          GOLEFT_TPU_FAULTS="decode:after=1:transient")
-        retried = _run(base_cmd[:-3] + ["--decode-device"] + crams,
-                       fault_env, timeout_s)
+        retried = _run(base_cmd[:-len(crams)] + ["--decode-device"]
+                       + crams, fault_env, timeout_s)
         if retried != plain:
             raise RuntimeError(
                 "injected transient decode fault was not retried to "

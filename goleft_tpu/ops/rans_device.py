@@ -29,18 +29,42 @@ pointer); each round is pure vector math plus gathers:
     bytes-left guard truncates at the same lane the scalar loop stops
     at, because a denied lane leaves every later lane denied too.
 
+ORDER1 fits the same scan shape: the per-context frequency rows
+become a ``(ctx, slot)`` gather against a ``(n_ctx, 2^shift)`` slot
+table expanded on device by the same searchsorted (one row per
+context present in the shipped compact table — CRAM serializes these
+tables themselves order-0-compressed; ``io/rans_nx16.py`` parses them
+host-side, O(table) not O(payload)). Each of the N interleaved states
+carries its PREVIOUS SYMBOL as a context lane in the scan carry, and
+the N lanes decode contiguous output slices (lane j owns
+``[j·F, (j+1)·F)`` with the last lane carrying the tail) exactly as
+the host oracle walks them — the post-scan gather maps the
+round-major scan output back to lane-sliced order. A context absent
+from the table raises the host's missing-context error via a carried
+diagnostic bit.
+
 CAT blocks skip the scan (payload = literals); RLE and PACK expansion
 run as vectorized gathers on the scan/CAT output (cumsum + searchsorted
-for run expansion, shift/mask gathers for bit-unpacking), completing
-the supported combo matrix ORDER0 × CAT × PACK × RLE × NOSZ for both
-N=4 and X32. ORDER1 and STRIPE stay host-side this PR (counted in
-``decode.device_fallback_total``).
+for run expansion, shift/mask gathers for bit-unpacking). STRIPE
+containers dispatch their N' byte-interleaved sub-streams through the
+same bucketed machinery (each lane is a complete Nx16 stream), then a
+batched transpose-interleave gather reassembles the container — one
+call per stripe signature. Together: the full CRAM 3.1 method-5
+matrix ORDER0/ORDER1 × CAT × PACK × RLE × NOSZ × STRIPE for both
+N=4 and X32 decodes device-resident; only corrupt/foreign streams
+fall back (``decode.device_fallback_total``).
 
 Parallelism and compiles: one block is only N lanes wide, so the real
 vector width comes from vmapping over many blocks at once. Blocks pad
 to power-of-two bucket signatures (payload length, round count,
 expansion caps) exactly like ops/pairhmm.py's length bucketing, so a
-whole cohort compiles O(#buckets) programs, not O(#shapes).
+whole cohort compiles O(#buckets) programs, not O(#shapes). With
+ORDER1 × STRIPE the signature space is wider, so a process-wide cap
+(``MAX_BUCKET_SIGNATURES``) bounds total compiles: blocks whose NEW
+signature would exceed it decode on host (a per-block fallback, not
+an error), visible via ``decode.bucket_signatures`` /
+``decode.bucket_cap_fallback_total`` and one log line when the cap
+first trips.
 
 An experimental Pallas variant (``pallas_decode0``) mirrors
 ops/pallas_coverage.py — one block per sequential grid step, lanes as
@@ -58,21 +82,40 @@ codecs, byte-identically.
 
 from __future__ import annotations
 
-import zlib
+import threading
 
 import numpy as np
 
 from ..io import rans_nx16 as _rx
 from ..io.rans_nx16 import ParsedNx16, parse_nx16
 from ..obs import get_registry
+from ..obs.logging import get_logger
 
 TF_SHIFT = _rx.TF_SHIFT
 TOTFREQ = _rx.TOTFREQ
 RANS_LOW = _rx.RANS_LOW
 
+log = get_logger("ops.rans_device")
+
 #: minimum pad bucket for payload/output axes (pow-2 above, like
 #: pairhmm's BUCKET: arbitrary block sizes compile O(#buckets))
 MIN_BUCKET = 64
+
+#: process-wide cap on DISTINCT compile signatures (decode buckets +
+#: stripe interleave shapes). Each signature is one XLA program kept
+#: for the process lifetime; ORDER1 adds (shift, n_ctx_cap) axes and
+#: STRIPE multiplies by lane shapes, so an adversarial cohort could
+#: otherwise force unbounded compiles. Blocks whose NEW signature
+#: would exceed the cap decode on host — a per-block fallback, never
+#: an error. Sizing: a real cohort's blocks share a writer, so its
+#: shapes collapse to a handful of pow-2 buckets per (N, flags)
+#: combo — the 4-sample mixed-matrix smoke cohort compiles ~50;
+#: 128 leaves 2-3x headroom before the graceful degradation starts.
+MAX_BUCKET_SIGNATURES = 128
+
+_SIG_LOCK = threading.Lock()
+_SEEN_SIGS: set[tuple] = set()
+_CAP_TRIPPED = False
 
 
 def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
@@ -82,34 +125,148 @@ def bucket(n: int, minimum: int = MIN_BUCKET) -> int:
     return b
 
 
+def reset_signature_registry() -> None:
+    """Test hook: forget admitted signatures (the jit cache keeps its
+    compiled programs — this only re-opens admission)."""
+    global _CAP_TRIPPED
+    with _SIG_LOCK:
+        _SEEN_SIGS.clear()
+        _CAP_TRIPPED = False
+
+
+def _admit_signatures(sigs: list[tuple]) -> bool:
+    """Admit a block's compile signatures against the process cap,
+    all-or-nothing (a stripe block needs every lane signature plus its
+    interleave shape). Over the cap, NEW signatures are refused and
+    the block falls back to the host codec; already-seen signatures
+    always pass (their programs exist)."""
+    global _CAP_TRIPPED
+    with _SIG_LOCK:
+        # dict.fromkeys dedupes in the caller's deterministic order
+        # (signatures mix tuple layouts, so they don't sort)
+        fresh = [s for s in dict.fromkeys(sigs)
+                 if s not in _SEEN_SIGS]
+        if not fresh:
+            return True
+        if len(_SEEN_SIGS) + len(fresh) > MAX_BUCKET_SIGNATURES:
+            if not _CAP_TRIPPED:
+                _CAP_TRIPPED = True
+                log.warning(
+                    "decode: bucket-signature cap reached (%d); new "
+                    "block shapes fall back to the host codec "
+                    "(decode.bucket_cap_fallback_total counts them)",
+                    MAX_BUCKET_SIGNATURES)
+            return False
+        _SEEN_SIGS.update(fresh)
+        get_registry().counter("decode.bucket_signatures").inc(
+            len(fresh))
+        return True
+
+
 # ------------------------------------------------------------ XLA path
 
 # jax.jit is applied lazily in _jitted() — this module must import
 # without jax (the jax-free fleet/router processes import the package)
 def _decode_bucket_impl(payload, plen, states, freq, inner_len,
                         rle_tab, runs, rle_out, pmap, bits, final_len,
-                        *, rounds, n_states, cat, rle, pack, lit_cap,
+                        ctx_index, ctx_freq, *, rounds, n_states, cat,
+                        rle, pack, order1, shift, n_ctx_cap, lit_cap,
                         mid_cap, out_cap):
     """One padded bucket: (B, …) arrays → ((B, out_cap) uint8 bytes,
-    (B, 3) int32 diagnostics [rle_total, marked_total, pack_vmax]).
+    (B, 4) int32 diagnostics [rle_total, marked_total, pack_vmax,
+    missing_ctx]).
 
-    Static flags (cat/rle/pack) specialize the program per combo; the
-    identity stages compile away. All shapes are the bucket caps, all
-    true lengths are traced scalars — one compile per signature.
+    Static flags (cat/rle/pack/order1) specialize the program per
+    combo; the identity stages compile away. All shapes are the bucket
+    caps, all true lengths are traced scalars — one compile per
+    signature.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     N = n_states
-    lanes = jnp.arange(N, dtype=jnp.int32)
+    lanes = jnp.arange(max(N, 1), dtype=jnp.int32)
     ms = jnp.arange(TOTFREQ, dtype=jnp.int32)
 
     def one(payload, plen, R0, freq, inner_len, rle_tab, runs,
-            rle_out, pmap, bits, final_len):
+            rle_out, pmap, bits, final_len, ctx_index, ctx_freq):
         P = payload.shape[0]
+        bad_ctx = jnp.int32(0)
         if cat:
             lit = payload[:lit_cap]
+        elif order1:
+            # per-context slot tables: the shipped compact (n_ctx,
+            # 256) rows expand into (n_ctx_cap, 2^shift) sym/freq/
+            # bias tables by the same searchsorted used for ORDER0 —
+            # the slot lookup becomes a (ctx_row, slot) gather. Each
+            # lane carries its previous symbol; ctx_index maps it to
+            # its table row (-1 = context absent from the alphabet →
+            # the host's missing-context error, carried as a diag
+            # bit). Lane j decodes the contiguous slice [j·F,
+            # (j+1)·F) with the last lane carrying the tail, so the
+            # active mask is per-lane-length, not round-robin.
+            target = 1 << shift
+            ms1 = jnp.arange(target, dtype=jnp.int32)
+            cf = ctx_freq.astype(jnp.int32)
+            cum1 = jnp.concatenate([
+                jnp.zeros((n_ctx_cap, 1), jnp.int32),
+                jnp.cumsum(cf, axis=1, dtype=jnp.int32)], axis=1)
+            sym1 = jnp.clip(jax.vmap(
+                lambda c: jnp.searchsorted(c, ms1, side="right"))(
+                    cum1).astype(jnp.int32) - 1, 0, 255)
+            freq1 = jnp.take_along_axis(cf, sym1, axis=1) \
+                .astype(jnp.uint32)
+            bias1 = (ms1[None, :] - jnp.take_along_axis(
+                cum1, sym1, axis=1)).astype(jnp.uint32)
+            ci = ctx_index.astype(jnp.int32)
+            F = inner_len // N
+            rem = inner_len - F * N
+            lens = F + jnp.where(lanes == N - 1, rem, 0)
+
+            def round1_fn(carry, r):
+                R, pos, last, bad = carry
+                active = r < lens
+                row = ci[last]
+                bad = bad | jnp.any(
+                    active & (row < 0)).astype(jnp.int32)
+                rowc = jnp.clip(row, 0, n_ctx_cap - 1)
+                m = (R & jnp.uint32(target - 1)).astype(jnp.int32)
+                s = sym1[rowc, m]
+                x = freq1[rowc, m] * (R >> jnp.uint32(shift)) \
+                    + bias1[rowc, m]
+                want = active & (x < jnp.uint32(RANS_LOW))
+                avail = jnp.maximum(jnp.int32(0), (plen - pos) // 2)
+                wi = want.astype(jnp.int32)
+                rank = jnp.cumsum(wi, dtype=jnp.int32) - wi
+                need = want & (rank < avail)
+                offs = pos + 2 * rank
+                b0 = payload[jnp.clip(offs, 0, P - 1)] \
+                    .astype(jnp.uint32)
+                b1 = payload[jnp.clip(offs + 1, 0, P - 1)] \
+                    .astype(jnp.uint32)
+                xr = (x << jnp.uint32(16)) | b0 | (b1 << jnp.uint32(8))
+                x = jnp.where(need, xr, x)
+                R = jnp.where(active, x, R)
+                pos = pos + 2 * jnp.sum(need, dtype=jnp.int32)
+                last = jnp.where(active, s, last)
+                return (R, pos, last, bad), s.astype(jnp.uint8)
+
+            (_, _, _, bad_ctx), syms = lax.scan(
+                round1_fn,
+                (R0, jnp.int32(0),
+                 jnp.zeros(N, jnp.int32), jnp.int32(0)),
+                jnp.arange(rounds, dtype=jnp.int32))
+            # syms[r, j] is out[j·F + r]: gather back to lane-sliced
+            # linear order (position p belongs to lane
+            # min(p // F, N-1) — every p ≥ (N-1)·F is the last lane's)
+            pidx = jnp.arange(lit_cap, dtype=jnp.int32)
+            jl = jnp.where(pidx < (N - 1) * F,
+                           pidx // jnp.maximum(F, 1),
+                           jnp.int32(N - 1))
+            rr = pidx - jl * F
+            lit = syms.reshape(rounds * N)[
+                jnp.clip(rr * N + jl, 0, rounds * N - 1)]
         else:
             # the wire ships only the int16 frequency row (~0.5KB);
             # cum and the 4096-entry slot tables expand on device. The
@@ -203,11 +360,31 @@ def _decode_bucket_impl(payload, plen, states, freq, inner_len,
             vmax = jnp.int32(0)
         del mid_len
         diag = jnp.stack([rle_total.astype(jnp.int32),
-                          marked_total, vmax])
+                          marked_total, vmax, bad_ctx])
         return outb, diag
 
     return jax.vmap(one)(payload, plen, states, freq, inner_len,
-                         rle_tab, runs, rle_out, pmap, bits, final_len)
+                         rle_tab, runs, rle_out, pmap, bits,
+                         final_len, ctx_index, ctx_freq)
+
+
+def _interleave_impl(lanes_arr, final_len, *, n_lanes, out_cap):
+    """Batched STRIPE reassembly: (B, n_lanes, lane_cap) decoded lane
+    bytes → (B, out_cap) interleaved output. Output position i comes
+    from lane ``i mod N'`` at offset ``i // N'`` — the transpose-
+    interleave the host does with strided assignment, as one gather
+    per stripe signature."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.arange(out_cap, dtype=jnp.int32)
+
+    def one(lanes_b, flen):
+        out = lanes_b[idx % n_lanes, idx // n_lanes]
+        return jnp.where(idx < flen, out, jnp.uint8(0)) \
+            .astype(jnp.uint8)
+
+    return jax.vmap(one)(lanes_arr, final_len)
 
 
 _JIT_CACHE: dict = {}
@@ -219,9 +396,20 @@ def _jitted():
         import jax
 
         fn = jax.jit(_decode_bucket_impl, static_argnames=(
-            "rounds", "n_states", "cat", "rle", "pack", "lit_cap",
-            "mid_cap", "out_cap"))
+            "rounds", "n_states", "cat", "rle", "pack", "order1",
+            "shift", "n_ctx_cap", "lit_cap", "mid_cap", "out_cap"))
         _JIT_CACHE["xla"] = fn
+    return fn
+
+
+def _jitted_interleave():
+    fn = _JIT_CACHE.get("ilv")
+    if fn is None:
+        import jax
+
+        fn = jax.jit(_interleave_impl,
+                     static_argnames=("n_lanes", "out_cap"))
+        _JIT_CACHE["ilv"] = fn
     return fn
 
 
@@ -362,12 +550,18 @@ def _pallas_scan_bytes(group: list[ParsedNx16], n_states: int,
 def _signature(p: ParsedNx16) -> tuple:
     """Pad-to-bucket compile signature (pairhmm-style): every axis
     rounds up to a power of two so arbitrary cohorts stay O(#buckets)
-    compiles."""
+    compiles. ORDER1 adds (shift, n_ctx_cap) axes and widens the
+    round count by N-1 (the last lane's tail rounds beyond F)."""
     n = p.n_states
     lit_cap = bucket(max(p.inner_len, 1))
     if not p.cat:
         rounds = (lit_cap + n - 1) // n
         lit_cap = rounds * n
+        if p.order1:
+            # lane j needs F = inner//N rounds, the last lane F+rem
+            # with rem < N; F ≤ lit_cap//N so this covers every block
+            # in the bucket
+            rounds += n - 1
     else:
         rounds = 0
     p_cap = bucket(max(p.payload.shape[0], 1))
@@ -377,36 +571,47 @@ def _signature(p: ParsedNx16) -> tuple:
     out_cap = bucket(max(p.final_len, 1)) if p.pack else mid_cap
     runs_cap = bucket(len(p.rle_runs) if p.rle_runs is not None
                       else 0, minimum=16)
-    return (n, p.cat, p.rle, p.pack, rounds, p_cap, lit_cap, mid_cap,
-            out_cap, runs_cap)
+    shift = p.shift if p.order1 else TF_SHIFT
+    n_ctx_cap = bucket(max(p.n_ctx, 1), minimum=16) if p.order1 else 1
+    return (n, p.cat, p.order1, shift, p.rle, p.pack, rounds, p_cap,
+            lit_cap, mid_cap, out_cap, runs_cap, n_ctx_cap)
 
 
-def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
-                  interpret: bool = False,
-                  stage=None) -> list[bytes]:
-    """Decode parsed streams on device, bucketed + vmapped; returns
-    bytes per stream, byte-identical to ``rans_nx16.decode``.
+def _stripe_shape(p: ParsedNx16) -> tuple[int, int, int]:
+    """(n_lanes, lane_cap, out_cap) of a stripe container's batched
+    interleave dispatch."""
+    lane_cap = bucket(max((p.final_len + p.n_lanes - 1) // p.n_lanes,
+                          1))
+    return (p.n_lanes, lane_cap, bucket(max(p.final_len, 1)))
 
-    ``backend``: "scan" (the XLA product path) or "pallas" (the
-    experimental kernel for the rANS stage; expansions shared).
-    ``stage``: optional callable mapping a dict of host arrays to
-    device arrays (parallel.prefetch.stage_block_arrays — the
-    compressed-wire staging/accounting step); default stages without
-    accounting.
-    """
+
+def plan_signatures(p: ParsedNx16) -> list[tuple]:
+    """Every compile signature decoding this block requires (a stripe
+    container needs each lane's bucket plus its interleave shape) —
+    the admission unit for the ``MAX_BUCKET_SIGNATURES`` cap."""
+    if p.stripe:
+        sigs = [_signature(ch) for ch in p.children or []]
+        sigs.append(("ilv",) + _stripe_shape(p))
+        return sigs
+    return [_signature(p)]
+
+
+def _decode_flat(plans: list[ParsedNx16], *, backend: str,
+                 interpret: bool, stage) -> list[bytes]:
+    """The bucketed + vmapped dispatch over non-stripe plans."""
     results: list[bytes | None] = [None] * len(plans)
     groups: dict[tuple, list[int]] = {}
     for i, p in enumerate(plans):
         groups.setdefault(_signature(p), []).append(i)
     for sig in sorted(groups):
         idxs = groups[sig]
-        (n, cat, rle, pack, rounds, p_cap, lit_cap, mid_cap, out_cap,
-         runs_cap) = sig
+        (n, cat, order1, shift, rle, pack, rounds, p_cap, lit_cap,
+         mid_cap, out_cap, runs_cap, n_ctx_cap) = sig
         grp = [plans[i] for i in idxs]
         B = len(grp)
         payload = np.zeros((B, p_cap), np.uint8)
         plen = np.zeros(B, np.int32)
-        states = np.zeros((B, n), np.uint32)
+        states = np.zeros((B, max(n, 1)), np.uint32)
         # freq ships int16 (≤ 4096 each); cum expands on device
         freq = np.zeros((B, 256), np.int16)
         inner = np.zeros(B, np.int32)
@@ -416,6 +621,11 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
         pmap = np.zeros((B, 16), np.int32)
         bits = np.zeros(B, np.int32)
         final = np.zeros(B, np.int32)
+        # ORDER1 compact context rows (int16 on the wire, ≤ 4096
+        # each) + the ctx→row map; (B, 1, 256) dummies for ORDER0
+        # groups so the jit signature stays uniform
+        ctx_index = np.full((B, 256), -1, np.int16)
+        ctx_freq = np.zeros((B, n_ctx_cap, 256), np.int16)
         for j, p in enumerate(grp):
             payload[j, :p.payload.shape[0]] = p.payload
             plen[j] = p.payload.shape[0]
@@ -423,7 +633,12 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
             final[j] = p.final_len
             if not cat:
                 states[j] = p.states
-                freq[j] = p.freq.astype(np.int16)
+                if order1:
+                    ctx_index[j] = p.ctx_index
+                    ctx_freq[j, :p.n_ctx] = \
+                        p.ctx_freq.astype(np.int16)
+                else:
+                    freq[j] = p.freq.astype(np.int16)
             if rle:
                 rle_tab[j] = p.rle_tab
                 runs[j, :len(p.rle_runs)] = p.rle_runs
@@ -434,14 +649,17 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
         host = dict(payload=payload, plen=plen, states=states,
                     freq=freq, inner=inner, rle_tab=rle_tab,
                     runs=runs, rle_out=rle_out, pmap=pmap, bits=bits,
-                    final=final)
+                    final=final, ctx_index=ctx_index,
+                    ctx_freq=ctx_freq)
         if stage is None:
             import jax
 
             dev = {k: jax.device_put(v) for k, v in host.items()}
         else:
             dev = stage(host)
-        if backend == "pallas" and not cat:
+        if backend == "pallas" and not cat and not order1:
+            # the experimental kernel covers the ORDER0 rANS stage;
+            # ORDER1 buckets take the XLA scan either way
             lit = _pallas_scan_bytes(grp, n, rounds, p_cap, interpret)
             # expansions reuse the XLA stages by re-entering as CAT
             # with the scan's output as payload
@@ -449,8 +667,10 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
                 lit, dev["plen"], dev["states"], dev["freq"],
                 dev["inner"], dev["rle_tab"], dev["runs"],
                 dev["rle_out"], dev["pmap"], dev["bits"],
-                dev["final"], rounds=0, n_states=n, cat=True,
-                rle=rle, pack=pack, lit_cap=lit.shape[1],
+                dev["final"], dev["ctx_index"], dev["ctx_freq"],
+                rounds=0, n_states=n, cat=True,
+                rle=rle, pack=pack, order1=False, shift=TF_SHIFT,
+                n_ctx_cap=n_ctx_cap, lit_cap=lit.shape[1],
                 mid_cap=mid_cap, out_cap=out_cap)
         else:
             out, diag = _jitted()(
@@ -458,12 +678,17 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
                 dev["freq"], dev["inner"],
                 dev["rle_tab"], dev["runs"], dev["rle_out"],
                 dev["pmap"], dev["bits"], dev["final"],
+                dev["ctx_index"], dev["ctx_freq"],
                 rounds=rounds, n_states=n, cat=cat, rle=rle,
-                pack=pack, lit_cap=lit_cap, mid_cap=mid_cap,
-                out_cap=out_cap)
+                pack=pack, order1=order1, shift=shift,
+                n_ctx_cap=n_ctx_cap, lit_cap=lit_cap,
+                mid_cap=mid_cap, out_cap=out_cap)
         out = np.asarray(out)
         diag = np.asarray(diag)
         for j, (i, p) in enumerate(zip(idxs, grp)):
+            if order1 and int(diag[j, 3]):
+                raise ValueError(
+                    "rans-nx16: missing order-1 context")
             if rle:
                 if int(diag[j, 0]) != p.rle_out_len:
                     raise ValueError(
@@ -480,21 +705,82 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
     return results
 
 
+def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
+                  interpret: bool = False,
+                  stage=None) -> list[bytes]:
+    """Decode parsed streams on device, bucketed + vmapped; returns
+    bytes per stream, byte-identical to ``rans_nx16.decode``.
+
+    STRIPE containers flatten into their lane sub-streams (decoded
+    through the same buckets as standalone blocks), then reassemble
+    via one batched transpose-interleave gather per stripe shape.
+
+    ``backend``: "scan" (the XLA product path) or "pallas" (the
+    experimental kernel for the ORDER0 rANS stage; ORDER1 and the
+    expansions take the XLA path).
+    ``stage``: optional callable mapping a dict of host arrays to
+    device arrays (parallel.prefetch.stage_block_arrays — the
+    compressed-wire staging/accounting step); default stages without
+    accounting.
+    """
+    flat: list[ParsedNx16] = []
+    spec: list[tuple] = []
+    for p in plans:
+        if p.stripe:
+            idxs = []
+            for ch in p.children or []:
+                idxs.append(len(flat))
+                flat.append(ch)
+            spec.append(("stripe", idxs, p))
+        else:
+            spec.append(("plain", len(flat), p))
+            flat.append(p)
+    decoded = _decode_flat(flat, backend=backend,
+                           interpret=interpret, stage=stage)
+
+    results: list[bytes | None] = [None] * len(plans)
+    stripe_groups: dict[tuple, list[int]] = {}
+    for i, entry in enumerate(spec):
+        if entry[0] == "plain":
+            results[i] = decoded[entry[1]]
+        else:
+            stripe_groups.setdefault(_stripe_shape(entry[2]),
+                                     []).append(i)
+    for shape in sorted(stripe_groups):
+        n_lanes, lane_cap, out_cap = shape
+        members = stripe_groups[shape]
+        B = len(members)
+        lanes_arr = np.zeros((B, n_lanes, lane_cap), np.uint8)
+        flens = np.zeros(B, np.int32)
+        for b, i in enumerate(members):
+            _, idxs, p = spec[i]
+            flens[b] = p.final_len
+            for j, k in enumerate(idxs):
+                lane = np.frombuffer(decoded[k], np.uint8)
+                lanes_arr[b, j, :lane.shape[0]] = lane
+        out = np.asarray(_jitted_interleave()(
+            lanes_arr, flens, n_lanes=n_lanes, out_cap=out_cap))
+        for b, i in enumerate(members):
+            results[i] = bytes(out[b, :spec[i][2].final_len])
+    return results
+
+
 def decode_streams(datas: list[bytes],
                    expected_lens: list[int | None] | None = None,
                    *, backend: str = "scan",
                    interpret: bool = False) -> list[bytes | None]:
     """Parse + device-decode many standalone Nx16 streams; None marks
-    a stream whose combo stays host-side (the caller falls back to
-    ``rans_nx16.decode``). The fuzz-parity surface tests pin against
-    the host oracle."""
+    a stream that stays host-side (unsupported/corrupt layout, or a
+    new bucket shape past the signature cap — the caller falls back
+    to ``rans_nx16.decode``). The fuzz-parity surface tests pin
+    against the host oracle."""
     if expected_lens is None:
         expected_lens = [None] * len(datas)
     plans, order = [], []
     results: list[bytes | None] = [None] * len(datas)
     for i, (d, el) in enumerate(zip(datas, expected_lens)):
         p = parse_nx16(d, el)
-        if p is not None:
+        if p is not None and _admit_signatures(plan_signatures(p)):
             plans.append(p)
             order.append(i)
     decoded = decode_parsed(plans, backend=backend,
@@ -511,18 +797,22 @@ class DeviceBlockDecoder:
     device.
 
     io/cram.py hands :meth:`decode_blocks` one container's raw (still
-    compressed) blocks. rANS-Nx16 blocks whose flag combo the device
-    path supports batch-decode in one bucketed vmapped dispatch — a
-    content-keyed plan Step at the ``decode`` fault site, so a
-    transient device fault costs one backoff and the per-sample
-    quarantine above composes unchanged. Every other block (gzip,
-    ORDER1, STRIPE, …) decodes on host exactly as before, counted in
-    ``decode.device_fallback_total`` (rANS combos deferred this PR)
-    or ``decode.host_blocks_total`` (other codecs).
+    compressed) blocks. rANS-Nx16 blocks batch-decode in one bucketed
+    vmapped dispatch — the full method-5 matrix (ORDER0/ORDER1 ×
+    CAT/PACK/RLE/NOSZ/STRIPE, N=4/X32) — as a content-keyed plan Step
+    at the ``decode`` fault site, so a transient device fault costs
+    one backoff and the per-sample quarantine above composes
+    unchanged. The fallback surface is now corrupt/foreign rANS
+    streams and new bucket shapes past ``MAX_BUCKET_SIGNATURES``
+    (``decode.device_fallback_total``; cap refusals additionally in
+    ``decode.bucket_cap_fallback_total``); non-rANS methods decode on
+    host as before (``decode.host_blocks_total``).
 
-    Wire accounting (the point of the exercise): compressed payload +
-    ~2KB of table arrays per block cross the link instead of the
-    inflated bytes — ``decode.wire_bytes_compressed_total`` vs
+    Wire accounting (the point of the exercise): compressed payload
+    plus the table arrays per block cross the link instead of the
+    inflated bytes — ~0.5KB of table for ORDER0, ~(n_ctx+2)·0.5KB
+    for ORDER1's compact context rows (``decode.table_bytes_total``
+    isolates that share) — ``decode.wire_bytes_compressed_total`` vs
     ``decode.wire_bytes_uncompressed_total``; the staging itself runs
     through parallel.prefetch.stage_block_arrays so the existing
     prefetch byte counters and stage spans record it.
@@ -540,10 +830,12 @@ class DeviceBlockDecoder:
         reg = get_registry()
         self._c_dev = reg.counter("decode.device_blocks_total")
         self._c_fall = reg.counter("decode.device_fallback_total")
+        self._c_cap = reg.counter("decode.bucket_cap_fallback_total")
         self._c_host = reg.counter("decode.host_blocks_total")
         self._c_wire_c = reg.counter("decode.wire_bytes_compressed_total")
         self._c_wire_u = reg.counter(
             "decode.wire_bytes_uncompressed_total")
+        self._c_table = reg.counter("decode.table_bytes_total")
 
     def _stage(self, host_arrays: dict) -> dict:
         from ..parallel.prefetch import stage_block_arrays
@@ -562,9 +854,11 @@ class DeviceBlockDecoder:
             if rb.method == _cram.M_RANSNX16:
                 p = parse_nx16(rb.raw, rb.rsize)
                 if p is not None:
-                    plans.append(p)
-                    order.append(i)
-                    continue
+                    if _admit_signatures(plan_signatures(p)):
+                        plans.append(p)
+                        order.append(i)
+                        continue
+                    self._c_cap.inc()
                 self._c_fall.inc()
             elif rb.method != _cram.M_RAW:
                 self._c_host.inc()
@@ -573,13 +867,17 @@ class DeviceBlockDecoder:
         if plans:
             from ..plan import Step
 
-            wire_c = sum(int(p.payload.nbytes) + p.table_bytes
-                         for p in plans)
+            table_b = sum(p.table_bytes for p in plans)
+            wire_c = sum(p.payload_bytes for p in plans) + table_b
             wire_u = sum(p.final_len for p in plans)
-            crc = 0
+            crc = tcrc = 0
             for p in plans:
-                crc = zlib.crc32(p.payload, crc)
-            key = ("decode", self.backend, len(plans), wire_c, crc)
+                crc = p.payload_crc(crc)
+                tcrc = p.table_crc(tcrc)
+            # the table CRC joins the content key: same payload bytes
+            # under a different table is a different decode
+            key = ("decode", self.backend, len(plans), wire_c, crc,
+                   tcrc)
             decoded = self._pex.run(Step(
                 key=key, site="decode", span="decode.device",
                 attrs={"blocks": len(plans), "wire_bytes": wire_c},
@@ -589,6 +887,7 @@ class DeviceBlockDecoder:
             self._c_dev.inc(len(plans))
             self._c_wire_c.inc(wire_c)
             self._c_wire_u.inc(wire_u)
+            self._c_table.inc(table_b)
             for i, b in zip(order, decoded):
                 results[i] = b
         return results

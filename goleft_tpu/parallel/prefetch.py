@@ -210,7 +210,11 @@ def stage_block_arrays(host_arrays: dict) -> dict:
     existing ``prefetch.bytes_staged_total`` /
     ``prefetch.bytes_transferred_total`` counters — and visible in the
     stage spans wrapping the caller — drop to COMPRESSED size instead
-    of the inflated blocks. ``jax.device_put`` dispatch is
+    of the inflated blocks. The accounting is over the dict's REAL
+    padded arrays, so ORDER1 buckets honestly pay for their compact
+    per-context rows (``ctx_freq``/``ctx_index`` — KBs per block, vs
+    ~0.5KB for an ORDER0 freq row; ``decode.table_bytes_total``
+    isolates the logical table share). ``jax.device_put`` dispatch is
     asynchronous, same as the chunk pipeline's transfer stage.
     """
     import jax

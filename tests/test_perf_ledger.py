@@ -457,15 +457,21 @@ def test_fleet_throughput_entry_ingests(tmp_path):
 
 def test_wire_decode_entry_ingests(tmp_path):
     """The wire_decode bench entry (host scalar/vectorized vs device
-    scan vs Pallas MB/s plus the compressed/inflated wire ratio) lands
-    in the ledger with its nested host lanes flattened to dotted
-    metrics, so `perf check` trends every decode lane separately."""
+    scan vs Pallas MB/s plus the compressed/inflated wire ratio, now
+    with the order1 and stripe lane groups) lands in the ledger with
+    its nested lanes flattened to dotted metrics, so `perf check`
+    trends every decode lane separately."""
     entry = {
         "blocks": 24, "block_bytes": 65536,
         "payload": "ACGT-skewed / correlated quals / run-heavy",
         "host": {"scalar_n4_mb_s": 1.7, "scalar_x32_mb_s": 1.75,
                  "vectorized_x32_mb_s": 2.6,
                  "vectorized_over_scalar_x32": 1.49},
+        "order1": {"scalar_n4_mb_s": 0.92, "scalar_x32_mb_s": 0.93,
+                   "vectorized_x32_mb_s": 2.07,
+                   "vectorized_over_scalar_x32": 2.23,
+                   "device_scan_mb_s": 7.66},
+        "stripe": {"host_mb_s": 1.5, "device_scan_mb_s": 34.2},
         "device_scan_mb_s": 52.3, "device_scan_gbases_s": 0.0523,
         "device_pallas_mb_s": 0.12,
         "wire_bytes_compressed": 401234,
@@ -481,13 +487,20 @@ def test_wire_decode_entry_ingests(tmp_path):
     # CPU-labeled until the tunnel returns (the entry's own note)
     assert rec["provenance"] == "host" and rec["stale"] is False
     for key in ("host.scalar_n4_mb_s", "host.vectorized_x32_mb_s",
+                "order1.scalar_n4_mb_s", "order1.device_scan_mb_s",
+                "order1.vectorized_x32_mb_s", "stripe.host_mb_s",
+                "stripe.device_scan_mb_s",
                 "device_scan_mb_s", "device_pallas_mb_s",
                 "wire_ratio"):
         assert key in rec["metrics"], key
     assert rec["metrics"]["device_scan_mb_s"] == pytest.approx(52.3)
+    assert rec["metrics"]["order1.device_scan_mb_s"] \
+        == pytest.approx(7.66)
     lp = str(tmp_path / "ledger.jsonl")
     ledger.append_records(lp, recs)
     back = [r for r in ledger.read_ledger(lp)
             if r["entry"] == "wire_decode"]
     assert len(back) == 1
     assert back[0]["metrics"]["wire_ratio"] == pytest.approx(0.2551)
+    assert back[0]["metrics"]["stripe.device_scan_mb_s"] \
+        == pytest.approx(34.2)

@@ -3,10 +3,13 @@
 The contract under test is byte-identity: the device decoder (XLA
 scan path, and the Pallas kernel in interpret mode on this CPU-only
 container) must produce EXACTLY the host decoder's bytes on every
-supported flag combo — ORDER0 × CAT × PACK × RLE × NOSZ, both N=4 and
-X32, including empty / 1-byte / tail-heavy blocks — and the
-``--decode-device`` cohort path must emit byte-identical matrices
-including when ORDER1/STRIPE blocks fire the per-block host fallback.
+supported flag combo — the full CRAM 3.1 method-5 matrix
+ORDER0/ORDER1 × CAT × PACK × RLE × NOSZ × STRIPE, both N=4 and X32,
+including empty / 1-byte / tail-heavy blocks, per-context table edge
+cases and uneven stripe lanes — and the ``--decode-device`` cohort
+path must emit byte-identical matrices with ZERO fallbacks on a
+fully-supported cohort (fallback is reserved for corrupt/foreign
+streams and bucket shapes past the signature cap).
 """
 
 import io
@@ -18,6 +21,17 @@ import pytest
 from goleft_tpu.io import rans_nx16 as rx
 from goleft_tpu.obs import get_registry
 from goleft_tpu.ops import rans_device as rd
+
+
+@pytest.fixture(autouse=True)
+def _fresh_signature_registry():
+    """The signature registry is process-global (it bounds process-
+    lifetime compiles); this suite deliberately explodes shapes, so
+    each test starts with fresh admission — no test's fallback
+    behavior may depend on shapes an earlier test admitted."""
+    rd.reset_signature_registry()
+    yield
+    rd.reset_signature_registry()
 
 
 def _corpus(rng, sizes, *, order=0, x32=False, rle=False, pack=False,
@@ -100,18 +114,282 @@ def test_pallas_parity_interpret():
         assert g == rx.decode(enc, len(data)) == data
 
 
-def test_order1_and_stripe_fall_back():
-    rng = np.random.default_rng(5)
-    deltas = rng.choice([0, 0, 0, 1, 2, 5], size=20000)
-    data = bytes((np.cumsum(deltas) % 120).astype(np.uint8))
-    e1 = rx.encode(data, order=1)
-    assert e1[0] & rx.F_ORDER1, "fixture must really be ORDER1"
-    es = rx.encode(data, stripe=4)
-    assert es[0] & rx.F_STRIPE
-    got = rd.decode_streams([e1, es], [len(data)] * 2)
-    assert got == [None, None]
-    assert rx.parse_nx16(e1, len(data)) is None
-    assert rx.parse_nx16(es, len(data)) is None
+def _order1_corpus(rng, n=20000):
+    """Delta-correlated bytes — the shape ORDER1 wins on (quality/
+    name-like streams)."""
+    deltas = rng.choice([0, 0, 0, 1, 2, 5], size=n)
+    return bytes((np.cumsum(deltas) % 120).astype(np.uint8))
+
+
+@pytest.mark.parametrize("x32", [False, True])
+@pytest.mark.parametrize("rle,pack", [(False, False), (True, False),
+                                      (False, True), (True, True)])
+def test_scan_parity_order1_flag_matrix(x32, rle, pack):
+    """ORDER1 through the full transform matrix: per-context slot
+    gathers, carry-context lanes and the lane-sliced output mapping
+    must be byte-identical to the host oracle, including tail-heavy
+    (out_len % N != 0) and bucket-boundary sizes."""
+    rng = np.random.default_rng(20)
+    base = _order1_corpus(rng)
+    cases = []
+    for sz in (0, 1, 63, 127, 4095, 4097, 8191, 19997, 20000):
+        data = base[:sz]
+        if pack:  # packable alphabet (≤16 distinct)
+            data = bytes((np.frombuffer(data, np.uint8) % 11)
+                         .astype(np.uint8))
+        enc = rx.encode(data, order=1, use_rle=rle, use_pack=pack,
+                        x32=x32)
+        cases.append((data, enc))
+    assert any(e[0] & rx.F_ORDER1 for _, e in cases), \
+        "fixture corpus must include genuinely-ORDER1 streams"
+    encs = [e for _, e in cases]
+    lens = [len(d) for d, _ in cases]
+    got = rd.decode_streams(encs, lens)
+    for (data, enc), g in zip(cases, got):
+        assert g is not None, "supported combo must not fall back"
+        assert g == rx.decode(enc, len(data)) == data
+
+
+def test_order1_table_edge_cases():
+    """Per-context table corners: skewed single-successor contexts
+    (freq 4096 rows), tiny alphabets on the RAW table path, large
+    alphabets on the order-0-compressed table path, and NOSZ."""
+    rng = np.random.default_rng(21)
+    cases = []
+    # cyclic patterns: every context has exactly one successor, so
+    # each row is one symbol at full 2^shift frequency
+    for pat, reps in ((b"abc", 4000), (b"ab", 3000),
+                      (b"\x00\xff", 2000)):
+        data = bytes(pat * reps)
+        enc = rx.encode(data, order=1)
+        cases.append((data, enc))
+    # two-symbol skew: one context dominates
+    data = bytes((rng.random(12000) < 0.02).astype(np.uint8) + 65)
+    cases.append((data, rx.encode(data, order=1)))
+    # wide alphabet → table itself ships order-0-compressed
+    wide = _order1_corpus(rng)
+    ewide = rx.encode(wide, order=1)
+    assert ewide[0] & rx.F_ORDER1
+    head = ewide[1 + len(rx.write_uint7(len(wide)))]
+    assert head & 1, "wide-alphabet table should be compressed"
+    cases.append((wide, ewide))
+    # small alphabet stays raw-table
+    eab = rx.encode(bytes(b"abc" * 4000), order=1)
+    hab = eab[1 + len(rx.write_uint7(12000))]
+    assert not (hab & 1), "tiny table should stay raw"
+    # NOSZ ORDER1
+    enc = rx.encode(wide, order=1)
+    cases.append((wide, _strip_size(enc, len(wide))))
+    encs = [e for _, e in cases]
+    lens = [len(d) for d, _ in cases]
+    got = rd.decode_streams(encs, lens)
+    for (data, enc), g in zip(cases, got):
+        assert g is not None
+        assert g == rx.decode(enc, len(data)) == data
+
+
+def test_stripe_device_decode_uneven_lanes():
+    """STRIPE containers: uneven sub-stream lengths (out_len not a
+    multiple of N'), every lane its own complete stream (ORDER0,
+    ORDER1 and X32 inner codecs), reassembled by the batched
+    transpose-interleave gather byte-identically."""
+    rng = np.random.default_rng(22)
+    base = _order1_corpus(rng)
+    cases = []
+    for sz in (20000, 19999, 19998, 4097, 101, 7):
+        for kw in (dict(stripe=4), dict(stripe=3),
+                   dict(stripe=4, x32=True),
+                   dict(stripe=2, order=1)):
+            data = base[:sz]
+            enc = rx.encode(data, **kw)
+            assert enc[0] & rx.F_STRIPE
+            cases.append((data, enc))
+    encs = [e for _, e in cases]
+    lens = [len(d) for d, _ in cases]
+    got = rd.decode_streams(encs, lens)
+    for (data, enc), g in zip(cases, got):
+        assert g is not None, "stripe must decode on device"
+        assert g == rx.decode(enc, len(data)) == data
+
+
+def test_order1_corrupt_table_falls_back():
+    """A corrupt ORDER1 table section parses to None (host handles it
+    its own canonical way) and the CRAM block decoder counts the
+    per-block fallback."""
+    from goleft_tpu.io.cram import M_RANSNX16, RawBlock
+
+    rng = np.random.default_rng(23)
+    data = _order1_corpus(rng, 6000)
+    enc = bytearray(rx.encode(data, order=1))
+    assert enc[0] & rx.F_ORDER1
+    # truncate inside the table section
+    szlen = len(rx.write_uint7(len(data)))
+    bad = bytes(enc[:1 + szlen + 40])
+    assert rx.parse_nx16(bad, len(data)) is None
+    with pytest.raises((ValueError, IndexError)):
+        rx.decode(bad, len(data))
+    # implausible claimed table size: same error class as host
+    head_at = 1 + szlen
+    assert enc[head_at] & 1, "fixture table should be compressed"
+    big = bytes(enc[:head_at + 1]) + rx.write_uint7(1 << 23) \
+        + bytes(enc[head_at + 1:])
+    assert rx.parse_nx16(big, len(data)) is None
+    with pytest.raises(ValueError, match="implausible o1 table"):
+        rx.decode(big, len(data))
+    # the block decoder degrades per-block, counted
+    reg = get_registry()
+    before = dict(reg.counters())
+    dec = rd.DeviceBlockDecoder()
+    good = bytes(enc)
+    got = dec.decode_blocks(
+        [RawBlock(M_RANSNX16, 4, 1, good, len(data))])
+    assert got == [data]
+    after = dict(reg.counters())
+    assert after.get("decode.device_blocks_total", 0) \
+        == before.get("decode.device_blocks_total", 0) + 1
+    assert after.get("decode.device_fallback_total", 0) \
+        == before.get("decode.device_fallback_total", 0)
+    # the per-block fallback is byte-transparent: the corrupt block
+    # fails with exactly the host codec's error class
+    with pytest.raises((ValueError, IndexError)):
+        dec.decode_blocks(
+            [RawBlock(M_RANSNX16, 4, 1, bad, len(data))])
+    assert dict(reg.counters()).get(
+        "decode.device_fallback_total", 0) \
+        == before.get("decode.device_fallback_total", 0) + 1
+
+
+def test_order1_missing_context_diag():
+    """A context lane pointing at an absent table row must raise the
+    host's missing-context error from the device diag bit, not decode
+    garbage silently."""
+    rng = np.random.default_rng(24)
+    data = _order1_corpus(rng, 4000)
+    enc = rx.encode(data, order=1)
+    p = rx.parse_nx16(enc, len(data))
+    assert p is not None and p.order1
+    # knock out a context row the stream actually uses
+    used = np.flatnonzero(np.asarray(p.ctx_index) >= 0)
+    p.ctx_index = p.ctx_index.copy()
+    p.ctx_index[used[len(used) // 2]] = -1
+    with pytest.raises(ValueError, match="missing order-1 context"):
+        rd.decode_parsed([p])
+
+
+def test_bucket_signature_cap_falls_back(caplog):
+    """Past MAX_BUCKET_SIGNATURES, NEW block shapes decode on host
+    (None from decode_streams, counted fallback from the block
+    decoder) — never an error — and the trip logs one visible line."""
+    import logging
+
+    from goleft_tpu.io.cram import M_RANSNX16, RawBlock
+
+    rng = np.random.default_rng(25)
+    datas = [bytes(rng.integers(0, 40, n, dtype=np.uint8))
+             for n in (300, 5000, 70000)]  # three distinct buckets
+    encs = [rx.encode(d) for d in datas]
+    old_cap = rd.MAX_BUCKET_SIGNATURES
+    reg = get_registry()
+    try:
+        rd.reset_signature_registry()
+        rd.MAX_BUCKET_SIGNATURES = 1
+        before = dict(reg.counters())
+        with caplog.at_level(logging.WARNING,
+                             logger="goleft-tpu.ops.rans_device"):
+            got = rd.decode_streams(encs, [len(d) for d in datas])
+        assert got[0] == datas[0], "first shape is admitted"
+        assert got[1] is None and got[2] is None, \
+            "shapes past the cap fall back"
+        after = dict(reg.counters())
+        assert after.get("decode.bucket_signatures", 0) \
+            == before.get("decode.bucket_signatures", 0) + 1
+        assert any("bucket-signature cap" in r.message
+                   for r in caplog.records)
+        # same flow through the CRAM block decoder: host bytes, no
+        # error, cap fallback counted
+        dec = rd.DeviceBlockDecoder()
+        raws = [RawBlock(M_RANSNX16, 4, 1, e, len(d))
+                for e, d in zip(encs, datas)]
+        got2 = dec.decode_blocks(raws)
+        assert got2 == datas
+        final = dict(reg.counters())
+        assert final.get("decode.bucket_cap_fallback_total", 0) \
+            >= before.get("decode.bucket_cap_fallback_total", 0) + 2
+        assert final.get("decode.device_fallback_total", 0) \
+            >= before.get("decode.device_fallback_total", 0) + 2
+    finally:
+        rd.MAX_BUCKET_SIGNATURES = old_cap
+        rd.reset_signature_registry()
+
+
+def test_host_vectorized_order1_loop_exactness():
+    """The all-N-states-per-round ORDER1 numpy loop is byte-identical
+    to the per-symbol scalar loop — lane-sliced output order, the
+    intra-round renorm rank, the scalar tail and the missing-context
+    raise — on clean AND mutated streams."""
+    rng = np.random.default_rng(26)
+    base = _order1_corpus(rng, 3000)
+    for n_states in (4, 32):
+        for cut in (0, 1, n_states - 1, n_states + 1):
+            d = base[:len(base) - cut]
+            enc = rx._encode_rans1(d, n_states)
+            buf = memoryview(enc)
+            head = buf[0]
+            shift = head >> 4
+            target = 1 << shift
+            pos = 1
+            if head & 1:
+                ulen, pos = rx.read_uint7(buf, pos)
+                clen, pos = rx.read_uint7(buf, pos)
+                table = rx._decode_rans0(buf, pos, ulen, 4)
+                pos += clen
+                _, freqs, cums, luts, _ = rx._read_freqs1_rows(
+                    memoryview(table), 0, target)
+            else:
+                _, freqs, cums, luts, pos = rx._read_freqs1_rows(
+                    buf, pos, target)
+            args = (buf, pos, len(d), n_states, shift, freqs, cums,
+                    luts)
+            assert rx._rans1_loop_vec(*args) \
+                == rx._rans1_loop_scalar(*args) == d
+            # mutated payload bytes: identical garbage or the same
+            # host-class error from both loops
+            for _ in range(15):
+                mut = bytearray(enc)
+                i = int(rng.integers(pos + 4 * n_states, len(mut)))
+                mut[i] ^= int(rng.integers(1, 256))
+                mb = memoryview(bytes(mut))
+                am = (mb, pos, len(d), n_states, shift, freqs, cums,
+                      luts)
+                try:
+                    want = rx._rans1_loop_scalar(*am)
+                except ValueError as e:
+                    with pytest.raises(ValueError,
+                                       match="order-1 context"):
+                        rx._rans1_loop_vec(*am)
+                    assert "order-1 context" in str(e)
+                else:
+                    assert rx._rans1_loop_vec(*am) == want
+
+
+def test_decode_order1_vectorized_product_gate():
+    """rx.decode routes X32 ORDER1 through the vectorized loop and
+    N=4 through the scalar loop (same measured crossover as ORDER0)
+    — identical bytes either way."""
+    rng = np.random.default_rng(27)
+    data = _order1_corpus(rng, 9000)
+    for x32 in (False, True):
+        enc = rx.encode(data, order=1, x32=x32)
+        assert enc[0] & rx.F_ORDER1
+        old = rx.VEC_MIN_STATES
+        try:
+            rx.VEC_MIN_STATES = 1 << 30   # force scalar
+            a = rx.decode(enc, len(data))
+            rx.VEC_MIN_STATES = 1        # force vectorized
+            b = rx.decode(enc, len(data))
+        finally:
+            rx.VEC_MIN_STATES = old
+        assert a == b == data
 
 
 def test_parse_nx16_rejects_inconsistencies():
@@ -245,8 +523,11 @@ def _write_cram_cohort(tmp_path):
 
 def test_cohortdepth_decode_device_byte_identical(tmp_path):
     """The full cohort path: --decode-device matrices byte-identical
-    to the default, with the ORDER1 sample firing real per-block
-    fallbacks along the way."""
+    to the default — and the ORDER1 + STRIPE samples that used to
+    fire per-block fallbacks now decode on device, so the fallback
+    counter must NOT move on this fully-supported cohort (the
+    decode-smoke contract), while the ORDER1 table share lands in
+    decode.table_bytes_total."""
     from goleft_tpu.commands.cohortdepth import run_cohortdepth
 
     crams, fai = _write_cram_cohort(tmp_path)
@@ -262,7 +543,9 @@ def test_cohortdepth_decode_device_byte_identical(tmp_path):
     assert after.get("decode.device_blocks_total", 0) \
         > before.get("decode.device_blocks_total", 0)
     assert after.get("decode.device_fallback_total", 0) \
-        > before.get("decode.device_fallback_total", 0)
+        == before.get("decode.device_fallback_total", 0)
+    assert after.get("decode.table_bytes_total", 0) \
+        > before.get("decode.table_bytes_total", 0)
 
 
 def test_cohortdepth_decode_device_prefetched(tmp_path):
