@@ -7,7 +7,8 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        decode-smoke perf-gate lint lint-changed plan-lint check clean
+        fleet-obs-smoke decode-smoke perf-gate lint lint-changed \
+        plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -129,9 +130,25 @@ fleet-chaos:
 decode-smoke:
 	python -m goleft_tpu.ops.decode_smoke
 
+# fleet observability plane end-to-end: a real subprocess router
+# supervising two real serve workers (three OS processes). One depth
+# request with a client-minted x-goleft-trace id yields ONE stitched
+# trace from GET /fleet/trace/<id> — router forward span parenting the
+# worker's request -> plan-step -> batch -> device-dispatch chain —
+# with distinct Perfetto process tracks and the `goleft-tpu trace` CLI
+# rendering it; /fleet/metrics counters equal the arithmetic sum of
+# the live workers' counters in both encodings; and a SIGKILLed worker
+# produces death/backoff/restart events replayable from the fsync'd
+# events.jsonl (`goleft-tpu fleet events --json`, schema-stable) and
+# visible in the router /metrics fleet.events block. Host-pinned like
+# the other smokes.
+fleet-obs-smoke:
+	python -m goleft_tpu.obs.fleet_smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
 # the test suite, then the end-to-end proofs
-check: lint plan-lint test decode-smoke fleet-smoke fleet-chaos
+check: lint plan-lint test decode-smoke fleet-smoke fleet-chaos \
+       fleet-obs-smoke
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -11,6 +11,7 @@ from __future__ import annotations
 from .determinism import DeterminismRule
 from .exceptions import ExceptionRule
 from .locks import LockDisciplineRule
+from .obs_span import ObsSpanRule
 from .plan_boundary import PlanBoundaryRule
 from .tracer import TracerRule
 
@@ -20,6 +21,7 @@ ALL_RULES = (
     LockDisciplineRule(),
     ExceptionRule(),
     PlanBoundaryRule(),
+    ObsSpanRule(),
 )
 
 

@@ -92,6 +92,9 @@ PROGS = {
     # or hang on — backend bring-up itself
     "fleet": ("multi-worker serve fleet behind a file-affinity router",
               _lazy(".commands.fleet"), False),
+    # pure HTTP client over the router's /fleet/trace — no device
+    "trace": ("fetch + pretty-print a stitched cross-process fleet "
+              "trace", _lazy(".commands.trace_cmd"), False),
 }
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",

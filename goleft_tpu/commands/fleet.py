@@ -64,7 +64,76 @@ def _spawn_worker(extra_args: list[str], env: dict):
     return child, url
 
 
+def events_main(argv) -> int:
+    """``goleft-tpu fleet events``: query the supervisor's structured
+    event journal (spawns, deaths, backoffs, hang-kills, quarantines,
+    scale events, drains) — replayable after a SIGKILLed supervisor
+    because every append is fsync'd and the reader tolerates the one
+    torn tail line a crash can leave."""
+    p = argparse.ArgumentParser(
+        "goleft-tpu fleet events",
+        description="query the fleet supervisor's events.jsonl "
+                    "lifecycle journal")
+    p.add_argument("--journal", default="events.jsonl",
+                   metavar="PATH",
+                   help="the events.jsonl written via fleet "
+                        "--events-journal (default: ./events.jsonl)")
+    p.add_argument("--since", default=None, metavar="WHEN",
+                   help="only events at/after WHEN: epoch seconds, a "
+                        "relative window (30s/15m/2h/1d), or ISO8601")
+    p.add_argument("--slot", type=int, default=None,
+                   help="only events for this worker slot index")
+    p.add_argument("--type", default=None, dest="etype",
+                   metavar="TYPE",
+                   help="only events of this type (spawn, restart, "
+                        "death, backoff, hang_kill, quarantine, "
+                        "scale_up, scale_down, drain, ...)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the schema-stable JSON document "
+                        "(goleft-tpu.fleet-events/1) instead of the "
+                        "human table")
+    a = p.parse_args(argv)
+
+    import json as _json
+
+    from ..obs.events import parse_since, read_events
+
+    if not os.path.exists(a.journal):
+        print(f"goleft-tpu fleet events: no journal at {a.journal}",
+              file=sys.stderr)
+        return 1
+    since = parse_since(a.since) if a.since else None
+    events = read_events(a.journal, since=since, slot=a.slot,
+                         type=a.etype)
+    if a.json:
+        print(_json.dumps({"schema": "goleft-tpu.fleet-events/1",
+                           "journal": a.journal,
+                           "count": len(events),
+                           "events": events}, sort_keys=True,
+                          indent=1))
+        return 0
+    for e in events:
+        slot = e.get("slot")
+        parts = [e.get("ts", "?"), f"{e.get('type', '?'):<13}"]
+        parts.append(f"slot={slot}" if slot is not None else "slot=-")
+        if e.get("worker"):
+            parts.append(e["worker"])
+        detail = {k: v for k, v in sorted(e.items())
+                  if k not in ("schema", "t", "ts", "type", "slot",
+                               "worker", "trace_id") and v is not None}
+        if detail:
+            parts.append(" ".join(f"{k}={v}" for k, v
+                                  in detail.items()))
+        print("  ".join(parts))
+    print(f"# {len(events)} event(s) from {a.journal}",
+          file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "events":
+        return events_main(argv[1:])
     p = argparse.ArgumentParser(
         "goleft-tpu fleet",
         description="multi-worker serve fleet behind a file-affinity "
@@ -170,6 +239,24 @@ def main(argv=None) -> int:
                      help="write the slot-quarantine JSON manifest "
                           "here on exit (same shape as cohortdepth's "
                           "sample quarantine)")
+    obsg = p.add_argument_group("fleet observability plane")
+    obsg.add_argument("--events-journal", default=None,
+                      metavar="PATH",
+                      help="append supervisor lifecycle events "
+                           "(spawn/death/backoff/hang-kill/"
+                           "quarantine/scale/drain) to this fsync'd "
+                           "events.jsonl — query with `goleft-tpu "
+                           "fleet events --journal PATH`")
+    obsg.add_argument("--burn-threshold", type=float, default=0.0,
+                      help="scale up while the fleet SLO burn rate "
+                           "(max over endpoints of p99 ratio and "
+                           "error-rate/budget) exceeds this, even "
+                           "with queue age below target (0 disables; "
+                           "1.0 = scale when the budget burns faster "
+                           "than it earns)")
+    obsg.add_argument("--error-budget", type=float, default=0.01,
+                      help="allowed windowed 5xx fraction the burn "
+                           "rate is computed against")
     a = p.parse_args(argv)
 
     if a.workers <= 0 and not a.worker:
@@ -203,7 +290,9 @@ def main(argv=None) -> int:
             scale_down_idle_ticks=a.scale_down_idle_ticks,
             drain_timeout_s=a.drain_timeout_s,
             spawn_timeout_s=a.spawn_timeout_s,
-            shared_cache=a.shared_cache)
+            shared_cache=a.shared_cache,
+            events_journal=a.events_journal,
+            burn_threshold=a.burn_threshold)
         try:
             urls = supervisor.spawn_initial(a.workers)
         except WorkerSpawnError as e:
@@ -248,7 +337,8 @@ def main(argv=None) -> int:
                     shed_below=a.shed_below,
                     redirect=a.redirect,
                     vnodes=a.vnodes,
-                    registry=registry)
+                    registry=registry,
+                    error_budget=a.error_budget)
     if supervisor is not None:
         supervisor.bind(app)
     app.start()

@@ -57,6 +57,12 @@ import urllib.request
 from bisect import bisect_right
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import obs
+from ..obs.fleetplane import (
+    TRACE_HEADER, format_trace_header, merge_worker_metrics,
+    parse_trace_header, perfetto_export, poll_jitter_frac,
+    rollup_registry_snapshot, stitch_trace,
+)
 from ..obs.logging import get_logger
 from ..obs.metrics import MetricsRegistry
 from .admission import (
@@ -187,6 +193,11 @@ class _Worker:
         self.open_breakers: frozenset[str] = frozenset()
         self.availability: float | None = None
         self.last_poll_s: float | None = None
+        self.last_metrics: dict | None = None  # full polled /metrics
+        # body — the fleet rollup's raw material (None until a poll
+        # lands; cleared never: a stale snapshot beats an empty fleet
+        # view during a worker's restart window)
+        self.next_poll_at = 0.0  # monotonic; phase-offset per worker
 
 
 class WorkerPool:
@@ -203,9 +214,19 @@ class WorkerPool:
             else MetricsRegistry()
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        for w in self.workers.values():
+            self._schedule_first_poll(w)
         self._thread = threading.Thread(
             target=self._poll_loop, daemon=True,
             name="goleft-fleet-poller")
+
+    def _schedule_first_poll(self, w: _Worker) -> None:
+        # deterministic hash jitter (the RetryPolicy trick): each
+        # worker's scrape phase is offset by a stable fraction of the
+        # interval, so N workers spread across it instead of being
+        # scraped in one tick burst every poll_interval_s
+        w.next_poll_at = time.monotonic() + \
+            poll_jitter_frac(w.url) * self.poll_interval_s
 
     def start(self) -> "WorkerPool":
         self.poll_all()  # synchronous first poll: route on real state
@@ -256,6 +277,7 @@ class WorkerPool:
             w.draining = h.get("status") == "draining"
             w.open_breakers = breakers
             w.availability = slo.get("availability")
+            w.last_metrics = m
             w.last_poll_s = time.monotonic()
 
     def poll_all(self) -> None:
@@ -263,8 +285,36 @@ class WorkerPool:
             self._poll_one(w)
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
-            self.poll_all()
+        # per-worker periodic schedule with the deterministic phase
+        # offsets from _schedule_first_poll: the loop wakes for the
+        # earliest due worker, polls whatever is due, and sleeps again
+        # — never the whole fleet in one burst
+        while not self._stop.is_set():
+            now = time.monotonic()
+            for w in list(self.workers.values()):
+                if w.next_poll_at <= now:
+                    self._poll_one(w)
+                    w.next_poll_at += self.poll_interval_s
+                    if w.next_poll_at <= time.monotonic():
+                        # fell behind (slow worker, long timeout):
+                        # re-phase rather than burst-catch-up
+                        w.next_poll_at = time.monotonic() \
+                            + self.poll_interval_s
+            nxt = min((w.next_poll_at
+                       for w in list(self.workers.values())),
+                      default=now + self.poll_interval_s)
+            wait = min(self.poll_interval_s,
+                       max(0.02, nxt - time.monotonic()))
+            self._stop.wait(wait)
+
+    def metrics_by_worker(self) -> dict[str, dict]:
+        """{label: last polled /metrics body} over workers that have
+        reported at least once — the fleet rollup's input. The label
+        is the port (the stable short form the counters already use)."""
+        with self._lock:
+            items = [(w.url.rsplit(":", 1)[-1], w.last_metrics)
+                     for w in self.workers.values()]
+        return {label: m for label, m in items if m is not None}
 
     # ---- dynamic membership (the supervisor's levers) ----
 
@@ -275,7 +325,8 @@ class WorkerPool:
         url = url.rstrip("/")
         with self._lock:
             if url not in self.workers:
-                self.workers[url] = _Worker(url)
+                w = self.workers[url] = _Worker(url)
+                self._schedule_first_poll(w)
 
     def remove(self, url: str) -> None:
         """Forget a worker entirely (idempotent) — after its process
@@ -385,7 +436,9 @@ class RouterApp:
                  shed_below: float = 0.0,
                  redirect: bool = False,
                  vnodes: int = 64,
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 error_budget: float = 0.01,
+                 flight_records: int = 64):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.ring = HashRing(worker_urls, vnodes=vnodes)
@@ -399,9 +452,19 @@ class RouterApp:
         self.default_timeout_s = default_timeout_s
         self.shed_below = shed_below
         self.redirect = redirect
+        self.error_budget = error_budget
         self.started = time.time()
         # set by Supervisor.bind(); the router itself never calls it
         self.supervisor = None
+        # the router's own flight ring: fleet.request.* trees (root +
+        # per-attempt forward spans) retained by trace id — the
+        # router-process half of every stitched /fleet/trace answer.
+        # serve/flight.py is stdlib-only, so the router stays jax-free.
+        from ..serve.flight import FlightRecorder
+
+        self.flight = FlightRecorder(max_records=flight_records)
+        self._tracer = obs.get_tracer()
+        self._tracer.add_listener(self.flight.on_span)
 
     def start(self) -> "RouterApp":
         self.pool.start()
@@ -409,6 +472,7 @@ class RouterApp:
 
     def close(self) -> None:
         self.pool.close()
+        self._tracer.remove_listener(self.flight.on_span)
 
     # ---- dynamic membership ----
     #
@@ -479,16 +543,41 @@ class RouterApp:
             + [u for u in order if u not in ok]
 
     def _forward(self, url: str, kind: str, body: bytes,
-                 timeout_s: float) -> tuple[int, bytes]:
+                 timeout_s: float,
+                 trace: tuple[str, int] | None = None) \
+            -> tuple[int, bytes]:
+        headers = {"Content-Type": "application/json",
+                   "Accept": "application/json"}
+        if trace is not None:
+            # the cross-process context: this trace's id + the forward
+            # span's id, which the worker's request root records as
+            # remote_parent — the graft point /fleet/trace stitches on
+            headers[TRACE_HEADER] = format_trace_header(*trace)
         req = urllib.request.Request(
-            url + "/v1/" + kind, data=body,
-            headers={"Content-Type": "application/json",
-                     "Accept": "application/json"})
+            url + "/v1/" + kind, data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as r:
                 return r.status, r.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
+
+    def handle_traced(self, kind: str, body: bytes,
+                      trace_header: str | None = None) \
+            -> tuple[int, dict | bytes, str]:
+        """One routed request under a fleet-wide trace → (status,
+        response bytes-or-dict, trace_id). The root adopts the
+        client's ``x-goleft-trace`` context when one arrived (a traced
+        ServeClient), else mints the fleet id itself; either way the
+        id is echoed to the client as a response header and every
+        forward carries it downstream."""
+        parsed = parse_trace_header(trace_header)
+        tid, remote_parent = parsed if parsed else (None, None)
+        with obs.trace(f"fleet.request.{kind}", kind="serve",
+                       trace_id=tid,
+                       remote_parent=remote_parent) as root:
+            code, payload = self.handle(kind, body)
+            root.attrs["status"] = code
+            return code, payload, root.trace_id
 
     def handle(self, kind: str, body: bytes) -> tuple[int, dict | bytes]:
         """One routed request → (status, response bytes-or-dict)."""
@@ -563,8 +652,16 @@ class RouterApp:
             wk = url.rsplit(":", 1)[-1]  # port: the stable short label
             self.pool.begin_forward(url)
             try:
-                status, payload = self._forward(url, kind, body,
-                                                timeout_s)
+                # one span per forward ATTEMPT: its span id rides the
+                # trace header, so the worker tree grafts under the
+                # attempt that actually served it (a retried request
+                # shows the dead-end forward AND the successful one)
+                with obs.span(f"fleet.forward.{kind}", url=url,
+                              attempt=i) as fsp:
+                    status, payload = self._forward(
+                        url, kind, body, timeout_s,
+                        trace=(fsp.trace_id, fsp.span_id))
+                    fsp.attrs["status"] = status
             except Exception as e:  # noqa: BLE001 — connection-level
                 # death (refused/reset/timeout): the worker, not the
                 # request — eject it and try the next ring candidate
@@ -624,6 +721,7 @@ class RouterApp:
         avail = self.pool.fleet_availability()
         if avail is not None:
             g("fleet.availability").set(round(avail, 6))
+        self._rollup()  # refresh fleet.slo.burn_rate.* gauges
         snap = self.registry.snapshot()
         out = {
             "counters": snap["counters"],
@@ -633,7 +731,82 @@ class RouterApp:
         }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.snapshot()
+            out["fleet.events"] = self.supervisor.events_block()
         return out
+
+    # ---- the fleet observability plane ----
+
+    def _rollup(self) -> dict:
+        """Merge the poller's per-worker metrics snapshots
+        (obs/fleetplane.py rules) and publish the fleet SLO burn-rate
+        gauges into the router registry — so they ride the plain
+        /metrics body too, not just /fleet/metrics."""
+        merged = merge_worker_metrics(self.pool.metrics_by_worker(),
+                                      error_budget=self.error_budget)
+        g = self.registry.gauge
+        slo = merged["slo"]
+        g("fleet.slo.error_rate").set(slo["error_rate"])
+        g("fleet.slo.burn_rate_max").set(slo["burn_rate_max"])
+        for ep, r in slo["burn_rate"].items():
+            g(f"fleet.slo.burn_rate.{ep}").set(r)
+        return merged
+
+    def fleet_burn_rate(self) -> float:
+        """Worst per-endpoint SLO burn rate across the fleet right now
+        (>1.0 = burning budget faster than earning it) — the
+        supervisor autoscaler's scale-up signal beyond queue age."""
+        return self._rollup()["slo"]["burn_rate_max"]
+
+    def fleet_metrics(self) -> dict:
+        """The ``GET /fleet/metrics`` JSON body: the full rollup plus
+        the router's own registry snapshot alongside (two layers, one
+        document — worker evidence and router evidence never mix
+        namespaces)."""
+        merged = self._rollup()
+        merged["router"] = self.registry.snapshot()
+        return merged
+
+    def fleet_metrics_prometheus(self) -> str:
+        """The same rollup as Prometheus text exposition: the merged
+        worker registry flattened (fleet.worker.*, fleet.slo.*) plus
+        the router's own registry — one scrape target for the whole
+        fleet."""
+        from ..obs import prometheus
+
+        merged = self._rollup()
+        flat = rollup_registry_snapshot(merged)
+        router_snap = self.registry.snapshot()
+        for group in ("counters", "gauges", "histograms"):
+            flat[group].update(router_snap.get(group, {}))
+        return prometheus.render(flat)
+
+    def fleet_trace(self, trace_id: str) -> tuple[int, dict]:
+        """``GET /fleet/trace/<id>``: pull every worker's flight
+        records for ``trace_id`` (the ``?trace_id=`` filter), stitch
+        them under this router's own record, and attach the Perfetto
+        export. 404 only when NO process holds the trace (evicted
+        rings or a never-seen id)."""
+        from urllib.parse import quote
+
+        own = self.flight.snapshot(trace_id=trace_id)
+        worker_records: dict[str, list] = {}
+        for url in sorted(self.pool.workers):
+            try:
+                d = self.pool._fetch_json(
+                    url + "/debug/flight?trace_id="
+                    + quote(trace_id))
+                worker_records[url] = d.get("records") or []
+            except Exception:  # noqa: BLE001 — a dead worker cannot
+                # veto the stitched view of everyone else's spans
+                worker_records[url] = []
+        stitched = stitch_trace(trace_id, own, worker_records)
+        if stitched is None:
+            return 404, {
+                "error": f"no flight record for trace {trace_id!r} "
+                         "in the router or any worker (rings are "
+                         "bounded — the trace may have been evicted)"}
+        stitched["perfetto"] = perfetto_export(trace_id, stitched)
+        return 200, stitched
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -659,20 +832,49 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
         self.close_connection = True
 
-    def _respond_raw(self, code: int, data: bytes) -> None:
+    def _respond_raw(self, code: int, data: bytes,
+                     extra_headers: dict | None = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(data)
         self.close_connection = True
 
     def do_GET(self):  # noqa: N802 — http.server contract
-        if self.path == "/healthz":
+        from urllib.parse import parse_qs, unquote, urlparse
+
+        u = urlparse(self.path)
+        if u.path == "/healthz":
             code, body = self.app.healthz()
             self._respond_json(code, body)
-        elif self.path.startswith("/metrics"):
+        elif u.path == "/fleet/metrics":
+            q = parse_qs(u.query)
+            fmt = q.get("format", [""])[0]
+            accept = self.headers.get("Accept", "")
+            if fmt in ("prom", "prometheus") or (
+                    not fmt and "text/plain" in accept
+                    and "json" not in accept):
+                from ..obs.prometheus import CONTENT_TYPE
+
+                data = self.app.fleet_metrics_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+                self.close_connection = True
+            else:
+                self._respond_json(200, self.app.fleet_metrics())
+        elif u.path.startswith("/fleet/trace/"):
+            trace_id = unquote(u.path[len("/fleet/trace/"):])
+            code, body = self.app.fleet_trace(trace_id)
+            self._respond_json(code, body)
+        elif u.path == "/metrics":
             self._respond_json(200, self.app.metrics_snapshot())
         else:
             self._respond_json(404,
@@ -697,17 +899,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                {"error": f"no route {self.path}"})
             return
         kind = self.path[len("/v1/"):].strip("/")
-        code, payload = self.app.handle(kind, body)
+        code, payload, trace_id = self.app.handle_traced(
+            kind, body, self.headers.get(TRACE_HEADER))
+        # echo the fleet trace id (minted here when the client sent
+        # none) so ANY client can follow up with
+        # `goleft-tpu trace <id> --router URL`
+        trace_hdr = {TRACE_HEADER: trace_id}
         if code == 307:
             # redirect mode: Location + a JSON body naming it (for
             # clients that refuse to follow)
             self._respond_json(code, payload,
                                extra_headers={
-                                   "Location": payload["location"]})
+                                   "Location": payload["location"],
+                                   **trace_hdr})
         elif isinstance(payload, bytes):
-            self._respond_raw(code, payload)
+            self._respond_raw(code, payload, extra_headers=trace_hdr)
         else:
-            self._respond_json(code, payload)
+            self._respond_json(code, payload,
+                               extra_headers=trace_hdr)
 
 
 class _RouterServer(ThreadingHTTPServer):

@@ -79,6 +79,7 @@ import threading
 import time
 import urllib.request
 
+from ..obs.events import EventJournal, EventLog
 from ..obs.logging import get_logger
 from ..obs.metrics import MetricsRegistry
 from ..resilience.policy import Quarantine, RetryPolicy
@@ -190,7 +191,10 @@ class Supervisor:
                  drain_timeout_s: float = 30.0,
                  spawn_timeout_s: float = 120.0,
                  shared_cache: str | None = None,
-                 queue_age_fn=None):
+                 queue_age_fn=None,
+                 events_journal: str | None = None,
+                 burn_threshold: float = 0.0,
+                 burn_rate_fn=None):
         if min_workers < 1:
             raise ValueError(
                 f"min_workers must be >= 1 (got {min_workers})")
@@ -229,6 +233,20 @@ class Supervisor:
         self.drain_timeout_s = drain_timeout_s
         self.spawn_timeout_s = spawn_timeout_s
         self.queue_age_fn = queue_age_fn
+        # SLO-burn autoscale signal (the fleet plane's second trigger
+        # beyond queue age): scale up while the polled fleet burn rate
+        # exceeds burn_threshold (>1.0 = budget burning faster than it
+        # earns; 0 disables). burn_rate_fn defaults to the bound
+        # router's fleet_burn_rate at bind() time.
+        self.burn_threshold = burn_threshold
+        self.burn_rate_fn = burn_rate_fn
+        # the structured event journal: every lifecycle transition,
+        # fsync'd per append (obs/events.py — the checkpoint journal's
+        # durability protocol), plus the bounded in-memory ring the
+        # router's /metrics `fleet.events` block serves
+        self.events = EventLog(
+            EventJournal(events_journal) if events_journal else None,
+            registry=self.registry)
         self.quarantine = Quarantine()
         self.app = None
         self._slots: list[WorkerSlot] = []
@@ -267,12 +285,16 @@ class Supervisor:
             # slot event (counted toward the crash window), never a
             # supervisor death
             self.registry.counter("fleet.spawn_failures_total").inc()
+            self.events.emit("spawn_failure", slot=slot.index,
+                             error=repr(e))
             log.warning("fleet: slot %d spawn failed: %r",
                         slot.index, e)
             return False
         slot.proc = proc
         slot.url = url.rstrip("/")
         slot.health_misses = 0
+        self.events.emit("spawn", slot=slot.index, worker=slot.url,
+                         pid=proc.pid)
         return True
 
     def spawn_initial(self, n: int) -> list[str]:
@@ -303,12 +325,19 @@ class Supervisor:
 
     def bind(self, app) -> "Supervisor":
         """Attach the RouterApp whose membership this supervisor
-        drives (and whose scheduler provides the autoscale signal)."""
+        drives (and whose scheduler + fleet rollup provide the
+        autoscale signals: queue age AND SLO burn rate)."""
         self.app = app
         app.supervisor = self
         if self.queue_age_fn is None:
             self.queue_age_fn = app.scheduler.queue_age_s
+        if self.burn_rate_fn is None and self.burn_threshold > 0:
+            self.burn_rate_fn = app.fleet_burn_rate
         return self
+
+    def events_block(self) -> dict:
+        """The router /metrics ``fleet.events`` block."""
+        return self.events.block()
 
     def start(self) -> "Supervisor":
         self._thread.start()
@@ -326,6 +355,8 @@ class Supervisor:
             if slot.state not in (QUARANTINED,):
                 slot.state = STOPPED
         self._update_capacity()
+        self.events.emit("stop", detailed_reason="supervisor close")
+        self.events.close()
 
     def _terminate(self, slot: WorkerSlot,
                    sig_kill: bool = False) -> None:
@@ -418,6 +449,10 @@ class Supervisor:
         # and recycle through the death path.
         slot.state = HUNG
         self.registry.counter("fleet.hangs_total").inc()
+        self.events.emit("hang_kill", slot=slot.index,
+                         worker=slot.url,
+                         pid=proc.pid if proc else None,
+                         misses=slot.health_misses)
         log.warning("fleet: slot %d worker %s hung (%d healthz "
                     "timeouts) — SIGKILL + recycle", slot.index,
                     slot.url, slot.health_misses)
@@ -451,6 +486,10 @@ class Supervisor:
         slot.deaths.append(now)
         slot.deaths = [t for t in slot.deaths
                        if now - t <= self.crash_window_s]
+        self.events.emit(
+            "death", slot=slot.index, worker=slot.url,
+            pid=slot.proc.pid if slot.proc else None, why=why,
+            deaths_in_window=len(slot.deaths))
         if len(slot.deaths) >= self.crash_limit:
             self._quarantine_slot(slot, why)
             return
@@ -461,6 +500,9 @@ class Supervisor:
         delay = self.backoff.backoff_s(("fleet-slot", slot.index),
                                        len(slot.deaths))
         slot.next_attempt_at = now + delay
+        self.events.emit("backoff", slot=slot.index,
+                         delay_s=round(delay, 3),
+                         attempt=len(slot.deaths))
         log.warning("fleet: slot %d restarting in %.2fs (%s; death "
                     "%d/%d in window)", slot.index, delay, why,
                     len(slot.deaths), self.crash_limit)
@@ -475,6 +517,10 @@ class Supervisor:
         slot.state = HEALTHY
         slot.restarts += 1
         self.registry.counter("fleet.restarts_total").inc()
+        self.events.emit(
+            "restart", slot=slot.index, worker=slot.url,
+            pid=slot.proc.pid if slot.proc else None,
+            restart=slot.restarts)
         if self.app is not None:
             self.app.add_worker(slot.url)
         log.warning("fleet: slot %d restored at %s (restart #%d)",
@@ -487,6 +533,8 @@ class Supervisor:
                        f"{self.crash_window_s:g}s ({why})")
         slot.proc = None
         self.registry.counter("fleet.slot_quarantines").inc()
+        self.events.emit("quarantine", slot=slot.index,
+                         worker=slot.url, reason=slot.reason)
         self.quarantine.add(
             ("fleet-slot", slot.index), f"slot{slot.index}",
             slot.url or "<never started>",
@@ -501,17 +549,37 @@ class Supervisor:
     # ---- elastic scaling ----
 
     def _evaluate_scaling(self, now: float) -> None:
-        if self.target_queue_age_s <= 0 or self.queue_age_fn is None:
-            return
-        age = self.queue_age_fn()
-        if age > self.target_queue_age_s:
-            self._idle_ticks = 0
-            if self.capacity < self.max_workers \
-                    and now - self._last_scale \
-                    >= self.scale_cooldown_s:
-                self.scale_up(
-                    reason=f"queue_age {age:.2f}s > target "
-                           f"{self.target_queue_age_s:g}s")
+        age = None
+        if self.target_queue_age_s > 0 \
+                and self.queue_age_fn is not None:
+            age = self.queue_age_fn()
+            if age > self.target_queue_age_s:
+                self._idle_ticks = 0
+                if self.capacity < self.max_workers \
+                        and now - self._last_scale \
+                        >= self.scale_cooldown_s:
+                    self.scale_up(
+                        reason=f"queue_age {age:.2f}s > target "
+                               f"{self.target_queue_age_s:g}s")
+                return
+        # second trigger, independent of backlog: the fleet SLO burn
+        # rate (obs/fleetplane.py rollup). Errors and p99 blowups burn
+        # budget WITHOUT aging the queue — a half-broken fleet answers
+        # fast — so queue age alone would never scale it. A breach
+        # also resets the idle count: a burning fleet is not idle.
+        if self.burn_threshold > 0 and self.burn_rate_fn is not None:
+            burn = self.burn_rate_fn()
+            if burn > self.burn_threshold:
+                self._idle_ticks = 0
+                if self.capacity < self.max_workers \
+                        and now - self._last_scale \
+                        >= self.scale_cooldown_s:
+                    self.scale_up(
+                        reason=f"slo burn_rate {burn:.2f} > "
+                               f"{self.burn_threshold:g} "
+                               "(queue age below target)")
+                return
+        if age is None:
             return
         idle = age == 0.0
         if self.app is not None:
@@ -552,6 +620,9 @@ class Supervisor:
         if self.app is not None:
             self.app.add_worker(slot.url)
         self._record_scale("up", reason)
+        self.events.emit("scale_up", slot=slot.index,
+                         worker=slot.url, reason=reason,
+                         capacity=self.capacity)
         self._update_capacity()
         return slot.url
 
@@ -583,6 +654,8 @@ class Supervisor:
             return None
         slot.state = DRAINING
         url = slot.url
+        self.events.emit("drain", slot=slot.index, worker=url,
+                         reason=reason)
         if self.app is not None:
             self.app.drain_worker(url)
             deadline = time.monotonic() + self.drain_timeout_s
@@ -594,5 +667,7 @@ class Supervisor:
         slot.state = STOPPED
         slot.reason = f"scaled down ({reason})"
         self._record_scale("down", reason)
+        self.events.emit("scale_down", slot=slot.index, worker=url,
+                         reason=reason, capacity=self.capacity)
         self._update_capacity()
         return url

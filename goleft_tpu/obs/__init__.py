@@ -54,9 +54,13 @@ def span(name: str, category: str = "", **attrs):
     return TRACER.span(name, category=category, **attrs)
 
 
-def trace(name: str, kind: str = "run", **attrs):
-    """Context manager: a run-scoped root span + fresh trace id."""
-    return TRACER.trace(name, kind=kind, **attrs)
+def trace(name: str, kind: str = "run", trace_id: str | None = None,
+          remote_parent: int | None = None, **attrs):
+    """Context manager: a run-scoped root span + fresh trace id (or an
+    ADOPTED one — ``trace_id``/``remote_parent`` attach the remote
+    context a forwarded ``x-goleft-trace`` header carries)."""
+    return TRACER.trace(name, kind=kind, trace_id=trace_id,
+                        remote_parent=remote_parent, **attrs)
 
 
 def capture() -> "SpanContext":

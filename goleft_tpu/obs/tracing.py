@@ -113,12 +113,26 @@ class Tracer:
         return f"{kind}-{os.getpid()}-{next(self._trace_ids)}"
 
     @contextlib.contextmanager
-    def trace(self, name: str, kind: str = "run", **attrs):
+    def trace(self, name: str, kind: str = "run",
+              trace_id: str | None = None,
+              remote_parent: int | None = None, **attrs):
         """Run-scoped root: sets this thread's trace id and opens the
         root span; yields the root :class:`Span` (its ``trace_id`` is
-        the invocation's id)."""
+        the invocation's id).
+
+        ``trace_id``/``remote_parent`` adopt a REMOTE context (the
+        ``x-goleft-trace`` header): the root joins the caller's trace
+        instead of minting one, and the foreign parent span id is
+        recorded as the ``remote_parent`` attribute — NOT as
+        ``parent_id``, which stays process-local (a foreign id in the
+        local parent chain could alias a local span; the fleet
+        stitcher resolves ``remote_parent`` against the remote
+        process's tree instead)."""
         prev = self._ctx.trace_id
-        self._ctx.trace_id = self.new_trace_id(kind)
+        self._ctx.trace_id = trace_id if trace_id \
+            else self.new_trace_id(kind)
+        if remote_parent is not None:
+            attrs = dict(attrs, remote_parent=remote_parent)
         try:
             with self.span(name, **attrs) as root:
                 yield root

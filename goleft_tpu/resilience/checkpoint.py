@@ -77,6 +77,38 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def iter_journal_lines(path: str, on_torn=None,
+                       stop_on_torn: bool = True):
+    """Yield parsed JSON records from an fsync'd append-only journal,
+    tolerating torn lines a crash mid-append can leave. With
+    ``stop_on_torn`` (the checkpoint replay contract) iteration stops
+    at the first torn line — everything before it is intact and
+    nothing can follow it, because the store truncates or recomputes.
+    The fleet event journal instead CONTINUES across restarts (a new
+    writer starts a fresh line after the torn one), so its reader
+    passes ``stop_on_torn=False`` and garbled lines are skipped
+    individually. ``on_torn()`` runs per torn line; a missing file
+    yields nothing."""
+    try:
+        fh = open(path)
+    except FileNotFoundError:
+        return
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if on_torn is not None:
+                    on_torn()
+                if stop_on_torn:
+                    break
+                continue
+            yield rec
+
+
 class CheckpointStore:
     """Keyed atomic block store + fsync'd append-only run journal.
 
@@ -112,30 +144,20 @@ class CheckpointStore:
         self._fh = open(self._journal_path, "a")
 
     def _replay(self) -> None:
-        try:
-            fh = open(self._journal_path)
-        except FileNotFoundError:
-            return
-        with fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    # torn final append (crash mid-write): everything
-                    # before it is intact, the torn shard recomputes
-                    log.warning("journal %s: ignoring torn line",
-                                self._journal_path)
-                    break
-                rel = rec.get("f")
-                kh = rec.get("k")
-                if not kh or not rel:
-                    continue
-                if os.path.exists(os.path.join(self.dir, rel)):
-                    self._completed[kh] = rel
-                    self._c_replayed.inc()
+        # torn final append (crash mid-write): everything before it is
+        # intact, the torn shard recomputes
+        for rec in iter_journal_lines(
+                self._journal_path,
+                on_torn=lambda: log.warning(
+                    "journal %s: ignoring torn line",
+                    self._journal_path)):
+            rel = rec.get("f")
+            kh = rec.get("k")
+            if not kh or not rel:
+                continue
+            if os.path.exists(os.path.join(self.dir, rel)):
+                self._completed[kh] = rel
+                self._c_replayed.inc()
         log.info("journal replay: %d committed shard(s) in %s",
                  len(self._completed), self.dir)
 

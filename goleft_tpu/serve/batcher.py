@@ -84,6 +84,8 @@ class _Item:          # compare payloads
     error: BaseException | None = None
     requeues: int = 0
     enqueued: float = 0.0  # time.monotonic() at admission
+    ctx: Any = None  # submitter's SpanContext: the batch trace links
+    #                  back to the anchor's request trace through it
 
     def finish(self, result=None, error=None) -> None:
         self.result = result
@@ -137,7 +139,10 @@ class MicroBatcher:
         """Block until the item's batch ran; return its result or
         re-raise its error. ``timeout_s`` is the full request deadline
         (queue wait + execution)."""
+        from .. import obs
+
         deadline = time.monotonic() + timeout_s
+        ctx = obs.capture()  # outside the lock: a thread-local read
         with self._cond:
             if not self._accepting:
                 raise Overloaded("server is draining")
@@ -147,7 +152,7 @@ class MicroBatcher:
                 raise Overloaded(
                     f"queue full ({self.max_queue} requests pending)")
             item = _Item(next(self._seq), key, payload, deadline,
-                         enqueued=time.monotonic())
+                         enqueued=time.monotonic(), ctx=ctx)
             self._q.append(item)
             self._cond.notify_all()
         # wait past the deadline by the grace period: if the batch
@@ -220,8 +225,19 @@ class MicroBatcher:
 
         try:
             kind = key[0] if isinstance(key, tuple) and key else key
+            # the batch runs under its OWN trace (it may serve many
+            # requests), but records which request trace anchored it:
+            # parent_trace/parent_span name the anchor's plan-step
+            # span, the link the fleet stitcher grafts the batch tree
+            # back under (obs/fleetplane.py)
+            link = {}
+            ctx = items[0].ctx
+            if ctx is not None and ctx.trace_id is not None:
+                link["parent_trace"] = ctx.trace_id
+                if ctx.parent_id is not None:
+                    link["parent_span"] = ctx.parent_id
             with obs.trace(f"batch.{kind}", kind="serve-batch",
-                           batch=len(items)):
+                           batch=len(items), **link):
                 results = self._run_batch(
                     key, [it.payload for it in items])
             if len(results) != len(items):

@@ -48,7 +48,7 @@ class ServeClient:
     def __init__(self, base_url: str, timeout_s: float = 120.0,
                  retries: int = 0, retry_cap_s: float = 30.0,
                  retry_budget_s: float | None = None,
-                 max_redirects: int = 4):
+                 max_redirects: int = 4, trace: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
         self.retries = retries
@@ -57,6 +57,12 @@ class ServeClient:
         # request (None: bounded by retries × retry_cap_s only)
         self.retry_budget_s = retry_budget_s
         self.max_redirects = max_redirects
+        # trace=True mints a fleet-wide trace id per workload request
+        # and sends it as x-goleft-trace: the router/worker adopt it,
+        # and `last_trace_id` is what you hand to
+        # `goleft-tpu trace <id> --router URL` afterwards
+        self.trace = trace
+        self.last_trace_id: str | None = None
 
     def _post_once(self, url: str, data: bytes | None,
                    headers: dict) -> dict:
@@ -68,6 +74,12 @@ class ServeClient:
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as r:
+                    # the fleet router echoes the trace id it used
+                    # (ours, or one it minted) — keep it so callers
+                    # can fetch the stitched trace afterwards
+                    tid = r.headers.get("x-goleft-trace")
+                    if tid:
+                        self.last_trace_id = tid
                     return json.loads(r.read().decode())
             except urllib.error.HTTPError as e:
                 raw = e.read()
@@ -96,6 +108,13 @@ class ServeClient:
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
+            if self.trace:
+                from ..obs.fleetplane import (
+                    TRACE_HEADER, mint_trace_id,
+                )
+
+                self.last_trace_id = mint_trace_id("cli")
+                headers[TRACE_HEADER] = self.last_trace_id
         attempt = 0
         t0 = time.monotonic()
         while True:
@@ -134,11 +153,36 @@ class ServeClient:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
             return r.read().decode()
 
-    def flight(self, n: int | None = None) -> dict:
+    def flight(self, n: int | None = None,
+               trace_id: str | None = None,
+               kind: str | None = None) -> dict:
         """The flight recorder ring: span trees of the most recent
-        completed requests/batches, newest first."""
-        path = "/debug/flight" + (f"?n={n}" if n is not None else "")
+        completed requests/batches, newest first. ``trace_id`` /
+        ``kind`` filter server-side (trace_id also matches batch trees
+        linked to the request trace)."""
+        from urllib.parse import urlencode
+
+        params = {k: v for k, v in
+                  (("n", n), ("trace_id", trace_id), ("kind", kind))
+                  if v is not None}
+        path = "/debug/flight" + \
+            (f"?{urlencode(params)}" if params else "")
         return self._request(path)
+
+    def fleet_trace(self, trace_id: str) -> dict:
+        """Fleet router only: the stitched cross-process trace for
+        ``trace_id`` — the router's forward spans plus every worker's
+        matching request/batch trees, with a Perfetto export inside
+        (``goleft-tpu trace <id> --router URL`` pretty-prints it)."""
+        from urllib.parse import quote
+
+        return self._request(f"/fleet/trace/{quote(trace_id)}")
+
+    def fleet_metrics(self) -> dict:
+        """Fleet router only: the rolled-up worker metrics (counters
+        summed, gauges per-worker + min/max/sum, merged histogram
+        summaries, fleet SLO burn rates)."""
+        return self._request("/fleet/metrics")
 
     def route_plan(self, kind: str, **params) -> list[str]:
         """Fleet router only: the candidate worker order a request

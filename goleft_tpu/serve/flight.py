@@ -29,6 +29,7 @@ never closes (leaked by a crashed thread) is evicted, never leaked.
 from __future__ import annotations
 
 import datetime
+import itertools
 import json
 import os
 import threading
@@ -46,6 +47,8 @@ def _node(sp: Span, t0_root: float) -> dict:
     rec = {
         "name": sp.name,
         "category": sp.category or "span",
+        "span_id": sp.span_id,  # the stitcher's graft anchor: batch
+        # trees link back to the plan-step span that submitted them
         "start_ms": round((sp.t0 - t0_root) * 1e3, 3),
         "duration_ms": round(sp.duration() * 1e3, 3),
         "thread": sp.thread_name or str(sp.thread_id),
@@ -73,6 +76,7 @@ def build_tree(spans: list[Span]) -> dict:
     for n in nodes.values():
         n["children"].sort(key=lambda c: c["start_ms"])
     root["trace_id"] = root_sp.trace_id
+    root["pid"] = os.getpid()  # the stitched export's process track
     root["ts"] = datetime.datetime.fromtimestamp(
         root_sp.t0 + _EPOCH_OFFSET,
         datetime.timezone.utc).isoformat(timespec="milliseconds")
@@ -91,6 +95,7 @@ class FlightRecorder:
         self._open: OrderedDict[str, list] = OrderedDict()
         self._overflow: dict[str, int] = {}
         self.records_dropped = 0
+        self._dump_seq = itertools.count(1)
         self._lock = threading.Lock()
 
     # the tracer listener: called once per COMPLETED span, any thread
@@ -125,14 +130,41 @@ class FlightRecorder:
                 self.records_dropped += 1
             self._records.append(tree)
 
-    def snapshot(self, n: int | None = None) -> list[dict]:
-        """Newest-first copy of the ring (``n`` limits the count)."""
+    @staticmethod
+    def _matches(rec: dict, trace_id: str | None,
+                 kind: str | None) -> bool:
+        if trace_id is not None:
+            # a batch tree runs under its OWN trace but links back to
+            # the request trace that anchored it (parent_trace) — a
+            # trace_id query returns both, which is exactly what the
+            # fleet stitcher pulls per worker
+            if rec.get("trace_id") != trace_id and \
+                    (rec.get("attrs") or {}).get("parent_trace") \
+                    != trace_id:
+                return False
+        if kind is not None:
+            # root names are request.<kind> / batch.<kind>
+            if rec.get("name", "").partition(".")[2] != kind:
+                return False
+        return True
+
+    def snapshot(self, n: int | None = None,
+                 trace_id: str | None = None,
+                 kind: str | None = None) -> list[dict]:
+        """Newest-first copy of the ring; ``trace_id``/``kind`` filter
+        (applied BEFORE ``n`` truncates, so a filtered query still
+        sees the whole ring)."""
         with self._lock:
             out = list(self._records)[::-1]
+        if trace_id is not None or kind is not None:
+            out = [r for r in out
+                   if self._matches(r, trace_id, kind)]
         return out[:n] if n is not None else out
 
-    def to_dict(self, n: int | None = None) -> dict:
-        recs = self.snapshot(n)
+    def to_dict(self, n: int | None = None,
+                trace_id: str | None = None,
+                kind: str | None = None) -> dict:
+        recs = self.snapshot(n, trace_id=trace_id, kind=kind)
         return {
             "records": recs,
             "count": len(recs),
@@ -142,11 +174,17 @@ class FlightRecorder:
 
     def dump(self, directory: str = ".",
              prefix: str = "goleft-serve-flight") -> str:
-        """Write the ring to ``<dir>/<prefix>-<utc ts>.json``
-        (atomic); returns the path. The SIGUSR1 handler's body."""
+        """Write the ring to ``<dir>/<prefix>-<utc ts>-<seq>.json``
+        (atomic); returns the path. The SIGUSR1 handler's body.
+
+        The monotonic per-recorder sequence makes the name unique even
+        when two dumps land inside one timestamp granule (two SIGUSR1s
+        in quick succession used to overwrite each other — the second
+        dump silently destroyed the first incident's evidence)."""
         ts = datetime.datetime.now(datetime.timezone.utc) \
-            .strftime("%Y%m%dT%H%M%S.%f")
-        path = os.path.join(directory, f"{prefix}-{ts}.json")
+            .strftime("%Y%m%dT%H%M%S")
+        path = os.path.join(
+            directory, f"{prefix}-{ts}-{next(self._dump_seq):03d}.json")
         doc = {
             "ts": datetime.datetime.now(datetime.timezone.utc)
             .isoformat(timespec="seconds"),

@@ -205,14 +205,26 @@ class ServeApp:
         files = tuple(file_key(p) for p in ex.cache_files(req))
         return (kind, params, files)
 
-    def handle(self, kind: str, req: dict) -> tuple[int, dict]:
+    def handle(self, kind: str, req: dict,
+               trace_ctx: tuple[str, int | None] | None = None) \
+            -> tuple[int, dict]:
         """One request → (http status, response dict). Runs under its
         own run-scoped trace: every serve request gets a trace id, and
         the spans its handler thread records (cache lookup, batcher
-        wait) parent under the request root."""
+        wait) parent under the request root.
+
+        ``trace_ctx`` is a parsed ``x-goleft-trace`` header (the fleet
+        router's — or a traced client's — remote context): the request
+        root ADOPTS the remote trace id and records the remote parent
+        span id, so the flight ring retains this worker's piece of the
+        cross-process trace under the fleet-wide id and the router's
+        ``/fleet/trace/<id>`` can stitch it back together."""
         from .. import obs
 
-        with obs.trace(f"request.{kind}", kind="serve") as root:
+        tid, remote_parent = trace_ctx if trace_ctx else (None, None)
+        with obs.trace(f"request.{kind}", kind="serve",
+                       trace_id=tid,
+                       remote_parent=remote_parent) as root:
             code, body = self._handle(kind, req)
             root.attrs["status"] = code
         return code, body
@@ -256,13 +268,17 @@ class ServeApp:
             # back to their own submit (plan/executor.py).
             from ..plan import Step
 
+            # span= makes the step visible in the request's flight
+            # tree (the stitched trace's plan-step hop); the batcher
+            # captures its context inside this span, so the coalesced
+            # batch trace links back to exactly this node
             out = self._request_executor.run_step(Step(
                 key=ckey if ckey is not None
                 else self._cache_key(kind, req),
                 fn=lambda: self.batcher.submit(
                     ex.group_key(req), req, timeout_s=timeout),
                 name=f"serve.request.{kind}", retry=False,
-                dedup=True))
+                dedup=True, span=f"plan.step.{kind}"))
             result = out.value_or_raise()
             if out.deduped:
                 self.metrics.inc(f"request_deduped_total.{kind}")
@@ -453,7 +469,10 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._respond(400, {"error": "n must be an integer"})
                 return
-            self._respond(200, self.app.flight.to_dict(n))
+            trace_id = q["trace_id"][0] if "trace_id" in q else None
+            kind = q["kind"][0] if "kind" in q else None
+            self._respond(200, self.app.flight.to_dict(
+                n, trace_id=trace_id, kind=kind))
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 
@@ -478,7 +497,12 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._respond(400, {"error": f"bad JSON body: {e}"})
             return
-        code, body = self.app.handle(kind, req)
+        from ..obs.fleetplane import TRACE_HEADER, parse_trace_header
+
+        code, body = self.app.handle(
+            kind, req,
+            trace_ctx=parse_trace_header(
+                self.headers.get(TRACE_HEADER)))
         self._respond(code, body)
 
 

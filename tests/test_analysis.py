@@ -430,3 +430,72 @@ def test_plan_lint_shim_still_works():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert r.returncode == 0, r.stdout + r.stderr
     assert "plan-lint: ok" in r.stdout
+
+
+# ---------------- obs-span-leak ----------------
+
+
+def test_obs_span_leak_flags_discarded_and_unentered(tmp_path):
+    rules, res = _rules(tmp_path, {"serve/s.py": (
+        "from .. import obs\n"
+        "def f(tracer):\n"
+        "    obs.span('discarded', n=1)\n"
+        "    sp = tracer.span('never-entered')\n"
+        "    obs.device_span('also-discarded')\n"
+        "    self_like = 0\n"
+    )}, only=["obs-span-leak"])
+    assert rules == ["obs-span-leak"] * 3
+    lines = sorted(f.line for f in res.findings)
+    assert lines == [3, 4, 5]
+
+
+def test_obs_span_leak_clean_shapes(tmp_path):
+    """with-entry, return (factory helpers), enter_context, call
+    arguments and assigned-then-entered are all legitimate."""
+    rules, _ = _rules(tmp_path, {"serve/ok.py": (
+        "import contextlib\n"
+        "from .. import obs\n"
+        "def f(tracer, stack):\n"
+        "    with obs.span('direct'):\n"
+        "        pass\n"
+        "    with obs.trace('root', kind='serve') as r:\n"
+        "        pass\n"
+        "    sp = tracer.span('later')\n"
+        "    with sp:\n"
+        "        pass\n"
+        "    stack.enter_context(obs.device_span('stacked'))\n"
+        "    return obs.span('handed-up')\n"
+        "def g():\n"
+        "    return obs.get_tracer().span('via-get-tracer-return')\n"
+    )}, only=["obs-span-leak"])
+    assert rules == []
+
+
+def test_obs_span_leak_get_tracer_receiver_and_self_attr(tmp_path):
+    rules, _ = _rules(tmp_path, {"obsx/t.py": (
+        "from .. import obs\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._tracer = obs.get_tracer()\n"
+        "    def bad(self):\n"
+        "        self._tracer.span('leak')\n"
+        "        obs.get_tracer().span('leak2')\n"
+        "    def good(self):\n"
+        "        with self._tracer.span('fine'):\n"
+        "            pass\n"
+    )}, only=["obs-span-leak"])
+    assert rules == ["obs-span-leak"] * 2
+
+
+def test_obs_span_leak_waiver_and_unrelated_span_methods(tmp_path):
+    rules, res = _rules(tmp_path, {"obsx/w.py": (
+        "from .. import obs\n"
+        "def f(doc, tracer):\n"
+        "    tracer.span('waived')  "
+        "# gtlint: ok obs-span-leak — fixture\n"
+        "    doc.span('not-a-tracer')\n"
+        "    return None\n"
+    )}, only=["obs-span-leak"])
+    # the waived call is suppressed; doc.span() is not a tracer
+    assert rules == []
+    assert res.waived == 1

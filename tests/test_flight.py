@@ -252,3 +252,87 @@ def test_prometheus_render_is_deterministic_and_sanitized():
             continue
         name = line.split("{")[0].split(" ")[0]
         assert prometheus._NAME_OK.match(name), name
+
+
+# ---------------- fleet-plane satellites ----------------
+
+
+def test_flight_filters_trace_id_and_kind_newest_first():
+    """/debug/flight?trace_id=&kind= — and a trace_id query also
+    returns the batch tree LINKED to the request trace (what the
+    fleet stitcher pulls per worker)."""
+    tracer = obs.get_tracer()
+    fr = FlightRecorder(max_records=16)
+    tracer.add_listener(fr.on_span)
+    try:
+        with tracer.trace("request.depth", kind="serve",
+                          trace_id="serve-cli-7-1"):
+            pass
+        with tracer.trace("request.indexcov", kind="serve",
+                          trace_id="serve-cli-7-2"):
+            pass
+        # a batch tree under its own trace, linked to trace 1
+        with tracer.trace("batch.depth", kind="serve-batch",
+                          parent_trace="serve-cli-7-1",
+                          parent_span=123):
+            pass
+        with tracer.trace("request.depth", kind="serve",
+                          trace_id="serve-cli-7-3"):
+            pass
+    finally:
+        tracer.remove_listener(fr.on_span)
+    # kind filter + newest-first together
+    depth = fr.snapshot(kind="depth")
+    assert [r["name"] for r in depth] == \
+        ["request.depth", "batch.depth", "request.depth"]
+    assert depth[0]["trace_id"] == "serve-cli-7-3"  # newest first
+    # trace filter returns the request tree AND its linked batch tree
+    t1 = fr.snapshot(trace_id="serve-cli-7-1")
+    assert sorted(r["name"] for r in t1) == \
+        ["batch.depth", "request.depth"]
+    # combined filters; n truncates AFTER filtering
+    assert [r["name"] for r in
+            fr.snapshot(trace_id="serve-cli-7-1", kind="depth")] \
+        == ["batch.depth", "request.depth"]
+    assert len(fr.snapshot(n=1, kind="depth")) == 1
+    assert fr.snapshot(trace_id="serve-cli-7-9") == []
+
+
+def test_debug_flight_http_filters(light_server):
+    app, url = light_server
+    app.handle("nope", {},
+               trace_ctx=("serve-cli-8-1", 55))
+    app.handle("other", {})
+    status, _, body = _get(
+        url + "/debug/flight?trace_id=serve-cli-8-1")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["count"] == 1
+    rec = doc["records"][0]
+    assert rec["trace_id"] == "serve-cli-8-1"
+    # the adopted remote context is recorded for the stitcher
+    assert rec["attrs"]["remote_parent"] == 55
+    assert rec["pid"] and rec["span_id"]
+    status, _, body = _get(url + "/debug/flight?kind=other")
+    assert json.loads(body)["records"][0]["name"] == "request.other"
+
+
+def test_flight_dump_names_never_collide(tmp_path):
+    """Satellite pin: two dumps within the same second must both
+    survive (the old timestamp-only name overwrote the first)."""
+    tracer = obs.get_tracer()
+    fr = FlightRecorder()
+    tracer.add_listener(fr.on_span)
+    try:
+        _serve_trace(tracer)
+    finally:
+        tracer.remove_listener(fr.on_span)
+    p1 = fr.dump(str(tmp_path))
+    p2 = fr.dump(str(tmp_path))  # same second, same ring
+    assert p1 != p2
+    import os
+
+    assert os.path.exists(p1) and os.path.exists(p2)
+    for p in (p1, p2):
+        with open(p) as fh:
+            assert json.load(fh)["count"] == 1

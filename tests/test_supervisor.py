@@ -284,3 +284,127 @@ def test_supervisor_module_does_not_import_jax():
          "sys.exit(1 if 'jax' in sys.modules else 0)"],
         capture_output=True, timeout=120)
     assert r.returncode == 0, r.stderr.decode()
+
+
+# ---------------- fleet observability plane ----------------
+
+
+def test_burn_rate_breach_scales_up_with_quiet_queue(stub_script):
+    """The acceptance pin: an injected SLO burn-rate breach scales
+    the fleet up while queue age sits BELOW target — errors/latency
+    burn budget without aging the queue, so queue age alone would
+    never trigger."""
+    burn = {"v": 0.4}
+    sup = _supervisor(stub_script, min_workers=1, max_workers=3,
+                      target_queue_age_s=5.0,  # queue trigger armed
+                      scale_cooldown_s=0.0,
+                      burn_threshold=1.0,
+                      burn_rate_fn=lambda: burn["v"],
+                      queue_age_fn=lambda: 0.0)  # queue ALWAYS quiet
+    sup.spawn_initial(1)
+    try:
+        sup.tick()
+        assert sup.capacity == 1  # burn below threshold: no scaling
+        burn["v"] = 2.5  # breach
+        _drive(sup, lambda: sup.capacity == 2, what="burn scale-up")
+        ev = [e["type"] for e in sup.events.block()["recent"]]
+        assert "scale_up" in ev
+        reasons = [e.get("reason", "") for e
+                   in sup.events.block()["recent"]
+                   if e["type"] == "scale_up"]
+        assert any("burn_rate 2.5" in r for r in reasons)
+        # burn cleared + queue quiet: the idle path may scale back
+        # down eventually, but a live breach never counts as idle
+        assert sup._idle_ticks == 0
+    finally:
+        sup.close()
+
+
+def test_events_journal_records_lifecycle(stub_script, tmp_path):
+    """Every transition lands in events.jsonl (fsync'd, replayable):
+    spawn → kill -9 → death + backoff + restart, then queryable with
+    the filters the CLI exposes."""
+    journal = str(tmp_path / "events.jsonl")
+    sup = _supervisor(stub_script, min_workers=1,
+                      crash_limit=5, crash_window_s=60.0,
+                      events_journal=journal)
+    sup.spawn_initial(1)
+    try:
+        slot = sup.slots()[0]
+        pid = slot.proc.pid
+        slot.proc.kill()
+        slot.proc.wait(timeout=10)
+        _drive(sup, lambda: sup.slots()[0].restarts == 1,
+               what="restart after SIGKILL")
+    finally:
+        sup.close()
+    from goleft_tpu.obs.events import read_events
+
+    evs = read_events(journal)
+    types = [e["type"] for e in evs]
+    for expected in ("spawn", "death", "backoff", "restart", "stop"):
+        assert expected in types, (expected, types)
+    # ordering tells the story: spawn before death before restart
+    assert types.index("spawn") < types.index("death") \
+        < types.index("restart")
+    death = next(e for e in evs if e["type"] == "death")
+    assert death["slot"] == 0 and death["pid"] == pid
+    assert "rc=-9" in death["why"]
+    # filters (the `goleft-tpu fleet events` surface)
+    assert all(e["type"] == "death"
+               for e in read_events(journal, type="death"))
+    assert read_events(journal, slot=99) == []
+    # the /metrics block: counters + newest-first ring
+    block = sup.events.block()
+    assert block["journal"] == journal
+    assert block["recent"][0]["type"] == "stop"
+
+
+def test_fleet_events_cli_json_schema_stable(stub_script, tmp_path):
+    """`goleft-tpu fleet events --json` is a schema-stable document
+    (the acceptance pin) and the filters narrow it."""
+    import json as _json
+
+    journal = str(tmp_path / "events.jsonl")
+    from goleft_tpu.obs.events import EventJournal
+
+    with EventJournal(journal) as j:
+        j.append("spawn", slot=0, worker="http://w0", pid=1)
+        j.append("death", slot=0, worker="http://w0", why="rc=-9")
+        j.append("scale_up", slot=1, worker="http://w1",
+                 reason="slo burn_rate 2.00 > 1")
+    import contextlib
+    import io
+
+    from goleft_tpu.commands.fleet import events_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = events_main(["--journal", journal, "--json"])
+    assert rc == 0
+    doc = _json.loads(buf.getvalue())
+    assert doc["schema"] == "goleft-tpu.fleet-events/1"
+    assert doc["count"] == 3
+    assert [e["type"] for e in doc["events"]] \
+        == ["spawn", "death", "scale_up"]
+    assert all(e["schema"] == "goleft-tpu.fleet-event/1"
+               for e in doc["events"])
+    # stable key order (sort_keys) — byte-identical on re-render
+    buf2 = io.StringIO()
+    with contextlib.redirect_stdout(buf2):
+        events_main(["--journal", journal, "--json"])
+    assert buf.getvalue() == buf2.getvalue()
+    # filtered
+    buf3 = io.StringIO()
+    with contextlib.redirect_stdout(buf3):
+        events_main(["--journal", journal, "--json", "--type",
+                     "scale_up"])
+    assert _json.loads(buf3.getvalue())["count"] == 1
+    # human table goes to stdout without crashing
+    buf4 = io.StringIO()
+    with contextlib.redirect_stdout(buf4):
+        assert events_main(["--journal", journal]) == 0
+    assert "scale_up" in buf4.getvalue()
+    # missing journal: loud exit 1
+    assert events_main(["--journal",
+                        str(tmp_path / "nope.jsonl")]) == 1
