@@ -7,8 +7,8 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        fleet-obs-smoke decode-smoke perf-gate lint lint-changed \
-        plan-lint check clean
+        fleet-obs-smoke federation-chaos decode-smoke perf-gate \
+        lint lint-changed plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -145,10 +145,24 @@ decode-smoke:
 fleet-obs-smoke:
 	python -m goleft_tpu.obs.fleet_smoke
 
+# the federation tier's contracts against real subprocess tiers (a
+# federation router fronting two real fleets, each a supervised serve
+# worker): a flooding tenant is shed at the federation front door
+# (429 + honest retry_after_s, federation.tenant.burn_rate gauges in
+# both /metrics encodings) while a quiet tenant's concurrent requests
+# all land byte-identically; SIGKILL of one fleet's ROUTER mid-flight
+# yields byte-identical 200s through the surviving fleet within the
+# client's retry budget; and the healed fleet (router restarted in
+# attach mode over its surviving worker) rejoins through a half-open
+# probe and its affinity key routes home again. Host-pinned like the
+# other smokes.
+federation-chaos:
+	python -m goleft_tpu.fleet.federation_smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
 # the test suite, then the end-to-end proofs
 check: lint plan-lint test decode-smoke fleet-smoke fleet-chaos \
-       fleet-obs-smoke
+       fleet-obs-smoke federation-chaos
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

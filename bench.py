@@ -1467,6 +1467,11 @@ def host_suite(quick: bool, emit=None) -> dict:
     except Exception as e:  # noqa: BLE001
         _put("fleet_restart_recovery_s", {"error": repr(e)})
     try:
+        _put("fleet_failover_recovery_s",
+             _fleet_failover_recovery_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("fleet_failover_recovery_s", {"error": repr(e)})
+    try:
         _put("cohort_resume_overhead", _resume_overhead_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("cohort_resume_overhead", {"error": repr(e)})
@@ -1993,6 +1998,154 @@ def _fleet_restart_recovery_entry(quick: bool) -> dict:
                 "full capacity (restart counted, both workers "
                 "eligible, routed request answered); dominated by "
                 "worker process bring-up",
+    }
+
+
+def _fleet_failover_recovery_entry(quick: bool) -> dict:
+    """The FEDERATION tier's MTTR for losing an entire fleet: SIGKILL
+    one fleet's ROUTER (real ``goleft-tpu fleet`` subprocesses — the
+    fleet's single point of failure, its supervisor dying with it)
+    behind an in-process FederationRouter and time two spans:
+
+      - ``failover_seconds``: kill → a request for the dead fleet's
+        affinity key answered byte-identically through the surviving
+        fleet (what a client pays during the loss);
+      - ``recovery_seconds``: router restart (attach mode over the
+        worker that survived it) → federation-observed full capacity
+        — the healed fleet half-open probed, rejoined, and the
+        affinity key ROUTED HOME again (what the fleet's keyspace
+        pays before its caches serve it locally again).
+
+    Both gated lower-is-better (``goleft-tpu perf check``)."""
+    import json as _json
+    import os
+    import shutil
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import jax as _jax
+
+    from goleft_tpu.fleet.federation import (
+        FederationRouter, FederationThread,
+    )
+    from goleft_tpu.serve.client import ServeClient
+
+    n_trials = 1 if quick else 3
+    d, bams, fai, _ = _build_cohort_fixture(2, 200_000, 4)
+    env = dict(os.environ, GOLEFT_TPU_PROBE="0")
+    env.pop("GOLEFT_TPU_FAULTS", None)
+
+    def _get_json(url):
+        req = urllib.request.Request(
+            url, headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read().decode())
+
+    def spawn_fleet(args):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "goleft_tpu", "fleet", *args],
+            stdout=subprocess.PIPE, text=True, env=env)
+        deadline = time.monotonic() + 300
+        line = ""
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line or "listening on " in line:
+                break
+        if "listening on " not in line:
+            proc.kill()
+            proc.wait(timeout=10)
+            raise RuntimeError("fleet never announced")
+        return proc, line.rsplit("listening on ", 1)[1].strip() \
+            .rstrip("/")
+
+    fleets: dict[str, dict] = {}
+    failovers: list[float] = []
+    recoveries: list[float] = []
+    try:
+        for _i in range(2):
+            proc, url = spawn_fleet(
+                ["--port", "0", "--workers", "1",
+                 "--poll-interval-s", "0.25", "--down-after", "1",
+                 "--supervise-interval-s", "0.1",
+                 "--worker-args=--no-warmup"])
+            slots = _get_json(url + "/metrics")["supervisor"]["slots"]
+            fleets[url] = {"proc": proc,
+                           "worker_url": slots[0]["url"],
+                           "worker_pid": slots[0]["pid"],
+                           "port": url.rsplit(":", 1)[-1]}
+        app = FederationRouter(list(fleets), poll_interval_s=0.25,
+                               down_after=1)
+        with FederationThread(app) as fed_url:
+            client = ServeClient(fed_url, timeout_s=300.0,
+                                 retries=6, retry_cap_s=1.0)
+            r0 = client.depth(bams[0], fai=fai)  # warm + home key
+            home = client.route_plan("depth", bam=bams[0],
+                                     fai=fai)[0]
+            port = fleets[home]["port"]
+            for trial in range(n_trials):
+                rec = fleets[home]
+                t0 = time.perf_counter()
+                rec["proc"].kill()
+                rec["proc"].wait(timeout=30)
+                r = client.depth(bams[0], fai=fai)
+                assert r["depth_bed"] == r0["depth_bed"]
+                failovers.append(round(time.perf_counter() - t0, 3))
+                t1 = time.perf_counter()
+                routed0 = app.registry.snapshot()["counters"].get(
+                    f"federation.routed_total.{port}.depth", 0)
+                proc2, _url2 = spawn_fleet(
+                    ["--port", port, "--worker", rec["worker_url"],
+                     "--poll-interval-s", "0.25",
+                     "--down-after", "1"])
+                rec["proc"] = proc2
+                deadline = time.perf_counter() + 300
+                while time.perf_counter() < deadline:
+                    if app.pool.snapshot()[home]["state"] \
+                            in ("probe", "up"):
+                        break
+                    time.sleep(0.02)
+                else:
+                    raise RuntimeError("fleet never half-opened")
+                # the probe request: must land HOME, byte-identical
+                r = client.depth(bams[0], fai=fai,
+                                 cache_buster=f"t{trial}")
+                assert r["depth_bed"] == r0["depth_bed"]
+                snap = app.registry.snapshot()["counters"]
+                assert snap.get(
+                    f"federation.routed_total.{port}.depth",
+                    0) > routed0, "probe did not route home"
+                recoveries.append(round(time.perf_counter() - t1, 3))
+    finally:
+        for rec in fleets.values():
+            proc = rec["proc"]
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)
+        for rec in fleets.values():
+            try:
+                rec["proc"].wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                rec["proc"].kill()
+            if rec["proc"].stdout is not None:
+                rec["proc"].stdout.close()
+            try:
+                os.kill(rec["worker_pid"], _signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+        shutil.rmtree(d, ignore_errors=True)
+    fs, rs = sorted(failovers), sorted(recoveries)
+    return {
+        "fleets": 2, "workers_per_fleet": 1, "trials": n_trials,
+        "failover_seconds": fs[len(fs) // 2],
+        "recovery_seconds": rs[len(rs) // 2],
+        "failover_s_each": failovers,
+        "recovery_s_each": recoveries,
+        "platform": _jax.default_backend(),
+        "note": "SIGKILL a fleet ROUTER behind the federation: "
+                "failover = kill -> byte-identical 200 via the "
+                "surviving fleet; recovery = router restart (attach "
+                "mode) -> half-open probe -> affinity key routed "
+                "home; dominated by fleet process bring-up",
     }
 
 

@@ -95,6 +95,11 @@ PROGS = {
     # pure HTTP client over the router's /fleet/trace — no device
     "trace": ("fetch + pretty-print a stitched cross-process fleet "
               "trace", _lazy(".commands.trace_cmd"), False),
+    # the tier above fleet: fronts N fleet routers (which spawn and
+    # supervise their own workers) — jax-free like the fleet router
+    "federation": ("multi-fleet failover tier with tenant-scoped "
+                   "overload isolation",
+                   _lazy(".commands.federation"), False),
 }
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",

@@ -93,6 +93,35 @@ AFFINITY_FIELDS = {
 }
 
 
+def request_affinity_key(kind: str, req: dict) -> str:
+    """The ring key for one request: every input file's content
+    identity, in order. Falls back to the raw path when the file
+    cannot be stat'd (routing must not 500 a request validation will
+    400) and to the canonical body when the request names no file.
+    Shared by the fleet router (worker affinity) and the federation
+    tier (fleet affinity) — the SAME key at both levels is what keeps
+    a file's whole serving path (fleet, worker, caches, jits) warm."""
+    paths: list[str] = []
+    for field in AFFINITY_FIELDS.get(kind, ()):
+        v = req.get(field)
+        if isinstance(v, str):
+            paths.append(v)
+        elif isinstance(v, (list, tuple)):
+            paths.extend(p for p in v if isinstance(p, str))
+    if not paths:
+        return kind + ":" + json.dumps(
+            {k: v for k, v in sorted(req.items())
+             if k not in ("tenant", "priority", "timeout_s")},
+            sort_keys=True, default=str)
+    parts = []
+    for p in paths:
+        try:
+            parts.append(repr(_file_key(p)))
+        except OSError:
+            parts.append(p)
+    return "|".join(parts)
+
+
 class HashRing:
     """Consistent hash ring with virtual nodes.
 
@@ -192,6 +221,10 @@ class _Worker:
         self.consecutive_fails = 0
         self.open_breakers: frozenset[str] = frozenset()
         self.availability: float | None = None
+        self.clock_offset_s: float | None = None  # estimated wall-
+        # clock skew (positive = this worker's clock runs AHEAD of
+        # ours), midpoint-of-poll estimate, EWMA-smoothed — the
+        # stitcher's cross-host rebase correction
         self.last_poll_s: float | None = None
         self.last_metrics: dict | None = None  # full polled /metrics
         # body — the fleet rollup's raw material (None until a poll
@@ -249,7 +282,9 @@ class WorkerPool:
 
     def _poll_one(self, w: _Worker) -> None:
         try:
+            t0_wall = time.time()
             h = self._fetch_json(w.url + "/healthz")
+            t1_wall = time.time()
             m = self._fetch_json(w.url + "/metrics")
         except Exception as e:  # noqa: BLE001 — any poll failure = a miss
             with self._lock:
@@ -269,6 +304,16 @@ class WorkerPool:
             kind for kind, state in (m.get("breakers") or {}).items()
             if is_shedding(state))
         slo = m.get("slo") or {}
+        # clock handshake: the worker stamped its wall clock into the
+        # healthz body; the midpoint of our request/response wall
+        # stamps is the unbiased estimate of when that stamp was taken
+        # on OUR clock, so the difference is the worker's skew.
+        # EWMA-smoothed: one slow poll (asymmetric network time) must
+        # not jerk the stitched timeline around.
+        offset = None
+        if isinstance(h.get("now"), (int, float)) \
+                and not isinstance(h.get("now"), bool):
+            offset = float(h["now"]) - (t0_wall + t1_wall) / 2.0
         with self._lock:
             if not w.healthy:
                 log.warning("fleet: worker %s recovered", w.url)
@@ -277,6 +322,9 @@ class WorkerPool:
             w.draining = h.get("status") == "draining"
             w.open_breakers = breakers
             w.availability = slo.get("availability")
+            if offset is not None:
+                w.clock_offset_s = offset if w.clock_offset_s is None \
+                    else 0.7 * w.clock_offset_s + 0.3 * offset
             w.last_metrics = m
             w.last_poll_s = time.monotonic()
 
@@ -306,6 +354,14 @@ class WorkerPool:
             wait = min(self.poll_interval_s,
                        max(0.02, nxt - time.monotonic()))
             self._stop.wait(wait)
+
+    def clock_offsets(self) -> dict[str, float]:
+        """{url: estimated wall-clock offset seconds} over workers
+        with an estimate — the trace stitcher's rebase correction."""
+        with self._lock:
+            return {u: w.clock_offset_s
+                    for u, w in sorted(self.workers.items())
+                    if w.clock_offset_s is not None}
 
     def metrics_by_worker(self) -> dict[str, dict]:
         """{label: last polled /metrics body} over workers that have
@@ -509,29 +565,9 @@ class RouterApp:
     # ---- routing ----
 
     def affinity_key(self, kind: str, req: dict) -> str:
-        """The ring key: every input file's content identity, in
-        order. Falls back to the raw path when the file cannot be
-        stat'd (routing must not 500 a request validation will 400)
-        and to the canonical body when the request names no file."""
-        paths: list[str] = []
-        for field in AFFINITY_FIELDS.get(kind, ()):
-            v = req.get(field)
-            if isinstance(v, str):
-                paths.append(v)
-            elif isinstance(v, (list, tuple)):
-                paths.extend(p for p in v if isinstance(p, str))
-        if not paths:
-            return kind + ":" + json.dumps(
-                {k: v for k, v in sorted(req.items())
-                 if k not in ("tenant", "priority", "timeout_s")},
-                sort_keys=True, default=str)
-        parts = []
-        for p in paths:
-            try:
-                parts.append(repr(_file_key(p)))
-            except OSError:
-                parts.append(p)
-        return "|".join(parts)
+        """The ring key (module-level
+        :func:`request_affinity_key`, shared with the federation)."""
+        return request_affinity_key(kind, req)
 
     def plan(self, kind: str, req: dict) -> list[str]:
         """Candidate worker order for this request: the ring walk from
@@ -703,6 +739,10 @@ class RouterApp:
             "status": "ok" if n_up else "degraded",
             "workers": len(snap), "healthy": n_up,
             "uptime_s": round(time.time() - self.started, 1),
+            # wall clock for the tier ABOVE this one: the federation
+            # poller runs the same midpoint clock handshake against
+            # fleet routers that this router runs against workers
+            "now": round(time.time(), 6),
         }
         if self.supervisor is not None:
             body["capacity"] = self.supervisor.capacity
@@ -749,6 +789,9 @@ class RouterApp:
         g("fleet.slo.burn_rate_max").set(slo["burn_rate_max"])
         for ep, r in slo["burn_rate"].items():
             g(f"fleet.slo.burn_rate.{ep}").set(r)
+        for tenant, rec in (slo.get("tenants") or {}).items():
+            g(f"fleet.slo.tenant.burn_rate.{tenant}").set(
+                rec["burn_rate"])
         return merged
 
     def fleet_burn_rate(self) -> float:
@@ -799,7 +842,9 @@ class RouterApp:
             except Exception:  # noqa: BLE001 — a dead worker cannot
                 # veto the stitched view of everyone else's spans
                 worker_records[url] = []
-        stitched = stitch_trace(trace_id, own, worker_records)
+        stitched = stitch_trace(trace_id, own, worker_records,
+                                clock_offsets=self.pool
+                                .clock_offsets())
         if stitched is None:
             return 404, {
                 "error": f"no flight record for trace {trace_id!r} "

@@ -125,34 +125,69 @@ def _merge_counter_maps(maps: list[dict]) -> dict:
     return {k: out[k] for k in sorted(out)}
 
 
-def merge_histogram_summaries(summaries: list[dict]) -> dict:
+def merge_histogram_summaries(summaries: list[dict],
+                              windows: list[list] | None = None) \
+        -> dict:
     """One merged summary from per-worker summaries produced by
     :meth:`~goleft_tpu.obs.metrics.Histogram.summary`.
 
     ``count`` and ``sum`` merge exactly (they are additive); ``max``
-    is the max of maxes (exact); the quantiles are count-weighted
-    means of the per-worker quantiles — an approximation, since true
-    quantiles cannot be recovered from summaries (the caveat is part
-    of the documented contract, not a bug to fix here)."""
-    live = [s for s in summaries if s and s.get("count")]
+    is the max of maxes (exact). Quantiles come in two grades:
+
+      - **exact** — when ``windows`` carries every live worker's
+        bounded raw observation window (the ``latency_windows``
+        block workers ship in /metrics), the merged quantiles are
+        computed over the CONCATENATED windows: the same windowed
+        estimator a single worker uses, applied to the union, so the
+        fleet p99 is exactly what one process holding all the samples
+        would report (``quantile_source: "exact"``).
+      - **approximate** — without raw windows the quantiles fall back
+        to count-weighted means of the per-worker quantiles, which is
+        an approximation (true quantiles cannot be recovered from
+        summaries; ``quantile_source: "approximate"``).
+    """
+    live = [(i, s) for i, s in enumerate(summaries)
+            if s and s.get("count")]
     if not live:
         return {"count": 0}
-    total = sum(s.get("count", 0) for s in live)
+    total = sum(s.get("count", 0) for _, s in live)
     out: dict = {"count": total}
-    sums = [s["sum"] for s in live if isinstance(s.get("sum"),
-                                                 (int, float))]
+    sums = [s["sum"] for _, s in live if isinstance(s.get("sum"),
+                                                    (int, float))]
     if sums:
         out["sum"] = round(sum(sums), 4)
-    maxes = [s["max"] for s in live if isinstance(s.get("max"),
-                                                  (int, float))]
+    maxes = [s["max"] for _, s in live if isinstance(s.get("max"),
+                                                     (int, float))]
     if maxes:
         out["max"] = round(max(maxes), 6)
+    wins = None
+    if windows is not None:
+        wins = [windows[i] if i < len(windows) else None
+                for i, _ in live]
+        if not all(isinstance(w, (list, tuple)) and w for w in wins):
+            wins = None  # any live worker missing its window → fall
+            # back for the whole merge (a mixed exact/approx answer
+            # would claim precision it doesn't have)
+    if wins is not None:
+        from ..utils.profiling import percentiles
+
+        merged = percentiles(
+            [float(v) for w in wins for v in w
+             if isinstance(v, (int, float))])
+        for q in _QUANTILE_KEYS:
+            if merged.get(q) is not None:
+                out[q] = round(merged[q], 6)
+        if merged.get("max") is not None:
+            out["max"] = round(merged["max"], 6)
+        out["quantile_source"] = "exact"
+        return out
     for q in _QUANTILE_KEYS:
-        pairs = [(s.get("count", 0), s[q]) for s in live
+        pairs = [(s.get("count", 0), s[q]) for _, s in live
                  if isinstance(s.get(q), (int, float))]
         w = sum(c for c, _ in pairs)
         if pairs and w > 0:
             out[q] = round(sum(c * v for c, v in pairs) / w, 6)
+    out["quantile_source"] = "approximate"
     return out
 
 
@@ -192,9 +227,14 @@ def merge_worker_metrics(snaps: dict[str, dict],
             [snaps[w].get("batch_size_hist") or {} for w in labels]),
         "gauges": {},
         "histograms": {},
-        "quantile_note": ("histogram quantiles are count-weighted "
+        "quantile_note": ("histogram quantiles are EXACT (computed "
+                          "over the workers' concatenated raw "
+                          "latency windows) when every live worker "
+                          "ships its window, else count-weighted "
                           "means of per-worker summaries "
-                          "(approximate; counts and sums are exact)"),
+                          "(approximate); counts and sums are exact "
+                          "either way — see each merged summary's "
+                          "quantile_source"),
     }
     for gname in GAUGE_FIELDS:
         per = {w: snaps[w][gname] for w in labels
@@ -213,7 +253,9 @@ def merge_worker_metrics(snaps: dict[str, dict],
         out["histograms"][f"latency_s.{name}"] = \
             merge_histogram_summaries(
                 [(snaps[w].get("latency_s") or {}).get(name) or {}
-                 for w in labels])
+                 for w in labels],
+                windows=[(snaps[w].get("latency_windows") or {})
+                         .get(name) for w in labels])
     out["slo"] = _merge_slo(
         [snaps[w].get("slo") or {} for w in labels], error_budget)
     return out
@@ -250,7 +292,46 @@ def _merge_slo(slos: list[dict], error_budget: float) -> dict:
         "error_budget": error_budget,
         "burn_rate": burn,
         "burn_rate_max": round(burn_max, 4),
+        "tenants": merge_tenant_slos(
+            [s.get("tenants") or {} for s in live], budget),
     }
+
+
+def merge_tenant_slos(blocks: list[dict],
+                      error_budget: float) -> dict:
+    """Fold per-source ``tenants`` SLO blocks (the per-tenant
+    dimension workers — and, one level up, fleets — publish) into one
+    view with a burn rate per tenant.
+
+    Error rates merge request-weighted; p99 ratios take the WORST
+    source; ``burn_rate`` is ``max(p99_ratio, error_rate / budget)``
+    — the same definition as the endpoint burn, scoped to one
+    tenant. This is the gauge the federation's tenant-scoped shed is
+    driven by (``federation.tenant.burn_rate.<tenant>``)."""
+    budget = max(error_budget, 1e-9)
+    agg: dict[str, dict] = {}
+    for block in blocks:
+        for tenant, rec in sorted((block or {}).items()):
+            a = agg.setdefault(tenant, {"n": 0, "err_w": 0.0,
+                                        "p99": 0.0})
+            n = rec.get("window_requests") or 0
+            er = rec.get("error_rate")
+            if isinstance(er, (int, float)) and n:
+                a["n"] += n
+                a["err_w"] += n * er
+            r = rec.get("p99_latency_ratio")
+            if isinstance(r, (int, float)):
+                a["p99"] = max(a["p99"], r)
+    out: dict = {}
+    for tenant, a in sorted(agg.items()):
+        er = (a["err_w"] / a["n"]) if a["n"] else 0.0
+        out[tenant] = {
+            "window_requests": a["n"],
+            "error_rate": round(er, 6),
+            "p99_latency_ratio": round(a["p99"], 4),
+            "burn_rate": round(max(a["p99"], er / budget), 4),
+        }
+    return out
 
 
 def rollup_registry_snapshot(merged: dict) -> dict:
@@ -285,6 +366,10 @@ def rollup_registry_snapshot(merged: dict) -> dict:
         gauges[f"fleet.slo.burn_rate.{ep}"] = r
     for ep, r in (slo.get("p99_latency_ratio") or {}).items():
         gauges[f"fleet.slo.p99_latency_ratio.{ep}"] = r
+    for tenant, rec in (slo.get("tenants") or {}).items():
+        if isinstance(rec.get("burn_rate"), (int, float)):
+            gauges[f"fleet.slo.tenant.burn_rate.{tenant}"] = \
+                rec["burn_rate"]
     hists = {f"fleet.worker.{n}": s
              for n, s in merged.get("histograms", {}).items()
              if s.get("count")}
@@ -334,12 +419,19 @@ def _find_span(root: dict, span_id) -> dict | None:
 
 
 def stitch_trace(trace_id: str, router_records: list[dict],
-                 worker_records: dict[str, list[dict]]) -> dict | None:
+                 worker_records: dict[str, list[dict]],
+                 clock_offsets: dict[str, float] | None = None) \
+        -> dict | None:
     """One stitched cross-process tree for ``trace_id``.
 
     ``router_records``: the router's own flight records matching the
     id (newest first); ``worker_records``: per-worker-url lists pulled
-    from ``/debug/flight?trace_id=``. Returns None when NOBODY has the
+    from ``/debug/flight?trace_id=``. ``clock_offsets`` optionally
+    maps a worker url to its estimated wall-clock offset in seconds
+    (positive = that worker's clock runs AHEAD of the router's — the
+    poller's midpoint handshake estimate); a record's epoch is
+    corrected by it before rebasing, so cross-HOST skew does not
+    shear the stitched timeline. Returns None when NOBODY has the
     trace. Grafting:
 
       - the router's ``fleet.request.*`` tree is the stitched root
@@ -391,13 +483,14 @@ def stitch_trace(trace_id: str, router_records: list[dict],
                 batches.append(rec)
         if not req_roots and not batches:
             continue
+        off = float((clock_offsets or {}).get(url) or 0.0)
         n_spans = 0
         for rec in req_roots + batches:
             _annotate(rec, label)
             n_spans += sum(1 for _ in _walk(rec))
             ep = record_epoch(rec)
             if root_epoch is not None and ep is not None:
-                _shift(rec, (ep - root_epoch) * 1e3
+                _shift(rec, (ep - off - root_epoch) * 1e3
                        - rec.get("start_ms", 0.0))
         processes[label] = {
             "pid": (req_roots + batches)[0].get("pid"),
@@ -422,6 +515,86 @@ def stitch_trace(trace_id: str, router_records: list[dict],
         "trace_id": trace_id,
         "processes": processes,
         "span_count": sum(p["spans"] for p in processes.values()),
+        "tree": root,
+    }
+
+
+def stitch_federation(trace_id: str, fed_records: list[dict],
+                      fleet_docs: dict[str, dict | None],
+                      clock_offsets: dict[str, float] | None = None) \
+        -> dict | None:
+    """Compose ONE federation-wide tree from per-fleet stitched docs.
+
+    The graft rules are :func:`stitch_trace`'s applied one level up —
+    a federation hop is just one more ``remote_parent`` level:
+
+      - the federation router's ``federation.request.*`` flight record
+        is the stitched root (synthesized when its ring already
+        evicted the trace but a fleet still holds it);
+      - each fleet's stitched document (the ``GET /fleet/trace/<id>``
+        answer — its own router + worker tree) grafts under the
+        federation ``federation.forward.*`` span whose ``span_id``
+        equals the fleet tree's ``remote_parent`` attr (the forward
+        that carried the request), else under the root;
+      - process labels are namespaced ``fleet:<port>/<process>`` so
+        two fleets' routers (both "router" locally) stay distinct
+        tracks in the Perfetto export;
+      - ``start_ms`` rebases via each fleet root's wall ``ts``,
+        corrected by the federation poller's per-fleet clock offset
+        (the same midpoint handshake the fleet router applies to its
+        workers).
+
+    Returns the same shape as :func:`stitch_trace` — ``format_tree``
+    and :func:`perfetto_export` consume it unchanged. None when no
+    process holds the trace.
+    """
+    import copy
+
+    root = None
+    for rec in fed_records:
+        if rec.get("trace_id") == trace_id:
+            root = copy.deepcopy(rec)
+            break
+    have_fleets = any(d for d in fleet_docs.values())
+    if root is None and not have_fleets:
+        return None
+    if root is None:
+        root = {"name": f"trace.{trace_id}", "trace_id": trace_id,
+                "category": "synthetic", "start_ms": 0.0,
+                "duration_ms": 0.0, "children": [],
+                "synthesized": True}
+    _annotate(root, "federation")
+    root_epoch = record_epoch(root)
+    processes: dict[str, dict] = {
+        "federation": {"pid": root.get("pid"), "spans": sum(
+            1 for _ in _walk(root))}}
+    for url in sorted(fleet_docs):
+        doc = fleet_docs[url]
+        if not doc or not doc.get("tree"):
+            continue
+        label = f"fleet:{url.rsplit(':', 1)[-1]}"
+        tree = copy.deepcopy(doc["tree"])
+        for n in _walk(tree):
+            n["process"] = f"{label}/{n.get('process', '?')}"
+        off = float((clock_offsets or {}).get(url) or 0.0)
+        ep = record_epoch(tree)
+        if root_epoch is not None and ep is not None:
+            _shift(tree, (ep - off - root_epoch) * 1e3
+                   - tree.get("start_ms", 0.0))
+        remote = (tree.get("attrs") or {}).get("remote_parent")
+        parent = _find_span(root, remote) or root
+        parent.setdefault("children", []).append(tree)
+        for pname, pinfo in sorted((doc.get("processes")
+                                    or {}).items()):
+            processes[f"{label}/{pname}"] = dict(pinfo)
+    for n in _walk(root):
+        n.setdefault("children", []).sort(
+            key=lambda c: c.get("start_ms", 0.0))
+    return {
+        "trace_id": trace_id,
+        "processes": processes,
+        "span_count": sum(p.get("spans", 0)
+                          for p in processes.values()),
         "tree": root,
     }
 

@@ -103,6 +103,16 @@ class Histogram:
             out["sum"] = round(total, 4)
         return out
 
+    def window(self) -> list[float]:
+        """The bounded raw observation window (most recent ``maxlen``
+        values, oldest first) — what the fleet rollup concatenates to
+        compute EXACT merged quantiles instead of the count-weighted
+        approximation summaries force on it. Rounded to µs-ish
+        precision so shipping a window over /metrics stays cheap."""
+        with self._lock:
+            vals = list(self._vals)
+        return [round(v, 6) for v in vals]
+
 
 class MetricsRegistry:
     """Thread-safe name → instrument registry (get-or-create)."""
@@ -146,6 +156,14 @@ class MetricsRegistry:
         with self._lock:
             items = list(self._hists.items())
         return {n[len(prefix):]: h.summary()
+                for n, h in sorted(items) if n.startswith(prefix)}
+
+    def histogram_windows(self, prefix: str = "") -> dict[str, list]:
+        """{name: bounded raw window} per histogram under ``prefix`` —
+        the worker-side half of the fleet's exact-quantile merge."""
+        with self._lock:
+            items = list(self._hists.items())
+        return {n[len(prefix):]: h.window()
                 for n, h in sorted(items) if n.startswith(prefix)}
 
     def snapshot(self) -> dict:

@@ -32,6 +32,7 @@ import hashlib
 import os
 import pickle
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Sequence
@@ -63,6 +64,10 @@ class ShardResult:
     from_cache: bool = False
 
 
+#: the eviction lease file's name under a cache directory
+EVICT_LEASE = ".evict.lease"
+
+
 class ResultCache:
     """Pickle-per-key cache under a directory.
 
@@ -74,14 +79,34 @@ class ResultCache:
     for observability; both are safe under concurrent get/put from many
     threads (writes are tmp-file + atomic ``os.replace``, and eviction
     tolerates entries vanishing under it).
+
+    **Eviction is coordinated across consumers of one directory**: a
+    fleet's ``--shared-cache`` tier used to pay N independent LRU
+    scans over the SAME directory — every worker's every put walked
+    the whole listing. Now a single elected SWEEPER owns eviction: a
+    lock-file lease (``.evict.lease`` under the cache dir, atomic
+    O_EXCL create) names the holder; non-holders skip the scan
+    entirely. The holder renews the lease (mtime) on each sweep; a
+    lease older than ``lease_ttl_s`` is presumed orphaned (its holder
+    crashed or was SIGKILLed) and taken over via atomic rename —
+    ``cache.evict_lease_steals_total`` counts takeovers,
+    ``cache.evict_sweeps_total`` the sweeps that actually ran. Two
+    racing stealers can both sweep once (last rename wins the lease);
+    eviction is idempotent, so the race costs one redundant scan,
+    never correctness.
     """
 
-    def __init__(self, directory: str, max_bytes: int | None = None):
+    def __init__(self, directory: str, max_bytes: int | None = None,
+                 lease_ttl_s: float = 30.0):
         self.dir = directory
         self.max_bytes = max_bytes
+        self.lease_ttl_s = lease_ttl_s
         self.hits = 0
         self.misses = 0
         self._lock = threading.Lock()
+        # pid + instance id: distinct per consumer even when several
+        # caches in ONE process share a directory (tests do)
+        self._lease_token = f"{os.getpid()}.{id(self):x}"
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, key: tuple) -> str:
@@ -142,7 +167,63 @@ class ResultCache:
         if self.max_bytes is not None:
             self._evict()
 
+    def _acquire_sweep_lease(self) -> bool:
+        """May THIS consumer run the eviction sweep right now?
+
+        True for the lease holder (created or renewed); False when
+        another consumer holds a live lease (skip the scan — the
+        holder sweeps for everyone). A stale lease (older than
+        ``lease_ttl_s``) is stolen via atomic rename."""
+        path = os.path.join(self.dir, EVICT_LEASE)
+        reg = get_registry()
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            pass
+        except OSError:
+            return False  # unwritable dir: never fail a put over it
+        else:
+            try:
+                os.write(fd, self._lease_token.encode())
+            finally:
+                os.close(fd)
+            return True
+        try:
+            with open(path) as fh:
+                owner = fh.read().strip()
+            st = os.stat(path)
+        except OSError:
+            # the lease vanished or is being replaced under us: skip
+            # this sweep, the next put re-contends
+            return False
+        if owner == self._lease_token:
+            try:
+                os.utime(path)  # renew: a live holder keeps the seat
+            except OSError:
+                pass
+            return True
+        if time.time() - st.st_mtime <= self.lease_ttl_s:
+            return False
+        # stale: the holder stopped sweeping (crashed worker, removed
+        # slot) — take the seat over atomically
+        tmp = path + f".{self._lease_token}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(self._lease_token)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        reg.counter("cache.evict_lease_steals_total").inc()
+        return True
+
     def _evict(self) -> None:
+        if not self._acquire_sweep_lease():
+            return
+        get_registry().counter("cache.evict_sweeps_total").inc()
         entries = []
         try:
             # gtlint: ok det-unsorted-iter — eviction order comes from

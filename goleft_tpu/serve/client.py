@@ -65,12 +65,34 @@ class ServeClient:
         self.last_trace_id: str | None = None
 
     def _post_once(self, url: str, data: bytes | None,
-                   headers: dict) -> dict:
+                   headers: dict, hops: list[int],
+                   deadline: float | None) -> dict:
         """One HTTP exchange, following router redirects (re-POSTing
-        the same body); raises :class:`ServeError` on non-2xx."""
-        for _hop in range(self.max_redirects + 1):
+        the same body); raises :class:`ServeError` on non-2xx.
+
+        Redirect hygiene (each a fixed bug class):
+
+          - ``hops`` is the request-WIDE remaining-follows budget,
+            shared across retry ATTEMPTS — previously each attempt
+            got a fresh ``max_redirects`` allowance, so a redirect
+            loop times retries could multiply the cap away
+          - every re-POST rebuilds its header dict and explicitly
+            re-attaches ``x-goleft-trace`` — the original request was
+            the only one guaranteed to carry it, which broke the
+            stitched trace exactly on redirected (router-bypass) hops
+          - follows are counted against ``retry_budget_s``: a
+            redirect chain spends the same wall-clock budget a
+            retry-after sleep does
+        """
+        from ..obs.fleetplane import TRACE_HEADER
+
+        traced = TRACE_HEADER in headers
+        while True:
+            hdrs = dict(headers)
+            if traced and self.last_trace_id:
+                hdrs[TRACE_HEADER] = self.last_trace_id
             req = urllib.request.Request(url, data=data,
-                                         headers=headers)
+                                         headers=hdrs)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as r:
@@ -91,6 +113,21 @@ class ServeClient:
                     target = e.headers.get("Location") \
                         or body.get("location")
                     if target:
+                        if hops[0] <= 0:
+                            raise ServeError(
+                                508,
+                                f"too many redirects (> "
+                                f"{self.max_redirects} for this "
+                                f"request) from {url}") from e
+                        if deadline is not None \
+                                and time.monotonic() >= deadline:
+                            raise ServeError(
+                                508,
+                                f"retry budget "
+                                f"{self.retry_budget_s:g}s exhausted "
+                                f"while following a redirect from "
+                                f"{url}") from e
+                        hops[0] -= 1
                         url = target
                         continue
                 raise ServeError(
@@ -98,8 +135,6 @@ class ServeClient:
                     body.get("error", "") or (e.reason or ""),
                     retry_after_s=body.get("retry_after_s"),
                 ) from e
-        raise ServeError(508, f"too many redirects (> "
-                              f"{self.max_redirects}) from {url}")
 
     def _request(self, path: str, payload: dict | None = None) -> dict:
         url = self.base_url + path
@@ -117,9 +152,15 @@ class ServeClient:
                 headers[TRACE_HEADER] = self.last_trace_id
         attempt = 0
         t0 = time.monotonic()
+        deadline = t0 + self.retry_budget_s \
+            if self.retry_budget_s is not None else None
+        # the total 307/308 budget for THIS request, across all retry
+        # attempts (a mutable cell so _post_once draws it down)
+        hops = [self.max_redirects]
         while True:
             try:
-                return self._post_once(url, data, headers)
+                return self._post_once(url, data, headers, hops,
+                                       deadline)
             except ServeError as e:
                 if attempt >= self.retries \
                         or e.status not in _RETRYABLE \

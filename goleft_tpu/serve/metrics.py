@@ -46,6 +46,15 @@ class ServeMetrics:
         # "over the last five minutes")
         self._outcomes: deque = deque(maxlen=outcome_window)
         self._outcomes_lock = threading.Lock()
+        # per-TENANT outcome windows: (monotonic ts, burned, seconds)
+        # per response, keyed by the request's tenant label — the raw
+        # material of the tenant-scoped burn rates the federation tier
+        # sheds on. Tenant count is bounded (an attacker-chosen label
+        # must not grow a dict forever): the stalest tenant is evicted
+        # when a new label would exceed the cap.
+        self._tenant_outcomes: dict[str, deque] = {}
+        self._max_tenants = 64
+        self._tenant_window = min(outcome_window, 1024)
 
     def inc(self, name: str, n: int = 1) -> None:
         self.registry.counter(_PREFIX + name).inc(n)
@@ -58,6 +67,62 @@ class ServeMetrics:
         self.inc(f"responses_total.{code}")
         with self._outcomes_lock:
             self._outcomes.append((time.monotonic(), code >= 500))
+
+    def record_tenant(self, tenant: str, code: int,
+                      seconds: float | None = None) -> None:
+        """One response attributed to a tenant: the per-tenant counter
+        pair plus the timestamped outcome its burn rate is computed
+        from. A tenant "burns" on 5xx AND on 429 — a throttled tenant
+        is spending its own budget, which is exactly the signal the
+        federation's tenant-scoped shed isolates on (a 4xx other than
+        429 stays the client's problem, as in the fleet-wide SLO)."""
+        burned = code >= 500 or code == 429
+        with self._outcomes_lock:
+            dq = self._tenant_outcomes.get(tenant)
+            if dq is None:
+                while len(self._tenant_outcomes) >= self._max_tenants:
+                    stale = min(
+                        self._tenant_outcomes,
+                        key=lambda t: self._tenant_outcomes[t][-1][0]
+                        if self._tenant_outcomes[t] else 0.0)
+                    del self._tenant_outcomes[stale]
+                dq = self._tenant_outcomes[tenant] = deque(
+                    maxlen=self._tenant_window)
+            dq.append((time.monotonic(), burned, seconds))
+        self.inc(f"tenant.requests_total.{tenant}")
+        if burned:
+            self.inc(f"tenant.burned_total.{tenant}")
+
+    def tenant_slo(self, p99_target_s: float = 2.0,
+                   window_s: float = 300.0) -> dict:
+        """{tenant: {window_requests, error_rate,
+        p99_latency_ratio?}} over the outcome window — the per-tenant
+        dimension of the /metrics ``slo`` block. Rates here are
+        RAW: burn rates (rate / error budget vs p99 ratio) are
+        computed by the tier that owns the budget (the fleet rollup
+        and the federation), not per worker."""
+        now = time.monotonic()
+        with self._outcomes_lock:
+            items = [(t, list(dq))
+                     for t, dq in self._tenant_outcomes.items()]
+        out: dict = {}
+        for tenant, rows in sorted(items):
+            recent = [(burned, sec) for ts, burned, sec in rows
+                      if now - ts <= window_s]
+            if not recent:
+                continue
+            n = len(recent)
+            errs = sum(1 for burned, _ in recent if burned)
+            rec = {"window_requests": n,
+                   "error_rate": round(errs / n, 6)}
+            lats = [s for _, s in recent if s is not None]
+            if lats and p99_target_s > 0:
+                from ..utils.profiling import percentiles
+
+                rec["p99_latency_ratio"] = round(
+                    percentiles(lats)["p99"] / p99_target_s, 4)
+            out[tenant] = rec
+        return out
 
     def slo_snapshot(self, p99_target_s: float = 2.0,
                      window_s: float = 300.0) -> dict:
@@ -100,6 +165,8 @@ class ServeMetrics:
             "error_rate": round(error_rate, 6),
             "availability": round(availability, 6),
             "p99_latency_ratio": ratios,
+            "tenants": self.tenant_slo(p99_target_s=p99_target_s,
+                                       window_s=window_s),
         }
 
     def observe_batch(self, size: int) -> None:
@@ -132,6 +199,12 @@ class ServeMetrics:
             "counters": counters,
             "batch_size_hist": hist,
             "latency_s": self.registry.histograms(_LATENCY),
+            # the bounded raw windows behind those summaries: the
+            # fleet rollup concatenates them for EXACT merged
+            # quantiles (summaries alone only permit a count-weighted
+            # approximation — docs/observability.md)
+            "latency_windows": self.registry.histogram_windows(
+                _LATENCY),
             "stage_seconds": self.timer.as_dict(),
             "stage_spans_dropped": self.timer.spans_dropped,
         }

@@ -222,11 +222,17 @@ class ServeApp:
         from .. import obs
 
         tid, remote_parent = trace_ctx if trace_ctx else (None, None)
+        t0 = time.perf_counter()
         with obs.trace(f"request.{kind}", kind="serve",
                        trace_id=tid,
                        remote_parent=remote_parent) as root:
             code, body = self._handle(kind, req)
             root.attrs["status"] = code
+        # the tenant-scoped outcome window (the federation tier's
+        # burn-rate raw material): every answered request lands in its
+        # tenant's window with its wall latency
+        self.metrics.record_tenant(str(req.get("tenant") or "default"),
+                                   code, time.perf_counter() - t0)
         return code, body
 
     def _handle(self, kind: str, req: dict) -> tuple[int, dict]:
@@ -314,7 +320,13 @@ class ServeApp:
     def healthz(self) -> tuple[int, dict]:
         rec = {"status": "draining" if self.draining else "ok",
                "uptime_s": round(time.time() - self.metrics.started,
-                                 1)}
+                                 1),
+               # this process's wall clock, for the poller's clock
+               # handshake: the router estimates a per-worker offset
+               # (midpoint method) and the trace stitcher rebases
+               # cross-host spans with it instead of trusting raw
+               # wall clocks
+               "now": round(time.time(), 6)}
         if self.cache is not None:
             rec["cache"] = "shared" if self.cache_shared \
                 else "private"

@@ -575,3 +575,210 @@ def test_router_echoes_minted_trace_header(obs_workers):
             echoed = r.headers.get("x-goleft-trace")
         # no client header: the ROUTER minted the fleet id and told us
         assert echoed and echoed.startswith("serve-")
+
+
+# ---------------- exact quantiles from raw windows ----------------
+
+
+def test_merge_histograms_exact_from_raw_windows():
+    a = {"p50": 0.1, "p99": 1.0, "max": 1.0, "count": 3, "sum": 1.2}
+    b = {"p50": 0.3, "p99": 3.0, "max": 3.0, "count": 3, "sum": 3.6}
+    wa, wb = [0.1, 0.1, 1.0], [0.3, 0.3, 3.0]
+    m = fp.merge_histogram_summaries([a, b], windows=[wa, wb])
+    assert m["quantile_source"] == "exact"
+    # the EXACT quantiles: the same windowed estimator one process
+    # holding all six samples would use
+    from goleft_tpu.utils.profiling import percentiles
+
+    want = percentiles(wa + wb)
+    assert m["p99"] == pytest.approx(want["p99"])
+    assert m["p50"] == pytest.approx(want["p50"])
+    assert m["max"] == pytest.approx(3.0)
+    # sum/count equality pinned unchanged (the additive merge)
+    assert m["count"] == 6
+    assert m["sum"] == pytest.approx(4.8)
+
+
+def test_merge_histograms_falls_back_without_full_windows():
+    a = {"p99": 1.0, "count": 10, "sum": 1.0}
+    b = {"p99": 3.0, "count": 30, "sum": 9.0}
+    # one worker missing its window → the WHOLE merge falls back (a
+    # mixed answer would claim precision it doesn't have)
+    m = fp.merge_histogram_summaries([a, b], windows=[[0.1], None])
+    assert m["quantile_source"] == "approximate"
+    assert m["p99"] == pytest.approx((10 * 1.0 + 30 * 3.0) / 40)
+    assert m["count"] == 40 and m["sum"] == pytest.approx(10.0)
+
+
+def test_merge_worker_metrics_uses_shipped_windows():
+    def snap(lat_window):
+        s = _worker_snap(len(lat_window), 0.0, 0.5)
+        s["latency_s"] = {"depth": {
+            "p99": max(lat_window), "count": len(lat_window),
+            "sum": round(sum(lat_window), 4),
+            "max": max(lat_window)}}
+        s["latency_windows"] = {"depth": lat_window}
+        return s
+
+    merged = fp.merge_worker_metrics({
+        "8001": snap([0.1, 0.1, 0.1]),
+        "8002": snap([0.2, 0.2, 5.0]),
+    })
+    h = merged["histograms"]["latency_s.depth"]
+    assert h["quantile_source"] == "exact"
+    from goleft_tpu.utils.profiling import percentiles
+
+    assert h["p99"] == pytest.approx(
+        percentiles([0.1, 0.1, 0.1, 0.2, 0.2, 5.0])["p99"])
+    assert h["count"] == 6
+
+
+def test_serve_metrics_ship_latency_windows_and_merge_exact():
+    from goleft_tpu.serve.metrics import ServeMetrics
+
+    w1, w2 = ServeMetrics(), ServeMetrics()
+    for v in (0.1, 0.2, 0.3):
+        w1.observe_latency("depth", v)
+    for v in (0.4, 9.0):
+        w2.observe_latency("depth", v)
+    snaps = {"8001": w1.snapshot(), "8002": w2.snapshot()}
+    assert snaps["8001"]["latency_windows"]["depth"] \
+        == [0.1, 0.2, 0.3]
+    merged = fp.merge_worker_metrics(snaps)
+    h = merged["histograms"]["latency_s.depth"]
+    assert h["quantile_source"] == "exact"
+    assert h["count"] == 5
+    from goleft_tpu.utils.profiling import percentiles
+
+    assert h["p99"] == pytest.approx(
+        percentiles([0.1, 0.2, 0.3, 0.4, 9.0])["p99"])
+
+
+# ---------------- cross-host clock handshake ----------------
+
+
+class _SkewedWorkerHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    skew_s = 0.0
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, body):
+        data = json.dumps(body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(data)
+        self.close_connection = True
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._json({"status": "ok",
+                        "now": time.time() + self.server.skew_s})
+        else:
+            self._json({})
+
+
+@pytest.mark.parametrize("skew", [5.0, -5.0])
+def test_worker_pool_estimates_clock_offset(skew):
+    from goleft_tpu.fleet.router import WorkerPool
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                _SkewedWorkerHandler)
+    httpd.skew_s = skew
+    t = threading.Thread(target=httpd.serve_forever,
+                         kwargs={"poll_interval": 0.02}, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    url = f"http://{host}:{port}"
+    pool = WorkerPool([url], poll_interval_s=30.0)
+    try:
+        pool.poll_all()
+        offs = pool.clock_offsets()
+        # midpoint estimate lands within network-time noise of the
+        # planted ±5s skew
+        assert offs[url] == pytest.approx(skew, abs=1.0)
+        # EWMA: a second poll stays near the skew (smoothed, stable)
+        pool.poll_all()
+        assert pool.clock_offsets()[url] == pytest.approx(skew,
+                                                          abs=1.0)
+    finally:
+        pool.close()
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=10)
+
+
+def test_stitch_trace_applies_clock_offsets():
+    tid = "serve-cli-9-skew"
+    router_fr, worker_fr = _router_worker_records(tid)
+    worker_recs = worker_fr.snapshot(trace_id=tid)
+    # forge the worker's wall clock 5s AHEAD (a skewed host)
+    import copy
+    import datetime
+
+    skewed = []
+    for rec in worker_recs:
+        rec = copy.deepcopy(rec)
+        ts = datetime.datetime.fromisoformat(rec["ts"]) \
+            + datetime.timedelta(seconds=5)
+        rec["ts"] = ts.isoformat(timespec="milliseconds")
+        skewed.append(rec)
+    url = "http://127.0.0.1:7001"
+    naive = fp.stitch_trace(tid,
+                            router_fr.snapshot(trace_id=tid),
+                            {url: copy.deepcopy(skewed)})
+    corrected = fp.stitch_trace(tid,
+                                router_fr.snapshot(trace_id=tid),
+                                {url: copy.deepcopy(skewed)},
+                                clock_offsets={url: 5.0})
+
+    def first_req(doc):
+        def walk(n):
+            yield n
+            for c in n["children"]:
+                yield from walk(c)
+        return next(n for n in walk(doc["tree"])
+                    if n["name"] == "request.depth")
+
+    # trusting raw wall clocks shears the worker tree ~5s late;
+    # the handshake offset pulls it back onto the router's clock
+    assert first_req(naive)["start_ms"] \
+        >= first_req(corrected)["start_ms"] + 4000
+
+
+# ---------------- per-tenant rollup dimension ----------------
+
+
+def test_worker_tenant_outcomes_roll_up_to_fleet_burn():
+    from goleft_tpu.serve.metrics import ServeMetrics
+
+    w1, w2 = ServeMetrics(), ServeMetrics()
+    for _ in range(4):
+        w1.record_tenant("mallory", 429, seconds=0.01)
+        w2.record_tenant("mallory", 503, seconds=0.01)
+        w1.record_tenant("alice", 200, seconds=0.01)
+    # 404s are the client's problem, never tenant burn
+    w1.record_tenant("alice", 404, seconds=0.01)
+    s1 = w1.slo_snapshot(window_s=300.0)
+    assert s1["tenants"]["mallory"]["error_rate"] == 1.0
+    assert s1["tenants"]["alice"]["error_rate"] == 0.0
+    assert w1.registry.counter(
+        "serve.tenant.requests_total.mallory").value == 4
+    assert w1.registry.counter(
+        "serve.tenant.burned_total.mallory").value == 4
+    # the fleet rollup: request-weighted tenant merge + burn gauges
+    merged = fp.merge_worker_metrics({
+        "8001": {"slo": s1},
+        "8002": {"slo": w2.slo_snapshot(window_s=300.0)},
+    }, error_budget=0.01)
+    tens = merged["slo"]["tenants"]
+    assert tens["mallory"]["window_requests"] == 8
+    assert tens["mallory"]["burn_rate"] == pytest.approx(100.0)
+    assert tens["alice"]["burn_rate"] < 0.1
+    flat = fp.rollup_registry_snapshot(merged)
+    assert flat["gauges"]["fleet.slo.tenant.burn_rate.mallory"] \
+        == pytest.approx(100.0)

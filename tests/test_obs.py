@@ -242,6 +242,7 @@ def test_serve_metrics_snapshot_is_registry_derived_byte_for_byte():
                 (int(n), v) for n, v in
                 reg.counters("serve.batch_size.").items())},
         "latency_s": reg.histograms("serve.latency_s."),
+        "latency_windows": reg.histogram_windows("serve.latency_s."),
         "stage_seconds": m.timer.as_dict(),
         "stage_spans_dropped": m.timer.spans_dropped,
         "queue_depth": 2,

@@ -504,3 +504,42 @@ def test_wire_decode_entry_ingests(tmp_path):
     assert back[0]["metrics"]["wire_ratio"] == pytest.approx(0.2551)
     assert back[0]["metrics"]["stripe.device_scan_mb_s"] \
         == pytest.approx(34.2)
+
+
+def test_fleet_failover_recovery_entry_ingests(tmp_path):
+    """The federation bench entry (fleet_failover_recovery_s: SIGKILL
+    a fleet router -> failover via the survivor, restart -> half-open
+    rejoin routing the affinity key home) lands in the ledger with
+    both spans as gated lower-is-better metrics."""
+    entry = {
+        "fleets": 2, "workers_per_fleet": 1, "trials": 3,
+        "failover_seconds": 0.207, "recovery_seconds": 0.748,
+        "failover_s_each": [0.71, 0.19, 0.207],
+        "recovery_s_each": [0.657, 0.843, 0.748],
+        "platform": "cpu",
+        "note": "SIGKILL a fleet ROUTER behind the federation",
+    }
+    recs = ledger.live_run_records(
+        {"fleet_failover_recovery_s": entry}, None)
+    rec = {r["entry"]: r for r in recs}["fleet_failover_recovery_s"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("failover_seconds", "recovery_seconds", "fleets",
+                "workers_per_fleet"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["recovery_seconds"] \
+        == pytest.approx(0.748)
+    # "seconds" metrics gate lower-is-better in the sentinel
+    from goleft_tpu.obs.sentinel import metric_direction
+
+    assert metric_direction("fleet_failover_recovery_s",
+                            "failover_seconds") == "lower"
+    assert metric_direction("fleet_failover_recovery_s",
+                            "recovery_seconds") == "lower"
+    # round-trips through the on-disk ledger (what perf check reads)
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "fleet_failover_recovery_s"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["failover_seconds"] \
+        == pytest.approx(0.207)
