@@ -2,10 +2,13 @@
 
 Stdlib-``ast`` static analysis guarding the invariants the system's
 guarantees rest on — determinism of anything feeding output bytes or
-content keys, tracer hygiene in jitted code, lock discipline in the
-threaded serve/prefetch layers, exhaustive exception classification,
-and the plan-layer dispatch boundary. ``goleft-tpu lint`` / ``make
-lint`` is the gate; docs/static-analysis.md is the rule catalog.
+content keys, tracer hygiene in jitted code, lock discipline (intra-
+class, cross-class foreign writes, and package-wide lock-order cycle
+detection over the interprocedural index), thread/resource lifecycle,
+the JSON↔Prometheus metrics-name contract, exhaustive exception
+classification, and the plan-layer dispatch boundary. ``goleft-tpu
+lint`` / ``make lint`` is the gate (``make lint-ci`` adds a SARIF
+artifact); docs/static-analysis.md is the rule catalog.
 """
 
 from .engine import AnalysisResult, run_analysis

@@ -1,14 +1,17 @@
 """lint: the AST invariant analyzer's CLI (``goleft-tpu lint``).
 
-Runs the five rule families over the package (default: the installed
+Runs the ten rule families over the package (default: the installed
 ``goleft_tpu/`` tree), subtracts per-line waivers and the committed
 baseline, prints human or ``--json`` findings, and exits 1 on any
-live finding — the ``make lint`` CI gate.
+live finding — the ``make lint`` CI gate (exit 3 when the
+``--max-seconds`` wall-time budget is blown).
 
     goleft-tpu lint                      # whole package
     goleft-tpu lint --only plan-boundary # the dispatch-split gate
     goleft-tpu lint --changed-only       # just git-modified files
     goleft-tpu lint --json               # stable machine output
+    goleft-tpu lint --sarif out.sarif    # CI annotation artifact
+    goleft-tpu lint --jobs 8 --stats     # pooled parse + timing line
     goleft-tpu lint --write-baseline     # grandfather current findings
 """
 
@@ -18,8 +21,10 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 
 from . import baseline as baseline_mod
+from . import sarif as sarif_mod
 from .engine import run_analysis
 from .findings import to_json, to_text
 from .rules import known_ids, select
@@ -54,8 +59,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "goleft-tpu lint",
         description="AST-based invariant analyzer: determinism, "
-                    "tracer hygiene, lock discipline, exception "
-                    "classification, plan boundary")
+                    "tracer hygiene, lock discipline (intra-class, "
+                    "cross-class, lock-order cycles), thread/"
+                    "resource lifecycle, metrics contract, "
+                    "exception classification, plan boundary")
     p.add_argument("root", nargs="?", default=None,
                    help="package directory to analyze (default: the "
                         "installed goleft_tpu package)")
@@ -64,6 +71,22 @@ def main(argv=None) -> int:
                         "(e.g. plan-boundary, det, lck)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable findings (stable schema)")
+    p.add_argument("--sarif", metavar="FILE", default=None,
+                   help="also write findings as a SARIF 2.1.0 log "
+                        "(deterministic; CI annotates the diff "
+                        "from it)")
+    p.add_argument("--jobs", type=int, default=None,
+                   help="parse files on a process pool of this size "
+                        "(default: auto; 1 forces serial; merge "
+                        "order is deterministic either way)")
+    p.add_argument("--stats", action="store_true",
+                   help="print a timing line (files, parse/analyze "
+                        "seconds, jobs) to stderr")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="fail (exit 3) if the whole run exceeds this "
+                        "wall-time budget — the make-lint guard "
+                        "against rule growth making `make check` "
+                        "crawl")
     p.add_argument("--changed-only", action="store_true",
                    help="lint only files changed vs git HEAD (falls "
                         "back to the full tree without git)")
@@ -109,7 +132,8 @@ def main(argv=None) -> int:
             print("gtlint: no changed .py files — nothing to lint")
             return 0
 
-    result = run_analysis(root, only=only, files=files)
+    t0 = time.perf_counter()
+    result = run_analysis(root, only=only, files=files, jobs=a.jobs)
     for path in result.index.syntax_errors:
         print(f"goleft-tpu lint: syntax error in {path} — skipped",
               file=sys.stderr)
@@ -134,6 +158,9 @@ def main(argv=None) -> int:
         findings, suppressed = baseline_mod.split(findings, entries)
         baselined = len(suppressed)
 
+    if a.sarif:
+        sarif_mod.write_sarif(a.sarif, findings, select(only))
+
     out = to_json(findings, baselined=baselined,
                   waived=result.waived,
                   rules=[r.id for r in select(only)]) if a.json \
@@ -141,6 +168,23 @@ def main(argv=None) -> int:
                      waived=result.waived)
     stream = sys.stdout if a.json or not findings else sys.stderr
     print(out, end="" if a.json else "\n", file=stream)
+
+    wall = time.perf_counter() - t0
+    if a.stats:
+        s = result.stats
+        print(f"gtlint: stats files={s.get('files', 0)} "
+              f"rules={s.get('rules', 0)} "
+              f"parse={s.get('parse_s', 0):.3f}s "
+              f"analyze={s.get('analyze_s', 0):.3f}s "
+              f"wall={wall:.3f}s "
+              f"jobs={a.jobs if a.jobs is not None else 'auto'}",
+              file=sys.stderr)
+    if a.max_seconds is not None and wall > a.max_seconds:
+        print(f"goleft-tpu lint: run took {wall:.1f}s, over the "
+              f"--max-seconds {a.max_seconds:g} budget — a rule or "
+              "the tree grew expensive; profile before raising the "
+              "budget", file=sys.stderr)
+        return 3
     if result.index.syntax_errors:
         return 1
     return 1 if findings else 0

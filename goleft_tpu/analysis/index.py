@@ -8,6 +8,28 @@ for the lock-discipline rule — per-class structure: methods, inferred
 lock attributes, which attributes are mutated under which lock, and a
 lightweight intra-class call graph (which methods call which, and
 whether the call site holds a lock).
+
+Since PR 15 the index is also *interprocedural*: :meth:`PackageIndex.
+link` builds a package-wide view over the parsed modules —
+
+  - a cross-module **call graph** (function/method qualnames resolved
+    through each module's import table, ``self.<attr>`` receivers
+    typed from ``__init__`` assignments, constructor-argument types
+    propagated one level so ``EventLog(EventJournal(p)).emit`` chains
+    resolve end to end),
+  - a package-wide **lock-order graph**: every lock identity (class
+    lock attrs and module-global locks) plus the acquired-while-
+    holding edges, both direct (nested ``with``) and through calls
+    (``may_acquire`` fixpoint over the call graph) — the ``lck-order``
+    deadlock rule's input,
+  - **thread spawn sites** (``threading.Thread(...)`` with target
+    resolution, daemon flag, start/join evidence) for the ``thr-*``
+    lifecycle rules.
+
+Parsing itself can fan out over a process pool (``jobs``): parent
+links are (re)attached after the deterministic merge, and ``link()``
+always runs in the calling process, so parallel and serial runs build
+byte-identical indexes.
 """
 
 from __future__ import annotations
@@ -219,6 +241,7 @@ class ModuleInfo:
     imports: dict[str, str]
     waivers: dict[int, set[str]]
     classes: list[ClassInfo]
+    modname: str = ""    # dotted module name, e.g. goleft_tpu.serve.server
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -294,28 +317,98 @@ def _classes(tree: ast.Module, module: "ModuleInfo") -> list[ClassInfo]:
     return out
 
 
-def load_module(path: str, root: str) -> ModuleInfo | None:
+def load_module(path: str, root: str,
+                parent_links: bool = True) -> ModuleInfo | None:
     """Parse one file into a ModuleInfo; None on a syntax error (the
     engine reports those separately — a lint gate must not crash on
-    the code it guards)."""
+    the code it guards). ``parent_links=False`` skips the parent-link
+    pass — process-pool workers leave it to the parent process (the
+    links are cyclic attribute noise in a pickle)."""
     with open(path, encoding="utf-8") as fh:
         src = fh.read()
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError:
         return None
-    set_parents(tree)
+    if parent_links:
+        set_parents(tree)
     base = os.path.dirname(os.path.abspath(root))
     rel = os.path.relpath(os.path.abspath(path), base) \
         .replace(os.sep, "/")
     modname = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+    public = modname[: -len(".__init__")] \
+        if modname.endswith(".__init__") else modname
     mod = ModuleInfo(path=os.path.abspath(path), rel=rel, tree=tree,
                      lines=src.splitlines(), imports={}, waivers={},
-                     classes=[])
+                     classes=[], modname=public)
     mod.imports = _imports(tree, modname)
     mod.waivers = waivers_mod.parse_source(mod.lines)
     mod.classes = _classes(tree, mod)
     return mod
+
+
+def _load_for_pool(args: tuple[str, str]) -> "ModuleInfo | str":
+    """Process-pool worker: parse one file (no parent links — they are
+    re-attached after unpickling). Returns the path string itself on a
+    syntax error (a pickleable sentinel)."""
+    path, root = args
+    mod = load_module(path, root, parent_links=False)
+    return mod if mod is not None else path
+
+
+# ---------------------------------------------------------------
+# interprocedural layer (PR 15): call graph, lock-order graph,
+# thread spawn sites — built once per index by PackageIndex.link()
+# ---------------------------------------------------------------
+
+
+@dataclass
+class SpawnSite:
+    """One ``threading.Thread(...)`` construction."""
+
+    module_rel: str
+    line: int
+    func_qual: str            # enclosing function/method ("" = module)
+    class_qual: str | None    # owning class when inside a method
+    daemon: bool
+    target: str | None        # resolved callee qual of target=, if any
+    attr: str | None          # "self.<attr>" storage target
+    local: str | None         # local-name storage target
+    node: ast.Call = field(repr=False, default=None)
+
+
+@dataclass
+class FuncInfo:
+    """One function/method, with its lock and call behavior."""
+
+    qual: str
+    module_rel: str
+    node: ast.AST = field(repr=False, default=None)
+    class_qual: str | None = None
+    #: every lock acquisition: (lock id, ids already held, line)
+    acquires: list[tuple[str, tuple[str, ...], int]] = \
+        field(default_factory=list)
+    #: resolved call sites: (callee qual, lock ids held, line)
+    calls: list[tuple[str, tuple[str, ...], int]] = \
+        field(default_factory=list)
+    #: calls os.fsync directly (the thr-daemon-io sink)
+    fsync: bool = False
+
+
+@dataclass
+class ForeignWrite:
+    """A write/mutation of another object's attribute (``w.x = ...``
+    where ``w`` is a typed local/param of a package class) — the
+    cross-class rule's raw material."""
+
+    module_rel: str
+    line: int
+    func_qual: str
+    obj_types: frozenset      # class quals the receiver may be
+    attr: str
+    held: tuple[str, ...]     # lock ids lexically held at the site
+    created_here: bool        # receiver constructed in this function
+    kind: str                 # "assign" | "mutate"
 
 
 @dataclass
@@ -323,12 +416,822 @@ class PackageIndex:
     root: str                      # the scanned package directory
     modules: list[ModuleInfo]
     syntax_errors: list[str] = field(default_factory=list)
+    # ---- interprocedural tables (see link()) ----
+    #: class qualname -> (ModuleInfo, ClassInfo)
+    classes_by_qual: dict = field(default_factory=dict, repr=False)
+    #: function/method qualname -> FuncInfo
+    functions: dict = field(default_factory=dict, repr=False)
+    #: (class qual, attr) -> set of class quals the attr may hold
+    attr_types: dict = field(default_factory=dict, repr=False)
+    #: module-global lock qualname -> (module rel, line)
+    global_locks: dict = field(default_factory=dict, repr=False)
+    #: caller qual -> sorted tuple of callee quals
+    call_graph: dict = field(default_factory=dict, repr=False)
+    #: func qual -> frozenset of lock ids it may (transitively) acquire
+    may_acquire: dict = field(default_factory=dict, repr=False)
+    #: (held lock, acquired lock) -> sorted list of evidence sites
+    #: (module rel, line, description)
+    lock_edges: dict = field(default_factory=dict, repr=False)
+    #: every threading.Thread(...) construction in the package
+    spawn_sites: list = field(default_factory=list, repr=False)
+    #: (class qual, attr) -> element class quals for dict/list/set
+    #: attrs (``self.workers = {u: _Worker(u) ...}``)
+    container_types: dict = field(default_factory=dict, repr=False)
+    #: func qual -> {param name: class qual} from annotations
+    param_types: dict = field(default_factory=dict, repr=False)
+    #: every typed cross-object attribute write in the package
+    foreign_writes: list = field(default_factory=list, repr=False)
+    #: func qual -> locks guaranteed held at entry (the caller-holds
+    #: fixpoint, interprocedural); None = only reachable during
+    #: construction (exempt, like __init__ itself)
+    held_under: dict = field(default_factory=dict, repr=False)
+    _corpus: str | None = field(default=None, repr=False)
+    _linked: bool = field(default=False, repr=False)
+
+    # ---- name resolution helpers ----
+
+    def resolve_qual(self, module: ModuleInfo, origin: str | None,
+                     table: dict) -> str | None:
+        """Match a resolved dotted origin against a qual table; a bare
+        (same-module) name also tries ``<modname>.<origin>``."""
+        if not origin:
+            return None
+        if origin in table:
+            return origin
+        cand = f"{module.modname}.{origin}"
+        return cand if cand in table else None
+
+    def class_of(self, module: ModuleInfo, origin: str | None) \
+            -> str | None:
+        return self.resolve_qual(module, origin, self.classes_by_qual)
+
+    def method_qual(self, class_qual: str, name: str) -> str | None:
+        """Resolve a method on a class, walking package-local bases
+        (ContinuousBatcher._take_batch shadows MicroBatcher's)."""
+        seen: set[str] = set()
+        stack = [class_qual]
+        while stack:
+            cq = stack.pop(0)
+            if cq in seen:
+                continue
+            seen.add(cq)
+            cand = f"{cq}.{name}"
+            if cand in self.functions:
+                return cand
+            entry = self.classes_by_qual.get(cq)
+            if entry is None:
+                continue
+            mod, ci = entry
+            for base in ci.node.bases:
+                bq = self.class_of(mod, mod.resolve(base))
+                if bq is not None:
+                    stack.append(bq)
+        return None
+
+    def reaches_fsync(self, qual: str) -> bool:
+        """Does ``qual`` transitively reach a function that calls
+        ``os.fsync``? (the thr-daemon-io question)"""
+        seen: set[str] = set()
+        stack = [qual]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            fi = self.functions.get(q)
+            if fi is None:
+                continue
+            if fi.fsync:
+                return True
+            stack.extend(c for c, _, _ in fi.calls)
+        return False
+
+    def corpus(self) -> str:
+        """Raw text of the repo's tests/, docs/ and README plus every
+        scanned module — the ``met-prom-twin`` rule's search space for
+        a metric's underscored Prometheus name. Cached per index."""
+        if self._corpus is not None:
+            return self._corpus
+        parts: list[str] = []
+        repo_root = os.path.dirname(self.root)
+        for sub, exts in (("tests", (".py",)), ("docs", (".md",))):
+            d = os.path.join(repo_root, sub)
+            if not os.path.isdir(d):
+                continue
+            for dirpath, dirnames, filenames in os.walk(d):
+                dirnames[:] = sorted(
+                    x for x in dirnames if x != "__pycache__")
+                for f in sorted(filenames):
+                    if f.endswith(exts):
+                        try:
+                            with open(os.path.join(dirpath, f),
+                                      encoding="utf-8",
+                                      errors="replace") as fh:
+                                parts.append(fh.read())
+                        except OSError:
+                            continue
+        readme = os.path.join(repo_root, "README.md")
+        if os.path.exists(readme):
+            with open(readme, encoding="utf-8",
+                      errors="replace") as fh:
+                parts.append(fh.read())
+        for m in self.modules:
+            parts.append("\n".join(m.lines))
+        self._corpus = "\n".join(parts)
+        return self._corpus
+
+    # ---- the linking passes ----
+
+    def link(self) -> "PackageIndex":
+        """Build the interprocedural tables. Idempotent; always runs
+        in the calling process (after any parallel parse)."""
+        if self._linked:
+            return self
+        self._linked = True
+        self._collect_definitions()
+        self._collect_types()
+        scans = self._scan_functions()
+        self._propagate_ctor_params(scans)
+        self._resolve_calls(scans)
+        self._fixpoint_may_acquire()
+        self._fixpoint_held_under()
+        self._build_lock_edges(scans)
+        return self
+
+    def _collect_definitions(self) -> None:
+        for mod in self.modules:
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and mod.resolve(node.value.func) \
+                        in LOCK_FACTORIES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.global_locks[
+                                f"{mod.modname}.{t.id}"] = \
+                                (mod.rel, node.lineno)
+            for ci in mod.classes:
+                cq = f"{mod.modname}.{ci.name}"
+                self.classes_by_qual[cq] = (mod, ci)
+                for name, mi in ci.methods.items():
+                    fq = f"{cq}.{name}"
+                    self.functions[fq] = FuncInfo(
+                        fq, mod.rel, mi.node, class_qual=cq)
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    fq = f"{mod.modname}.{node.name}"
+                    self.functions.setdefault(
+                        fq, FuncInfo(fq, mod.rel, node))
+
+    def _collect_types(self) -> None:
+        """Attribute / container-element / parameter typing — the
+        receivers the foreign-write and call-resolution passes need.
+        Runs before function scans so cross-class lookups (a method in
+        one class iterating another class's typed container) never
+        depend on scan order."""
+        for mod in self.modules:
+            for ci in mod.classes:
+                cq = f"{mod.modname}.{ci.name}"
+                for name, mi in ci.methods.items():
+                    self._collect_param_types(
+                        mod, f"{cq}.{name}", mi.node)
+                    for sub in ast.walk(mi.node):
+                        self._type_from_stmt(mod, cq, name, ci, sub)
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._collect_param_types(
+                        mod, f"{mod.modname}.{node.name}", node)
+
+    def _collect_param_types(self, mod: ModuleInfo, fq: str,
+                             node: ast.AST) -> None:
+        table = {}
+        for a in node.args.args + node.args.kwonlyargs:
+            if a.annotation is None:
+                continue
+            ann = a.annotation
+            # strip Optional-ish unions: `x: _Worker | None`
+            if isinstance(ann, ast.BinOp) \
+                    and isinstance(ann.op, ast.BitOr):
+                ann = ann.left
+            cq = self.class_of(mod, mod.resolve(ann))
+            if cq is not None:
+                table[a.arg] = cq
+        if table:
+            self.param_types[fq] = table
+
+    def _ann_element_class(self, mod: ModuleInfo,
+                           ann: ast.expr) -> str | None:
+        """``list[C]`` / ``dict[K, C]`` / ``set[C]`` -> C."""
+        if not isinstance(ann, ast.Subscript):
+            return None
+        base = mod.resolve(ann.value) or ""
+        if base.split(".")[-1].lower() not in (
+                "list", "dict", "set", "deque", "defaultdict"):
+            return None
+        sl = ann.slice
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        return self.class_of(mod, mod.resolve(elts[-1]))
+
+    def _type_from_stmt(self, mod: ModuleInfo, cq: str,
+                        meth: str, ci, sub: ast.AST) -> None:
+        def self_target(t) -> str | None:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return t.attr
+            return None
+
+        def expr_class(e) -> str | None:
+            if isinstance(e, ast.IfExp):
+                return expr_class(e.body) or expr_class(e.orelse)
+            if isinstance(e, ast.Call):
+                return self.class_of(mod, mod.resolve(e.func))
+            return None
+
+        def container_class(e) -> str | None:
+            if isinstance(e, ast.DictComp):
+                return expr_class(e.value)
+            if isinstance(e, (ast.ListComp, ast.SetComp,
+                              ast.GeneratorExp)):
+                return expr_class(e.elt)
+            if isinstance(e, (ast.List, ast.Set, ast.Tuple)):
+                for elt in e.elts:
+                    c = expr_class(elt)
+                    if c is not None:
+                        return c
+                return None
+            if isinstance(e, ast.Dict):
+                for v in e.values:
+                    c = expr_class(v)
+                    if c is not None:
+                        return c
+            return None
+
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                attr = self_target(t)
+                # self.a[k] = C(...): container element evidence
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = self_target(t.value)
+                    if attr is not None:
+                        c = expr_class(sub.value)
+                        if c is not None:
+                            self.container_types.setdefault(
+                                (cq, attr), set()).add(c)
+                    continue
+                if attr is None:
+                    continue
+                c = expr_class(sub.value)
+                if c is not None:
+                    self.attr_types.setdefault(
+                        (cq, attr), set()).add(c)
+                cc = container_class(sub.value)
+                if cc is not None:
+                    self.container_types.setdefault(
+                        (cq, attr), set()).add(cc)
+                if meth == "__init__" \
+                        and isinstance(sub.value, ast.Name):
+                    store = getattr(ci, "_param_attrs", None)
+                    if store is None:
+                        store = {}
+                        ci._param_attrs = store
+                    store.setdefault(sub.value.id, set()).add(attr)
+        elif isinstance(sub, ast.AnnAssign):
+            attr = self_target(sub.target)
+            if attr is None:
+                return
+            ec = self._ann_element_class(mod, sub.annotation)
+            if ec is not None:
+                self.container_types.setdefault(
+                    (cq, attr), set()).add(ec)
+            else:
+                c = self.class_of(mod, mod.resolve(sub.annotation)) \
+                    if not isinstance(sub.annotation, ast.Subscript) \
+                    else None
+                if c is not None:
+                    self.attr_types.setdefault(
+                        (cq, attr), set()).add(c)
+            if sub.value is not None:
+                c = expr_class(sub.value)
+                if c is not None:
+                    self.attr_types.setdefault(
+                        (cq, attr), set()).add(c)
+        elif isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in ("append", "appendleft", "add",
+                                      "insert"):
+            attr = self_target(sub.func.value)
+            if attr is not None and sub.args:
+                c = expr_class(sub.args[-1])
+                if c is not None:
+                    self.container_types.setdefault(
+                        (cq, attr), set()).add(c)
+
+    def _scan_functions(self) -> list["_FnScan"]:
+        scans: list[_FnScan] = []
+        for mod in self.modules:
+            for ci in mod.classes:
+                cq = f"{mod.modname}.{ci.name}"
+                for name, mi in ci.methods.items():
+                    sc = _FnScan(self, mod, f"{cq}.{name}",
+                                 mi.node, ci, cq)
+                    sc.run()
+                    scans.append(sc)
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    sc = _FnScan(self, mod,
+                                 f"{mod.modname}.{node.name}",
+                                 node, None, None)
+                    sc.run()
+                    scans.append(sc)
+        return scans
+
+    def _propagate_ctor_params(self, scans: list["_FnScan"]) -> None:
+        """One level of constructor-argument typing: at every
+        ``C(EventJournal(p), ...)`` instantiation, bind C.__init__'s
+        parameter to the argument's class, then flow it into
+        ``self.<attr> = <param>`` assignments recorded for C."""
+        for sc in scans:
+            for class_qual, arg_types in sc.instantiations:
+                entry = self.classes_by_qual.get(class_qual)
+                if entry is None:
+                    continue
+                _, ci = entry
+                init = ci.methods.get("__init__")
+                if init is None:
+                    continue
+                params = [a.arg for a in init.node.args.args[1:]]
+                bindings = getattr(ci, "_param_attrs", None) or {}
+                for pos_or_kw, type_qual in arg_types:
+                    pname = pos_or_kw if isinstance(pos_or_kw, str) \
+                        else (params[pos_or_kw]
+                              if pos_or_kw < len(params) else None)
+                    if pname is None:
+                        continue
+                    for attr in bindings.get(pname, ()):
+                        self.attr_types.setdefault(
+                            (class_qual, attr), set()).add(type_qual)
+
+    def _resolve_calls(self, scans: list["_FnScan"]) -> None:
+        for sc in scans:
+            fi = self.functions.get(sc.qual)
+            if fi is None:
+                continue
+            fi.fsync = sc.fsync
+            fi.acquires = sc.acquires
+            for desc, held, line in sc.raw_calls:
+                for callee in self._callees(sc, desc):
+                    fi.calls.append((callee, held, line))
+            self.call_graph[sc.qual] = tuple(sorted(
+                {c for c, _, _ in fi.calls}))
+            for sp in sc.spawns:
+                if sp.target is not None:
+                    sp.target = self._target_qual(sc, sp.target)
+                self.spawn_sites.append(sp)
+            self.foreign_writes.extend(sc.foreign_writes)
+        self.spawn_sites.sort(key=lambda s: (s.module_rel, s.line))
+        self.foreign_writes.sort(
+            key=lambda w: (w.module_rel, w.line, w.attr))
+
+    def _callees(self, sc: "_FnScan", desc) -> list[str]:
+        kind = desc[0]
+        if kind == "origin":
+            origin = desc[1]
+            fq = self.resolve_qual(sc.module, origin, self.functions)
+            if fq is not None:
+                return [fq]
+            cq = self.class_of(sc.module, origin)
+            if cq is not None:
+                init = self.method_qual(cq, "__init__")
+                return [init] if init else []
+            return []
+        if kind == "self":
+            if sc.class_qual is None:
+                return []
+            mq = self.method_qual(sc.class_qual, desc[1])
+            return [mq] if mq else []
+        if kind == "selfattr":  # self.<attr>.<meth>()
+            if sc.class_qual is None:
+                return []
+            attr, meth = desc[1], desc[2]
+            type_quals = self.attr_types.get(
+                (sc.class_qual, attr), ())
+            out = []
+            for tq in sorted(type_quals):
+                mq = self.method_qual(tq, meth)
+                if mq is not None:
+                    out.append(mq)
+            return out
+        if kind == "attr":  # <local>.<meth>() with a known local type
+            type_quals, meth = desc[1], desc[2]
+            out = []
+            for tq in sorted(type_quals):
+                mq = self.method_qual(tq, meth)
+                if mq is not None:
+                    out.append(mq)
+            return out
+        return []
+
+    def _target_qual(self, sc: "_FnScan", desc) -> str | None:
+        """Resolve a Thread(target=...) expression descriptor."""
+        if isinstance(desc, str):
+            return desc  # already resolved
+        out = self._callees(sc, desc)
+        return out[0] if out else None
+
+    def _fixpoint_may_acquire(self) -> None:
+        acq = {q: {lock for lock, _, _ in fi.acquires}
+               for q, fi in self.functions.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, callees in self.call_graph.items():
+                mine = acq.setdefault(q, set())
+                before = len(mine)
+                for c in callees:
+                    mine |= acq.get(c, set())
+                if len(mine) != before:
+                    changed = True
+        self.may_acquire = {q: frozenset(v) for q, v in acq.items()}
+
+    def _fixpoint_held_under(self) -> None:
+        """PR 8's intra-class "caller holds the lock" fixpoint,
+        generalized across classes and modules: a function is held
+        under lock L when EVERY live call site in the package holds L
+        (lexically or transitively) — call sites inside constructors
+        are construction-time and don't count; a function reachable
+        ONLY from constructors is exempt outright (None); a function
+        with no call sites at all (an entry point, a thread target)
+        is guaranteed nothing (empty set)."""
+        callers: dict[str, list[tuple[str, tuple[str, ...]]]] = {}
+        for q, fi in self.functions.items():
+            for callee, held, _line in fi.calls:
+                callers.setdefault(callee, []).append((q, held))
+        TOP = None  # "construction-only": exempt
+        held: dict[str, frozenset | None] = {
+            q: TOP for q in self.functions}
+        changed = True
+        while changed:
+            changed = False
+            for q in self.functions:
+                if q.endswith(".__init__"):
+                    continue  # constructors stay exempt (TOP)
+                sites = callers.get(q)
+                if not sites:
+                    new = frozenset()
+                else:
+                    parts = []
+                    for caller, site_held in sites:
+                        if caller.endswith(".__init__"):
+                            continue
+                        hu = held.get(caller)
+                        if hu is TOP:
+                            continue  # construction-time path
+                        parts.append(frozenset(site_held) | hu)
+                    if not parts:
+                        new = TOP
+                    else:
+                        acc = parts[0]
+                        for p in parts[1:]:
+                            acc &= p
+                        new = acc
+                if new != held[q]:
+                    held[q] = new
+                    changed = True
+        self.held_under = held
+
+    def _build_lock_edges(self, scans: list["_FnScan"]) -> None:
+        def add(frm: str, to: str, site: tuple) -> None:
+            if frm == to:
+                return  # re-entrancy (RLock/Condition) is not order
+            self.lock_edges.setdefault((frm, to), []).append(site)
+
+        for sc in scans:
+            fi = self.functions.get(sc.qual)
+            if fi is None:
+                continue
+            for lock, held, line in fi.acquires:
+                for h in held:
+                    add(h, lock, (sc.module.rel, line,
+                                  f"{sc.qual} acquires {lock} "
+                                  f"while holding {h}"))
+            for callee, held, line in fi.calls:
+                if not held:
+                    continue
+                for lock in sorted(self.may_acquire.get(callee, ())):
+                    for h in held:
+                        add(h, lock, (sc.module.rel, line,
+                                      f"{sc.qual} -> {callee} "
+                                      f"(may acquire {lock}) while "
+                                      f"holding {h}"))
+        for sites in self.lock_edges.values():
+            sites.sort()
 
 
-def build_index(root: str, files: list[str] | None = None) \
-        -> PackageIndex:
+class _FnScan(ast.NodeVisitor):
+    """One function's lock/call/spawn scan (link() pass B).
+
+    Tracks held lock identities through ``with`` nesting, records raw
+    call descriptors for later resolution, instantiation argument
+    types for constructor-parameter propagation, thread spawn sites
+    and direct ``os.fsync`` evidence.
+    """
+
+    def __init__(self, index: PackageIndex, module: ModuleInfo,
+                 qual: str, node: ast.AST, ci: ClassInfo | None,
+                 class_qual: str | None):
+        self.index = index
+        self.module = module
+        self.qual = qual
+        self.fn_node = node
+        self.ci = ci
+        self.class_qual = class_qual
+        self._held: list[str] = []
+        self.acquires: list[tuple[str, tuple[str, ...], int]] = []
+        #: (descriptor, held lock ids, line); descriptor is
+        #: ("origin", dotted) | ("self", meth) | ("attr", {quals}, meth)
+        self.raw_calls: list[tuple] = []
+        #: (class qual, [(pos_or_kwname, arg class qual)])
+        self.instantiations: list[tuple] = []
+        self.spawns: list[SpawnSite] = []
+        self.fsync = False
+        self.foreign_writes: list[ForeignWrite] = []
+        self._local_types: dict[str, set[str]] = {}
+        self._created: set[str] = set()  # locals constructed here
+
+    def run(self) -> None:
+        # pre-pass: local var -> class types. Sources: direct
+        # construction (x = C(...), marks created-here), annotated
+        # parameters, typed-container access (self.<d>.get/[k]/
+        # .values()/.items() where the element type is known) — the
+        # receivers the foreign-write analysis needs.
+        for pname, cq in self.index.param_types.get(
+                self.qual, {}).items():
+            self._local_types.setdefault(pname, set()).add(cq)
+        for sub in ast.walk(self.fn_node):
+            if isinstance(sub, ast.Assign):
+                tq = self._expr_class(sub.value)
+                eq = self._container_elem(sub.value)
+                for t in sub.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if tq is not None:
+                        self._local_types.setdefault(
+                            t.id, set()).add(tq)
+                        self._created.add(t.id)
+                    elif eq is not None:
+                        self._local_types.setdefault(
+                            t.id, set()).add(eq)
+            elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                eq = self._iter_elem(sub.iter)
+                if eq is None:
+                    continue
+                tgt = sub.target
+                if isinstance(tgt, ast.Tuple) and tgt.elts:
+                    tgt = tgt.elts[-1]  # for k, w in d.items()
+                if isinstance(tgt, ast.Name):
+                    self._local_types.setdefault(
+                        tgt.id, set()).add(eq)
+        for stmt in getattr(self.fn_node, "body", []):
+            self.visit(stmt)
+
+    # ---- type helpers ----
+
+    def _expr_class(self, expr: ast.expr) -> str | None:
+        """The package class an expression obviously constructs
+        (``C(...)``, or either arm of ``C(...) if x else None``)."""
+        if isinstance(expr, ast.IfExp):
+            return self._expr_class(expr.body) \
+                or self._expr_class(expr.orelse)
+        if isinstance(expr, ast.Call):
+            return self.index.class_of(
+                self.module, self.module.resolve(expr.func))
+        return None
+
+    def _self_container_elem(self, expr: ast.expr) -> str | None:
+        """Element type of ``self.<d>`` when the container's element
+        class is known."""
+        attr = self._self_attr(expr)
+        if attr is None or self.class_qual is None:
+            return None
+        types = self.index.container_types.get(
+            (self.class_qual, attr))
+        return sorted(types)[0] if types else None
+
+    def _container_elem(self, expr: ast.expr) -> str | None:
+        """Element type of a typed-container ACCESS expression:
+        ``self.<d>.get(k)`` / ``self.<d>[k]`` / ``.pop(k)``."""
+        if isinstance(expr, ast.Subscript):
+            return self._self_container_elem(expr.value)
+        if isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr in ("get", "pop"):
+            return self._self_container_elem(expr.func.value)
+        return None
+
+    def _iter_elem(self, expr: ast.expr) -> str | None:
+        """Element type of an ITERATION expression over a typed
+        container: ``self.<d>.values()/items()``, the same behind
+        ``list(...)`` / ``sorted(...)``, or ``self.<list>``."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name) \
+                    and f.id in ("list", "sorted", "tuple", "iter",
+                                 "reversed") and expr.args:
+                return self._iter_elem(expr.args[0])
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in ("values", "items"):
+                return self._self_container_elem(f.value)
+        return self._self_container_elem(expr)
+
+    def _self_attr(self, node) -> str | None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _lock_id(self, ctx: ast.expr) -> str | None:
+        """The lock identity a ``with`` context expression acquires,
+        if any: a class lock attr or a module-global lock."""
+        if isinstance(ctx, ast.Call):
+            ctx = ctx.func
+        attr = self._self_attr(ctx)
+        if attr is not None and self.ci is not None \
+                and attr in self.ci.lock_attrs:
+            return f"{self.class_qual}.{attr}"
+        d = dotted(ctx)
+        if d is not None:
+            origin = self.module.resolve(ctx)
+            gq = self.index.resolve_qual(self.module, origin,
+                                         self.index.global_locks)
+            if gq is not None:
+                return gq
+        return None
+
+    # ---- visitors ----
+
+    def _record_foreign(self, name: str, attr: str, kind: str,
+                        line: int) -> None:
+        if name == "self":
+            return
+        quals = self._local_types.get(name)
+        if not quals:
+            return
+        self.foreign_writes.append(ForeignWrite(
+            module_rel=self.module.rel, line=line,
+            func_qual=self.qual, obj_types=frozenset(quals),
+            attr=attr, held=tuple(self._held),
+            created_here=name in self._created, kind=kind))
+
+    def _foreign_target(self, t: ast.expr, line: int) -> None:
+        if isinstance(t, ast.Subscript):
+            t = t.value  # w.x[k] = v mutates w.x
+        if isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name):
+            self._record_foreign(t.value.id, t.attr, "assign", line)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                self._foreign_target(elt, line)
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._foreign_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._foreign_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                self.acquires.append(
+                    (lid, tuple(self._held), node.lineno))
+                self._held.append(lid)
+                acquired.append(lid)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call):
+        origin = self.module.resolve(node.func)
+        held = tuple(self._held)
+        if origin == "os.fsync":
+            self.fsync = True
+        if origin == "threading.Thread":
+            self._record_spawn(node)
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            recv = fn.value  # w.deaths.append(...): mutates w.deaths
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name):
+                self._record_foreign(recv.value.id, recv.attr,
+                                     "mutate", node.lineno)
+        recorded = False
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            attr = self._self_attr(fn)
+            if attr is not None and self.class_qual is not None:
+                # self.m(...): resolved later through the MRO walk
+                # (inherited methods included)
+                self.raw_calls.append(
+                    (("self", attr), held, node.lineno))
+                recorded = True
+            elif isinstance(recv, ast.Name):
+                quals = self._local_types.get(recv.id)
+                if quals:
+                    self.raw_calls.append(
+                        (("attr", frozenset(quals), fn.attr),
+                         held, node.lineno))
+                    recorded = True
+            else:
+                recv_attr = self._self_attr(recv)
+                if recv_attr is not None \
+                        and self.class_qual is not None:
+                    # self.<attr>.m(...): the attr's type set is only
+                    # complete after ctor-param propagation — defer
+                    self.raw_calls.append(
+                        (("selfattr", recv_attr, fn.attr),
+                         held, node.lineno))
+                    recorded = True
+        if not recorded and origin is not None:
+            self.raw_calls.append(
+                (("origin", origin), held, node.lineno))
+        # instantiation argument types (ctor-param propagation)
+        cq = self.index.class_of(self.module, origin)
+        if cq is not None:
+            arg_types = []
+            for i, a in enumerate(node.args):
+                tq = self._expr_class(a)
+                if tq is not None:
+                    arg_types.append((i, tq))
+            for kw in node.keywords:
+                tq = self._expr_class(kw.value)
+                if tq is not None and kw.arg is not None:
+                    arg_types.append((kw.arg, tq))
+            if arg_types:
+                self.instantiations.append((cq, arg_types))
+        self.generic_visit(node)
+
+    def _record_spawn(self, node: ast.Call) -> None:
+        daemon = False
+        target_desc = None
+        for kw in node.keywords:
+            if kw.arg == "daemon":
+                daemon = isinstance(kw.value, ast.Constant) \
+                    and bool(kw.value.value)
+            elif kw.arg == "target":
+                target_desc = self._callable_desc(kw.value)
+        attr = local = None
+        parent = getattr(node, "_gt_parent", None)
+        if isinstance(parent, ast.Assign) and parent.targets:
+            t = parent.targets[0]
+            a = self._self_attr(t)
+            if a is not None:
+                attr = a
+            elif isinstance(t, ast.Name):
+                local = t.id
+        self.spawns.append(SpawnSite(
+            module_rel=self.module.rel, line=node.lineno,
+            func_qual=self.qual, class_qual=self.class_qual,
+            daemon=daemon, target=target_desc, attr=attr,
+            local=local, node=node))
+
+    def _callable_desc(self, expr: ast.expr):
+        """A raw-call-style descriptor for a thread target."""
+        attr = self._self_attr(expr)
+        if attr is not None:
+            return ("self", attr)
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            origin = self.module.resolve(expr)
+            if origin is not None:
+                return ("origin", origin)
+        return None
+
+    # nested defs: same scope approximation as _MethodScanner — their
+    # bodies execute with whatever the enclosing code holds when it
+    # calls them inline (the closure-heavy serve/fleet idiom)
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def build_index(root: str, files: list[str] | None = None,
+                jobs: int | None = None) -> PackageIndex:
     """Index ``root`` (a package directory). ``files`` restricts the
-    set (--changed-only); paths outside root are ignored."""
+    set (--changed-only); paths outside root are ignored. ``jobs``
+    parses on a process pool (deterministic merge: results are sorted
+    by path and parent links re-attached before linking); ``None``
+    auto-sizes, ``1`` forces the serial path."""
     root = os.path.abspath(root)
     if files is None:
         files = []
@@ -343,13 +1246,35 @@ def build_index(root: str, files: list[str] | None = None) \
             os.path.abspath(f) for f in files
             if f.endswith(".py")
             and os.path.abspath(f).startswith(root + os.sep))
+    files = [p for p in files if os.path.exists(p)]
+    if jobs is None:
+        jobs = min(8, os.cpu_count() or 1)
     modules, bad = [], []
-    for path in files:
-        if not os.path.exists(path):
-            continue  # --changed-only on a deleted file
-        mod = load_module(path, root)
-        if mod is None:
-            bad.append(path)
-        else:
-            modules.append(mod)
-    return PackageIndex(root=root, modules=modules, syntax_errors=bad)
+    if jobs > 1 and len(files) >= PARALLEL_MIN_FILES:
+        import concurrent.futures as cf
+
+        with cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(
+                _load_for_pool, [(p, root) for p in files],
+                chunksize=max(1, len(files) // (jobs * 4))))
+        for path, res in zip(files, results):
+            if isinstance(res, str):
+                bad.append(res)
+            else:
+                set_parents(res.tree)
+                modules.append(res)
+    else:
+        for path in files:
+            mod = load_module(path, root)
+            if mod is None:
+                bad.append(path)
+            else:
+                modules.append(mod)
+    modules.sort(key=lambda m: m.rel)
+    index = PackageIndex(root=root, modules=modules,
+                         syntax_errors=sorted(bad))
+    return index.link()
+
+
+#: below this many files a process pool costs more than it saves
+PARALLEL_MIN_FILES = 24

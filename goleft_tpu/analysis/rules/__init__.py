@@ -10,15 +10,23 @@ from __future__ import annotations
 
 from .determinism import DeterminismRule
 from .exceptions import ExceptionRule
+from .lockorder import LockOrderRule
 from .locks import LockDisciplineRule
+from .metrics import MetricsContractRule
 from .obs_span import ObsSpanRule
 from .plan_boundary import PlanBoundaryRule
+from .resources import ResourceLifecycleRule
+from .threads import ThreadLifecycleRule
 from .tracer import TracerRule
 
 ALL_RULES = (
     DeterminismRule(),
     TracerRule(),
     LockDisciplineRule(),
+    LockOrderRule(),
+    ThreadLifecycleRule(),
+    ResourceLifecycleRule(),
+    MetricsContractRule(),
     ExceptionRule(),
     PlanBoundaryRule(),
     ObsSpanRule(),

@@ -1,0 +1,309 @@
+"""Interprocedural lock rules: ordering cycles and guard escapes.
+
+``lck-order``
+    Cycle detection over the package-wide acquired-while-holding
+    graph. A node is a lock *identity* (a class lock attribute —
+    ``serve.batcher.MicroBatcher._cond`` — or a module-global lock);
+    an edge A→B means somewhere in the package lock B is acquired
+    while A is held, either directly (nested ``with``) or through a
+    call chain (the index's ``may_acquire`` fixpoint over the
+    cross-module call graph, so ``with self._lock: self._pool.kick()``
+    sees the locks ``kick`` takes three modules away). Two threads
+    taking two locks in opposite orders is the classic deadlock; a
+    cycle in this graph is exactly that potential. A *diamond*
+    (A→B via two different paths) is benign and not flagged — only
+    strongly-connected components with ≥2 locks are. Self-edges are
+    skipped (re-entrant acquisition through RLock/Condition is a
+    different bug class, not an ordering one).
+
+``lck-escape``
+    A lock-guarded MUTABLE attribute (a list/dict/set/deque built in
+    ``__init__`` and mutated under the class's lock) returned bare
+    from a method, or stored onto a foreign object: the reference
+    escapes its guard, and every downstream iteration races the
+    writers the lock exists to serialize. Returning a *copy*
+    (``list(self._q)``, ``dict(self._m)``, ``self._q.copy()``,
+    ``sorted(...)``) is the sanctioned pattern and stays clean.
+
+``lck-foreign-write``
+    PR 8's lock rule, across class boundaries: the serve/fleet tier
+    is full of passive state objects (``_Worker``, ``WorkerSlot``,
+    ``_Item``) whose fields are guarded by their OWNER's lock — a
+    discipline the per-class analysis cannot see. For an attribute of
+    a lockless package class that is mutated at least once under some
+    lock (through a typed receiver: annotated parameters, typed
+    containers, direct construction), any mutation site holding no
+    lock — lexically or through the interprocedural caller-holds
+    fixpoint (``index.held_under``) — is flagged. Mutations in the
+    function that CONSTRUCTED the object are exempt (not shared yet,
+    the cross-class analogue of the ``__init__`` exemption), and a
+    class whose fields are never mutated under any lock is out of
+    scope entirely (the single-writer design is legitimate — the
+    supervisor's WorkerSlot machine — and flagging it would be
+    noise).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex
+
+ID_ORDER = "lck-order"
+ID_ESCAPE = "lck-escape"
+ID_FOREIGN = "lck-foreign-write"
+
+#: constructors whose result is mutable shared state worth guarding
+_MUTABLE_FACTORIES = {
+    "list", "dict", "set", "collections.deque",
+    "collections.defaultdict", "collections.OrderedDict",
+    "collections.Counter",
+}
+
+
+def _mutable_attrs(module: ModuleInfo, ci) -> set[str]:
+    """Attributes initialized to an obviously-mutable container in
+    any method (usually ``__init__``)."""
+    def is_mutable(v) -> bool:
+        if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            return True
+        return isinstance(v, ast.Call) \
+            and module.resolve(v.func) in _MUTABLE_FACTORIES
+
+    def self_attr(t) -> str | None:
+        if isinstance(t, ast.Attribute) \
+                and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        return None
+
+    out: set[str] = set()
+    for m in ci.methods.values():
+        for sub in ast.walk(m.node):
+            if isinstance(sub, ast.Assign) \
+                    and is_mutable(sub.value):
+                for t in sub.targets:
+                    attr = self_attr(t)
+                    if attr is not None:
+                        out.add(attr)
+            elif isinstance(sub, ast.AnnAssign) \
+                    and sub.value is not None \
+                    and is_mutable(sub.value):
+                attr = self_attr(sub.target)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _sccs(nodes: list[str], edges: dict) -> list[list[str]]:
+    """Tarjan's strongly-connected components, deterministic order."""
+    adj: dict[str, list[str]] = {n: [] for n in nodes}
+    for (a, b) in sorted(edges):
+        if a in adj and b in adj:
+            adj[a].append(b)
+    index_of: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the serve call graph is shallow, but a
+        # lint gate must not recursion-error on adversarial input)
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index_of[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for i in range(pi, len(adj[node])):
+                w = adj[node][i]
+                if w not in index_of:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index_of[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index_of[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for n in nodes:
+        if n not in index_of:
+            strongconnect(n)
+    return out
+
+
+class LockOrderRule:
+    id = ID_ORDER
+    ids = (ID_ORDER, ID_ESCAPE, ID_FOREIGN)
+    severity = "error"
+    description = ("cross-module lock-acquisition cycles (deadlock "
+                   "potential), lock-guarded mutable state escaping "
+                   "its guard, and cross-class writes to another "
+                   "object's lock-guarded fields")
+
+    # ---- lck-escape: per module ----
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        for ci in module.classes:
+            if not ci.lock_attrs:
+                continue
+            exposed = ci.guarded_attrs() & _mutable_attrs(module, ci)
+            if not exposed:
+                continue
+            for m in ci.methods.values():
+                if m.name == "__init__":
+                    continue
+                for sub in ast.walk(m.node):
+                    attr = self._escaping_attr(sub)
+                    if attr in exposed:
+                        out.append(Finding(
+                            module.rel, sub.lineno, ID_ESCAPE,
+                            f"{ci.name}.{m.name}: lock-guarded "
+                            f"mutable attribute {attr!r} escapes its "
+                            "guard (bare reference handed out) — "
+                            "return a copy (list()/dict()/.copy()) "
+                            "taken under the lock instead",
+                            snippet=module.snippet(sub.lineno)))
+        return out
+
+    @staticmethod
+    def _escaping_attr(node: ast.AST) -> str | None:
+        """The self-attr a statement hands out bare, if any: ``return
+        self.x`` / ``yield self.x`` / ``other.y = self.x``."""
+        def self_attr(expr) -> str | None:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                return expr.attr
+            return None
+
+        if isinstance(node, ast.Return) and node.value is not None:
+            return self_attr(node.value)
+        if isinstance(node, ast.Expr) \
+                and isinstance(node.value, ast.Yield) \
+                and node.value.value is not None:
+            return self_attr(node.value.value)
+        if isinstance(node, ast.Assign):
+            attr = self_attr(node.value)
+            if attr is None:
+                return None
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and not (isinstance(t.value, ast.Name)
+                                 and t.value.id == "self"):
+                    return attr
+        return None
+
+    # ---- lck-foreign-write: once per package ----
+
+    def _foreign_writes(self, index: PackageIndex) -> list[Finding]:
+        by_attr: dict[tuple[str, str], list] = {}
+        for w in index.foreign_writes:
+            for tq in sorted(w.obj_types):
+                entry = index.classes_by_qual.get(tq)
+                if entry is None or entry[1].lock_attrs:
+                    continue  # self-locked classes: the per-class rule
+                by_attr.setdefault((tq, w.attr), []).append(w)
+        out: list[Finding] = []
+        by_rel = {m.rel: m for m in index.modules}
+        seen: set[tuple] = set()
+        for (tq, attr) in sorted(by_attr):
+            writes = by_attr[(tq, attr)]
+
+            def effective(w) -> frozenset | None:
+                """Locks protecting this site; None = exempt."""
+                if w.created_here:
+                    return None
+                hu = index.held_under.get(w.func_qual)
+                if w.held:
+                    return frozenset(w.held) | (hu or frozenset())
+                if hu is None:  # construction-only caller chain
+                    return None
+                return hu
+
+            effs = [(w, effective(w)) for w in writes]
+            guard_locks = sorted({
+                lk for _, e in effs if e for lk in e})
+            if not guard_locks:
+                continue  # never guarded anywhere: single-writer
+                # design (supervisor slots) — out of scope
+            owner = tq.rsplit(".", 1)[-1]
+            for w, e in effs:
+                if e is None or e:
+                    continue  # exempt or guarded
+                key = (w.module_rel, w.line, attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                mod = by_rel.get(w.module_rel)
+                fn = w.func_qual.rsplit(".", 1)[-1]
+                locks = ", ".join(
+                    lk.rsplit(".", 2)[-2] + "." + lk.rsplit(".", 1)[-1]
+                    for lk in guard_locks)
+                out.append(Finding(
+                    w.module_rel, w.line, ID_FOREIGN,
+                    f"{fn}: {'mutation of' if w.kind == 'mutate' else 'write to'} "
+                    f"{owner}.{attr} without a lock — other sites "
+                    f"guard it with {locks}; cross-thread readers "
+                    "see torn/lost updates",
+                    snippet=mod.snippet(w.line) if mod else ""))
+        return out
+
+    # ---- lck-order: once per package ----
+
+    def check_package(self, index: PackageIndex) -> list[Finding]:
+        out = self._foreign_writes(index)
+        if not index.lock_edges:
+            return out
+        nodes = sorted({n for e in index.lock_edges for n in e})
+        for comp in _sccs(nodes, index.lock_edges):
+            if len(comp) < 2:
+                continue
+            # evidence: every edge inside the component, each with its
+            # first (sorted) site; the finding anchors on the first
+            comp_set = set(comp)
+            edges = sorted(
+                (a, b) for (a, b) in index.lock_edges
+                if a in comp_set and b in comp_set)
+            sites = [(index.lock_edges[e][0], e) for e in edges]
+            sites.sort()
+            (rel, line, why), _ = sites[0]
+            chain = " / ".join(
+                f"{a.rsplit('.', 2)[-2]}.{a.rsplit('.', 1)[-1]}"
+                f" -> {b.rsplit('.', 2)[-2]}.{b.rsplit('.', 1)[-1]}"
+                f" at {index.lock_edges[(a, b)][0][0]}:"
+                f"{index.lock_edges[(a, b)][0][1]}"
+                for a, b in edges)
+            mod = next((m for m in index.modules if m.rel == rel),
+                       None)
+            out.append(Finding(
+                rel, line, ID_ORDER,
+                f"lock-order cycle over {{{', '.join(comp)}}} — two "
+                "threads taking these in opposite orders deadlock; "
+                f"break one edge or impose a global order ({chain}; "
+                f"here: {why})",
+                snippet=mod.snippet(line) if mod else ""))
+        return out

@@ -1,0 +1,149 @@
+"""Metrics-contract rules: the registry namespace is an API.
+
+The /metrics document is consumed by the fleet rollup, the federation
+burn-rate gauges and every operator dashboard — in TWO encodings
+(dotted JSON and underscored Prometheus) that must never drift. Three
+rules hold the contract, package-wide (literal names only; f-string
+names are per-instance series and out of scope):
+
+``met-counter-dec``
+    An ``.inc(...)`` carrying a negative constant. Counters are
+    monotonic by definition — the rollup SUMS them across workers and
+    the sentinel diffs them across rounds; a decrement turns both
+    into nonsense. Track level with a gauge instead.
+
+``met-kind-drift``
+    One name registered as different instrument kinds at different
+    sites (``counter("x")`` here, ``gauge("x")`` there). The registry
+    is get-or-create per kind table, so both instruments EXIST and
+    the snapshot contains whichever the encoder reaches first — the
+    JSON and prom bodies can silently disagree about what "x" is.
+
+``met-prom-twin``
+    A dotted metric name whose underscored Prometheus twin appears
+    nowhere in tests/ or docs/ (or the package's own smokes): the
+    prom encoding of this metric is completely unpinned, which is
+    exactly how a JSON↔prom drift ships. The fix is honest work, not
+    ceremony: add the metric to docs/observability.md's name table
+    (or a test that greps the prom body), and the contract exists.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex
+
+ID_DEC = "met-counter-dec"
+ID_DRIFT = "met-kind-drift"
+ID_TWIN = "met-prom-twin"
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def _literal_name(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _instrument_uses(module: ModuleInfo):
+    """Yield (name, kind, line) for every ``.counter("lit")`` /
+    ``.gauge("lit")`` / ``.histogram("lit")`` attribute call."""
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _KINDS:
+            name = _literal_name(node)
+            if name is not None:
+                yield name, node.func.attr, node.lineno
+
+
+class MetricsContractRule:
+    id = ID_DRIFT
+    ids = (ID_DEC, ID_DRIFT, ID_TWIN)
+    severity = "error"
+    description = ("decremented counters, counter/gauge kind drift "
+                   "across modules, and dotted metric names whose "
+                   "underscored prom twin is pinned nowhere")
+
+    # ---- met-counter-dec: per module ----
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr != "inc":
+                continue
+            for a in list(node.args) + \
+                    [k.value for k in node.keywords]:
+                if isinstance(a, ast.UnaryOp) \
+                        and isinstance(a.op, ast.USub) \
+                        and isinstance(a.operand, ast.Constant) \
+                        and isinstance(a.operand.value, (int, float)):
+                    out.append(Finding(
+                        module.rel, node.lineno, ID_DEC,
+                        "counter decremented (.inc of a negative "
+                        "constant): counters are monotonic — the "
+                        "fleet rollup sums them and the sentinel "
+                        "diffs them; use a gauge for levels",
+                        snippet=module.snippet(node.lineno)))
+                    break
+        return out
+
+    # ---- met-kind-drift / met-prom-twin: once per package ----
+
+    def check_package(self, index: PackageIndex) -> list[Finding]:
+        uses: dict[str, list[tuple[str, str, int]]] = {}
+        for mod in index.modules:
+            for name, kind, line in _instrument_uses(mod):
+                uses.setdefault(name, []).append(
+                    (kind, mod.rel, line))
+        out: list[Finding] = []
+        by_rel = {m.rel: m for m in index.modules}
+        for name in sorted(uses):
+            sites = sorted(uses[name],
+                           key=lambda s: (s[1], s[2], s[0]))
+            kinds = sorted({k for k, _, _ in sites})
+            if len(kinds) > 1:
+                # anchor one finding at the first site of every kind
+                # beyond the majority/first one
+                first_of = {}
+                for k, rel, line in sites:
+                    first_of.setdefault(k, (rel, line))
+                keep = min(kinds, key=lambda k: (
+                    -sum(1 for s in sites if s[0] == k), k))
+                where = ", ".join(
+                    f"{k} at {first_of[k][0]}:{first_of[k][1]}"
+                    for k in kinds)
+                for k in kinds:
+                    if k == keep:
+                        continue
+                    rel, line = first_of[k]
+                    mod = by_rel.get(rel)
+                    out.append(Finding(
+                        rel, line, ID_DRIFT,
+                        f"metric {name!r} is registered as "
+                        f"{len(kinds)} different kinds ({where}): "
+                        "the JSON and prom encodings can silently "
+                        "disagree — pick one kind per name",
+                        snippet=mod.snippet(line) if mod else ""))
+            if "." in name:
+                twin = name.replace(".", "_")
+                if twin not in index.corpus():
+                    kind, rel, line = sites[0]
+                    mod = by_rel.get(rel)
+                    out.append(Finding(
+                        rel, line, ID_TWIN,
+                        f"metric {name!r}: its prom name {twin!r} "
+                        "appears in no test or doc — the Prometheus "
+                        "encoding of this metric is unpinned; add "
+                        "it to docs/observability.md's metric table "
+                        "or grep it in a test",
+                        severity="warning",
+                        snippet=mod.snippet(line) if mod else ""))
+        return out

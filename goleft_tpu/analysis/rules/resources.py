@@ -1,0 +1,137 @@
+"""Resource lifecycle: every acquisition needs an owner who closes it.
+
+``res-leak`` flags the acquisition of an OS-backed resource —
+``subprocess.Popen``, ``socket.socket`` / ``create_connection``,
+``http.client.HTTPConnection``, ``tempfile.NamedTemporaryFile`` /
+``TemporaryFile`` — whose handle has no visible release path:
+
+  - consumed inline (``json.load(Popen(...).stdout)``-shapes): nobody
+    holds a name, so nobody can ever close/terminate it; on CPython
+    it lingers until a GC cycle, under a serve daemon that is an fd
+    (or zombie-child) leak with a date
+  - assigned to a local that the enclosing function neither closes
+    (``close``/``terminate``/``kill``/``communicate``/``wait``/
+    ``shutdown``/``release``/``detach``), enters as a context
+    manager, returns/yields, stores onto an object or container, nor
+    passes to another call (those last three transfer ownership —
+    the supervisor handing its Popen to a WorkerSlot is the idiom)
+
+The rule is deliberately presence-based, not path-sensitive: it asks
+"who is responsible for this handle", not "is every early-exit path
+covered" — the reviewed answer to the second question is a ``with``
+block, which also satisfies the first.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex, parents
+
+ID = "res-leak"
+
+RESOURCE_FACTORIES = {
+    "subprocess.Popen", "socket.socket", "socket.create_connection",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+    "tempfile.NamedTemporaryFile", "tempfile.TemporaryFile",
+}
+
+_RELEASE_METHODS = {
+    "close", "terminate", "kill", "communicate", "wait", "shutdown",
+    "release", "detach", "__exit__",
+}
+
+
+def _release_evidence(fn_node: ast.AST, name: str) -> bool:
+    """Does the enclosing scope release/transfer ownership of
+    ``name``? (see module docstring for the accepted shapes)"""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == name \
+                    and f.attr in _RELEASE_METHODS:
+                return True
+            for a in list(sub.args) + [k.value for k in sub.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True  # handed to another call
+        elif isinstance(sub, ast.withitem):
+            ctx = sub.context_expr
+            if isinstance(ctx, ast.Name) and ctx.id == name:
+                return True
+        elif isinstance(sub, (ast.Return, ast.Yield)) \
+                and sub.value is not None:
+            for n in ast.walk(sub.value):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        elif isinstance(sub, ast.Assign):
+            if isinstance(sub.value, ast.Name) \
+                    and sub.value.id == name \
+                    and any(not isinstance(t, ast.Name)
+                            for t in sub.targets):
+                return True  # self.x = h / slots[i] = h
+    return False
+
+
+class ResourceLifecycleRule:
+    id = ID
+    ids = (ID,)
+    severity = "error"
+    description = ("Popen/socket/HTTPConnection/tempfile acquired "
+                   "with no close/terminate owner (fd and "
+                   "zombie-child leaks)")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            origin = module.resolve(node.func)
+            if origin not in RESOURCE_FACTORIES:
+                continue
+            parent = next(parents(node), None)
+            if isinstance(parent, (ast.withitem, ast.Return,
+                                   ast.NamedExpr)):
+                continue
+            if isinstance(parent, ast.Assign):
+                # stored on self/container: ownership moves to the
+                # object's lifecycle (its close path is its business)
+                if any(not isinstance(t, ast.Name)
+                       for t in parent.targets):
+                    continue
+                name = parent.targets[0].id \
+                    if isinstance(parent.targets[0], ast.Name) \
+                    else None
+                scope = next(
+                    (p for p in parents(node)
+                     if isinstance(p, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))),
+                    module.tree)
+                if name is not None \
+                        and _release_evidence(scope, name):
+                    continue
+                out.append(Finding(
+                    module.rel, node.lineno, ID,
+                    f"{origin}() assigned to {name!r} but never "
+                    "closed/terminated, entered as a context "
+                    "manager, returned, stored or handed off — the "
+                    "handle leaks on every path",
+                    snippet=module.snippet(node.lineno)))
+                continue
+            if isinstance(parent, ast.Call):
+                continue  # argument: ownership passes to the callee
+            if isinstance(parent, ast.Attribute) \
+                    and isinstance(getattr(parent, "_gt_parent",
+                                           None), ast.Call) \
+                    and parent.attr in _RELEASE_METHODS:
+                continue  # Popen(...).wait() / .communicate(): fine
+            out.append(Finding(
+                module.rel, node.lineno, ID,
+                f"{origin}() handle is consumed inline with no name "
+                "to close — no one can release it; bind it (ideally "
+                "in a `with`)",
+                snippet=module.snippet(node.lineno)))
+        return out

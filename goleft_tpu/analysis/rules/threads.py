@@ -1,0 +1,164 @@
+"""Thread lifecycle rules over the index's spawn-site table.
+
+``thr-unjoined``
+    A ``threading.Thread`` started with no join/stop evidence on its
+    owner's lifecycle path. For a thread stored on ``self.<attr>``,
+    some method of the owning class must ``self.<attr>.join(...)``
+    (the close/drain contract every serve/fleet daemon follows); for
+    a function-local thread, the enclosing function must join it,
+    return it, store it, or hand it to another call (ownership
+    transfer). An orphaned running thread outlives every invariant
+    its owner's close() restores: it keeps mutating state after drain
+    "completed", and under pytest it leaks into the next test.
+    Smoke-harness modules (``*smoke*``) are exempt — they kill whole
+    subprocesses, not threads.
+
+``thr-daemon-io``
+    A ``daemon=True`` thread whose target (resolved through the
+    cross-module call graph, constructor-parameter types included)
+    transitively reaches ``os.fsync`` — i.e. a thread the interpreter
+    will KILL MID-WRITE at process exit while it is journaling or
+    checkpointing. Daemon threads die abruptly when the main thread
+    exits; an fsync'd append torn at that point is exactly the
+    half-record the journal formats exist to survive — which is why
+    the fix is either join-on-close (so exit never tears) or a
+    written waiver proving the sink is torn-tail tolerant.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..index import ModuleInfo, PackageIndex, SpawnSite
+
+ID_UNJOINED = "thr-unjoined"
+ID_DAEMON_IO = "thr-daemon-io"
+
+
+def _is_smoke(rel: str) -> bool:
+    base = rel.rsplit("/", 1)[-1]
+    return "smoke" in base
+
+
+def _name_join_evidence(fn_node: ast.AST, name: str) -> bool:
+    """Does the enclosing function join/own ``name``? join(), return,
+    yield, container store, attribute store, or passed as an arg."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == name and f.attr == "join":
+                return True
+            for a in list(sub.args) + [k.value for k in sub.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True
+        elif isinstance(sub, (ast.Return, ast.Yield)) \
+                and sub.value is not None:
+            for n in ast.walk(sub.value):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        elif isinstance(sub, ast.Assign):
+            if any(not isinstance(t, ast.Name)
+                   for t in sub.targets) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id == name:
+                return True  # self.x = t / box[k] = t: ownership moves
+    return False
+
+
+def _attr_join_evidence(index: PackageIndex, class_qual: str,
+                        attr: str) -> bool:
+    """Does ANY method of the owning class (or a subclass in the
+    package) call ``self.<attr>.join(...)``?"""
+    quals = [class_qual] + [
+        cq for cq, (mod, ci) in sorted(index.classes_by_qual.items())
+        if any(index.class_of(mod, mod.resolve(b)) == class_qual
+               for b in ci.node.bases)]
+    for cq in quals:
+        entry = index.classes_by_qual.get(cq)
+        if entry is None:
+            continue
+        _, ci = entry
+        for m in ci.methods.values():
+            for sub in ast.walk(m.node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "join":
+                    recv = sub.func.value
+                    if isinstance(recv, ast.Attribute) \
+                            and isinstance(recv.value, ast.Name) \
+                            and recv.value.id == "self" \
+                            and recv.attr == attr:
+                        return True
+    return False
+
+
+class ThreadLifecycleRule:
+    id = ID_UNJOINED
+    ids = (ID_UNJOINED, ID_DAEMON_IO)
+    severity = "error"
+    description = ("threads with no join/stop on the owner's close "
+                   "path, and daemon threads that fsync journals or "
+                   "checkpoints (torn by process exit)")
+
+    def check(self, module: ModuleInfo, index: PackageIndex) \
+            -> list[Finding]:
+        out: list[Finding] = []
+        for sp in index.spawn_sites:
+            if sp.module_rel != module.rel:
+                continue
+            out.extend(self._unjoined(module, index, sp))
+            out.extend(self._daemon_io(module, index, sp))
+        return out
+
+    def _unjoined(self, module: ModuleInfo, index: PackageIndex,
+                  sp: SpawnSite) -> list[Finding]:
+        if _is_smoke(sp.module_rel):
+            return []
+        if sp.attr is not None and sp.class_qual is not None:
+            if _attr_join_evidence(index, sp.class_qual, sp.attr):
+                return []
+            owner = sp.class_qual.rsplit(".", 1)[-1]
+            return [Finding(
+                module.rel, sp.line, ID_UNJOINED,
+                f"{owner} starts a thread on self.{sp.attr} but no "
+                f"method ever joins it — add self.{sp.attr}.join() "
+                "to the close/drain path (or a waiver proving who "
+                "stops it)",
+                snippet=module.snippet(sp.line))]
+        if sp.local is not None:
+            fi = index.functions.get(sp.func_qual)
+            if fi is not None and fi.node is not None \
+                    and _name_join_evidence(fi.node, sp.local):
+                return []
+            return [Finding(
+                module.rel, sp.line, ID_UNJOINED,
+                f"thread {sp.local!r} is started but never joined, "
+                "returned or handed off in "
+                f"{sp.func_qual.rsplit('.', 1)[-1]}() — it outlives "
+                "the function with nobody responsible for stopping "
+                "it",
+                snippet=module.snippet(sp.line))]
+        # anonymous Thread(...).start() — nobody can ever join it
+        return [Finding(
+            module.rel, sp.line, ID_UNJOINED,
+            "anonymous thread is unstoppable by construction — bind "
+            "it to a name/attr and join it on the owner's close path",
+            snippet=module.snippet(sp.line))]
+
+    def _daemon_io(self, module: ModuleInfo, index: PackageIndex,
+                   sp: SpawnSite) -> list[Finding]:
+        if not sp.daemon or sp.target is None:
+            return []
+        if not index.reaches_fsync(sp.target):
+            return []
+        return [Finding(
+            module.rel, sp.line, ID_DAEMON_IO,
+            f"daemon thread targets {sp.target} which transitively "
+            "calls os.fsync (journal/checkpoint writes): process "
+            "exit kills daemon threads mid-write — make it "
+            "non-daemon + joined, or waive with a written proof the "
+            "sink tolerates torn tails",
+            snippet=module.snippet(sp.line))]

@@ -1,0 +1,81 @@
+"""SARIF 2.1.0 emission: lint findings as a CI-annotatable artifact.
+
+``goleft-tpu lint --sarif FILE`` writes one SARIF log so CI systems
+(GitHub code scanning, Azure, anything SARIF-aware) can annotate the
+findings inline on the diff. The document is deterministic — findings
+arrive already sorted (path, line, rule), rule metadata is sorted by
+id, and keys are serialized sorted — so two runs over the same tree
+emit byte-identical SARIF (the same bar the text and ``--json``
+reports hold themselves to; pinned by tests/test_analysis.py).
+
+Schema choices, kept minimal and stable:
+
+  - one ``run`` with ``tool.driver.name = "gtlint"``
+  - every known rule id appears in ``driver.rules`` (index order is
+    what ``results[].ruleIndex`` points into)
+  - one ``result`` per finding: ruleId, level (``error``/``warning``
+    straight from the finding severity), message, one physical
+    location (repo-relative URI + 1-based startLine), and the
+    finding's snippet under ``partialFingerprints`` — the same
+    edit-resilient identity the baseline uses
+"""
+
+from __future__ import annotations
+
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings, rules) -> dict:
+    """Build the SARIF document. ``findings`` are sorted
+    :class:`~goleft_tpu.analysis.findings.Finding`s; ``rules`` is the
+    selected rule objects (their ids/descriptions become the driver
+    rule table)."""
+    rule_meta = sorted(
+        {rid: rule.description for rule in rules
+         for rid in rule.ids}.items())
+    rule_index = {rid: i for i, (rid, _) in enumerate(rule_meta)}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "gtlint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": [
+                    {"id": rid,
+                     "shortDescription": {"text": desc}}
+                    for rid, desc in rule_meta
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "ruleIndex": rule_index.get(f.rule, -1),
+                    "level": f.severity,
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": f.line},
+                        },
+                    }],
+                    "partialFingerprints": {
+                        "gtlintSnippet/v1": f.snippet,
+                    },
+                }
+                for f in findings
+            ],
+        }],
+    }
+
+
+def write_sarif(path: str, findings, rules) -> None:
+    doc = to_sarif(findings, rules)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")

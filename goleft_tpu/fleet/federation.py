@@ -297,19 +297,35 @@ class FleetPool:
         for f in list(self.fleets.values()):
             self._poll_one(f)
 
+    def _due_fleets(self, now: float) -> list[_Fleet]:
+        """Schedule reads under the pool lock — the same ``_Worker``
+        discipline WorkerPool follows (gtlint lck-foreign-write): the
+        fleet set is static today, but the field contract is "lock:
+        the pool's" and the poller must not be the one exception."""
+        with self._lock:
+            return [f for f in self.fleets.values()
+                    if f.next_poll_at <= now]
+
+    def _advance_schedule(self, f: _Fleet) -> None:
+        with self._lock:
+            f.next_poll_at += self.poll_interval_s
+            if f.next_poll_at <= time.monotonic():
+                f.next_poll_at = time.monotonic() \
+                    + self.poll_interval_s
+
+    def _next_poll_due(self, default: float) -> float:
+        with self._lock:
+            return min((f.next_poll_at
+                        for f in self.fleets.values()),
+                       default=default)
+
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
             now = time.monotonic()
-            for f in list(self.fleets.values()):
-                if f.next_poll_at <= now:
-                    self._poll_one(f)
-                    f.next_poll_at += self.poll_interval_s
-                    if f.next_poll_at <= time.monotonic():
-                        f.next_poll_at = time.monotonic() \
-                            + self.poll_interval_s
-            nxt = min((f.next_poll_at
-                       for f in list(self.fleets.values())),
-                      default=now + self.poll_interval_s)
+            for f in self._due_fleets(now):
+                self._poll_one(f)
+                self._advance_schedule(f)
+            nxt = self._next_poll_due(now + self.poll_interval_s)
             wait = min(self.poll_interval_s,
                        max(0.02, nxt - time.monotonic()))
             self._stop.wait(wait)

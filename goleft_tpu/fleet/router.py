@@ -332,6 +332,32 @@ class WorkerPool:
         for w in list(self.workers.values()):
             self._poll_one(w)
 
+    def _due_workers(self, now: float) -> list[_Worker]:
+        """The workers whose scheduled poll time has arrived — read
+        under the pool lock: the supervisor's ``add()`` writes a new
+        worker's phase offset concurrently (gtlint lck-foreign-write;
+        every ``_Worker`` field access shares the pool lock)."""
+        with self._lock:
+            return [w for w in self.workers.values()
+                    if w.next_poll_at <= now]
+
+    def _advance_schedule(self, w: _Worker) -> None:
+        """Step one worker's schedule by an interval (under the pool
+        lock — same discipline as :meth:`_due_workers`); a worker that
+        fell behind (slow worker, long timeout) is re-phased rather
+        than burst-caught-up."""
+        with self._lock:
+            w.next_poll_at += self.poll_interval_s
+            if w.next_poll_at <= time.monotonic():
+                w.next_poll_at = time.monotonic() \
+                    + self.poll_interval_s
+
+    def _next_poll_due(self, default: float) -> float:
+        with self._lock:
+            return min((w.next_poll_at
+                        for w in self.workers.values()),
+                       default=default)
+
     def _poll_loop(self) -> None:
         # per-worker periodic schedule with the deterministic phase
         # offsets from _schedule_first_poll: the loop wakes for the
@@ -339,18 +365,10 @@ class WorkerPool:
         # — never the whole fleet in one burst
         while not self._stop.is_set():
             now = time.monotonic()
-            for w in list(self.workers.values()):
-                if w.next_poll_at <= now:
-                    self._poll_one(w)
-                    w.next_poll_at += self.poll_interval_s
-                    if w.next_poll_at <= time.monotonic():
-                        # fell behind (slow worker, long timeout):
-                        # re-phase rather than burst-catch-up
-                        w.next_poll_at = time.monotonic() \
-                            + self.poll_interval_s
-            nxt = min((w.next_poll_at
-                       for w in list(self.workers.values())),
-                      default=now + self.poll_interval_s)
+            for w in self._due_workers(now):
+                self._poll_one(w)
+                self._advance_schedule(w)
+            nxt = self._next_poll_due(now + self.poll_interval_s)
             wait = min(self.poll_interval_s,
                        max(0.02, nxt - time.monotonic()))
             self._stop.wait(wait)

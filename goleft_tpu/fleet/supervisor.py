@@ -252,6 +252,13 @@ class Supervisor:
         self._slots: list[WorkerSlot] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # gtlint: ok thr-daemon-io — the loop's only fsync sink is the
+        # events journal, whose READERS skip torn tails by contract
+        # (obs/events.py: iter_journal_lines stop_on_torn=False, the
+        # PR-13 restart-continuation design); close() joins this
+        # thread, so only a hard kill can tear — exactly the case the
+        # format survives. daemon=True stays: a crashed operator path
+        # that never reaches close() must not hang process exit.
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="goleft-fleet-supervisor")

@@ -130,6 +130,10 @@ def probe_device(timeout_s: float | None = None, argv=None,
     fo = tempfile.TemporaryFile(mode="w+")
     fe = tempfile.TemporaryFile(mode="w+")
     try:
+        # gtlint: ok res-leak — deliberately orphaned: killing a probe
+        # mid-bring-up wedges the remote device session (docstring);
+        # the poll() loop below reaps the exit path, the hang path
+        # abandons the child BY DESIGN
         child = subprocess.Popen(
             argv or [sys.executable, "-c",
                      arm_traceback_snippet(_PROBE_SNIPPET, timeout_s)],
@@ -293,3 +297,8 @@ def devices_with_watchdog(seconds: float | None = None):
         return jax.devices()
     finally:
         done.set()
+        # the wait() returns the moment done is set, so this join is
+        # immediate — and without it the warn thread could outlive the
+        # call, firing a stale hang warning into a caller that already
+        # got its devices (gtlint thr-unjoined)
+        t.join(timeout=5.0)

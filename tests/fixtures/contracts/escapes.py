@@ -1,0 +1,20 @@
+"""Fixture: lock-guard escapes, bare vs copied."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def bare(self):
+        # the seeded violation: guarded mutable state, bare reference
+        return self._items
+
+    def copied(self):
+        with self._lock:
+            return list(self._items)  # clean: a copy under the lock

@@ -1,0 +1,63 @@
+"""Fixture: cross-class writes to lock-guarded fields.
+
+``Owner`` guards ``Cell`` fields with its own lock (the serve/fleet
+passive-state-object idiom). The poll loop's unlocked write is the
+seeded finding; ``_rephase`` is clean because EVERY live call site
+holds the lock (the caller-holds-the-lock fixpoint, interprocedural);
+``fresh`` mutates an object it just constructed (not shared yet).
+``Solo`` is single-writer by design — no site is ever locked, so the
+whole class is out of scope.
+"""
+import threading
+
+
+class Cell:
+    def __init__(self, name: str):
+        self.name = name
+        self.stamp = 0.0
+        self.hits = 0
+
+
+class Owner:
+    def __init__(self, names):
+        self._lock = threading.Lock()
+        self.cells = {n: Cell(n) for n in names}
+
+    def touch(self, name: str):
+        c = self.cells.get(name)
+        with self._lock:
+            c.hits += 1       # guarded: the discipline
+
+    def admit(self, name: str):
+        with self._lock:
+            if name not in self.cells:
+                c = self.cells[name] = Cell(name)
+                self._rephase(c)
+
+    def _rephase(self, c: Cell):
+        c.stamp = 1.0  # clean: every call site holds self._lock
+
+    def sweep(self):
+        for c in list(self.cells.values()):
+            c.stamp += 1.0  # lck-foreign-write: unlocked schedule write
+
+    def fresh(self, name: str) -> Cell:
+        c = Cell(name)
+        c.stamp = 2.0  # clean: constructed here, not shared yet
+        return c
+
+
+class SoloCell:
+    def __init__(self):
+        self.ticks = 0
+
+
+class Solo:
+    """Single-writer: no SoloCell field is ever mutated under a lock,
+    so the foreign-write rule leaves the whole class alone."""
+
+    def __init__(self):
+        self.cell = SoloCell()
+
+    def tick(self):
+        self.cell.ticks += 1

@@ -1,0 +1,19 @@
+"""Fixture: metrics-contract violations and their clean twins."""
+
+
+def count_things(registry, n):
+    registry.counter("fix.things_total").inc(n)      # clean
+    registry.counter("fix.undone_total").inc(-1)     # met-counter-dec
+    registry.gauge("fix.level").set(n)               # clean (gauge)
+
+
+def drift(registry):
+    # same name, two kinds: met-kind-drift
+    registry.counter("fix.drifty").inc()
+    return registry.gauge("fix.drifty")
+
+
+def pinned(registry):
+    # its underscored twin appears in pins.py's docstring -> no
+    # met-prom-twin for this one
+    registry.counter("fix.pinned_total").inc()

@@ -1,0 +1,2 @@
+"""Fixture corpus pin: fix_pinned_total is the documented prom twin
+of fix.pinned_total (the met-prom-twin rule searches raw text)."""

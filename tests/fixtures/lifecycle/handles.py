@@ -1,0 +1,34 @@
+"""Fixture: resource acquisition with and without a release owner."""
+import subprocess
+import tempfile
+
+
+def leaky(cmd):
+    child = subprocess.Popen(cmd)  # res-leak: nobody ever releases it
+    return None
+
+
+def waited(cmd):
+    child = subprocess.Popen(cmd)
+    try:
+        return child.wait(timeout=5)
+    finally:
+        child.kill()
+
+
+def handed_off(cmd, slots):
+    child = subprocess.Popen(cmd)
+    slots.append(child)  # ownership transfers to the container
+
+
+def returned(cmd):
+    return subprocess.Popen(cmd)  # the caller owns it
+
+
+def inline_tmp():
+    return tempfile.NamedTemporaryFile().name  # res-leak: no name
+
+
+def managed_tmp():
+    with tempfile.NamedTemporaryFile() as fh:
+        return fh.name

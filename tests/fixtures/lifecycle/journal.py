@@ -1,0 +1,26 @@
+"""Fixture: the fsync sink and a constructor-param-typed wrapper."""
+import os
+
+
+class Journal:
+    def __init__(self, path: str):
+        self.path = path
+
+    def append(self, line: str) -> None:
+        with open(self.path, "a") as fh:
+            fh.write(line)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+
+class EventSink:
+    """Receives the journal as a constructor PARAMETER — resolving
+    ``self.journal.append`` requires propagating the argument's type
+    from the instantiation site (runner.py)."""
+
+    def __init__(self, journal):
+        self.journal = journal
+
+    def emit(self, line: str) -> None:
+        if self.journal is not None:
+            self.journal.append(line)

@@ -1,0 +1,53 @@
+"""Fixture: thread lifecycle shapes, good and bad."""
+import threading
+
+from .journal import EventSink, Journal
+
+
+class FsyncDaemon:
+    """Daemon thread that reaches os.fsync through the ctor-param
+    chain: EventSink(Journal(p)).emit -> Journal.append -> fsync.
+    Joined on close, so thr-unjoined stays quiet — thr-daemon-io is
+    the seeded finding."""
+
+    def __init__(self, path: str):
+        self.sink = EventSink(Journal(path))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.1):
+            self.sink.emit("tick\n")
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class Orphaner:
+    """Starts a thread on self._t and never joins it anywhere —
+    thr-unjoined."""
+
+    def __init__(self):
+        self._t = threading.Thread(target=self._work, daemon=True)
+        self._t.start()
+
+    def _work(self):
+        pass
+
+    def close(self):
+        pass  # no join: the seeded violation
+
+
+def local_joined():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+    return True
+
+
+def local_orphan():
+    t = threading.Thread(target=print)
+    t.start()  # never joined/returned/stored: thr-unjoined

@@ -1,0 +1,24 @@
+"""Fixture: a cross-CLASS acquired-while-holding edge (no cycle)."""
+import threading
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.m = 0
+
+    def bump(self):
+        with self._lock:
+            self.m += 1
+
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inner = Inner()
+        self.n = 0
+
+    def poke(self):
+        with self._lock:
+            self.n += 1
+            self.inner.bump()  # Outer._lock -> Inner._lock edge

@@ -1,0 +1,23 @@
+"""Fixture: module A of the seeded cross-module lock-order cycle."""
+import threading
+
+from . import lockb
+from .lockb import inner_b as aliased_b  # import-as: must still resolve
+
+A_LOCK = threading.Lock()
+
+
+def inner_a():
+    with A_LOCK:
+        return 1
+
+
+def a_then_b():
+    # edge A_LOCK -> B_LOCK, through the ALIASED name
+    with A_LOCK:
+        return aliased_b()
+
+
+def a_diamond_left():
+    with A_LOCK:
+        return lockb.diamond_sink()

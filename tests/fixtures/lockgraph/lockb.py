@@ -1,0 +1,30 @@
+"""Fixture: module B — closes the cycle and hosts the diamond sink."""
+import threading
+
+from . import locka
+
+B_LOCK = threading.Lock()
+D_LOCK = threading.Lock()
+
+
+def inner_b():
+    with B_LOCK:
+        return 2
+
+
+def b_then_a():
+    # edge B_LOCK -> A_LOCK: together with a_then_b's A->B this is the
+    # classic two-lock deadlock cycle
+    with B_LOCK:
+        return locka.inner_a()
+
+
+def diamond_sink():
+    with D_LOCK:
+        return 3
+
+
+def a_diamond_right():
+    # second A_LOCK -> D_LOCK path: a DIAMOND, not a cycle — clean
+    with locka.A_LOCK:
+        return diamond_sink()

@@ -659,3 +659,114 @@ def test_fleet_modules_do_not_import_jax():
     r = subprocess.run([sys.executable, "-c", code],
                       capture_output=True, timeout=120)
     assert r.returncode == 0, r.stderr.decode()
+
+
+# ---------------- poll-schedule lock discipline (PR 15) ----------------
+# Regression for the gtlint lck-foreign-write finding: the poller
+# loop used to read/advance _Worker.next_poll_at WITHOUT the pool
+# lock, racing the supervisor's add() (which writes the new worker's
+# phase offset under it). Every schedule access now shares the lock;
+# these tests pin both the discipline and the schedule semantics the
+# refactor had to preserve.
+
+
+def _quiet_pool(urls, interval=10.0):
+    from goleft_tpu.fleet.router import WorkerPool
+
+    return WorkerPool(urls, poll_interval_s=interval)
+
+
+def test_pool_schedule_access_holds_the_pool_lock():
+    pool = _quiet_pool(["http://127.0.0.1:9301"])
+    w = next(iter(pool.workers.values()))
+    entered = threading.Event()
+    done = threading.Event()
+
+    def advance():
+        entered.set()
+        pool._advance_schedule(w)
+        done.set()
+
+    with pool._lock:
+        t = threading.Thread(target=advance)
+        t.start()
+        assert entered.wait(2.0)
+        # the schedule write must BLOCK while we hold the pool lock
+        assert not done.wait(0.15)
+    assert done.wait(2.0)
+    t.join(timeout=5.0)
+
+    # _due_workers takes the same lock
+    done2 = threading.Event()
+
+    def due():
+        pool._due_workers(time.monotonic())
+        done2.set()
+
+    with pool._lock:
+        t2 = threading.Thread(target=due)
+        t2.start()
+        assert not done2.wait(0.15)
+    assert done2.wait(2.0)
+    t2.join(timeout=5.0)
+
+
+def test_pool_schedule_semantics_preserved():
+    pool = _quiet_pool(["http://127.0.0.1:9302",
+                        "http://127.0.0.1:9303"], interval=10.0)
+    ws = sorted(pool.workers.values(), key=lambda w: w.url)
+    now = time.monotonic()
+    ws[0].next_poll_at = now - 1.0   # due
+    ws[1].next_poll_at = now + 5.0   # not yet
+    due = pool._due_workers(now)
+    assert due == [ws[0]]
+    # on-schedule advance: exactly one interval
+    ws[0].next_poll_at = now + 9.0
+    pool._advance_schedule(ws[0])
+    assert abs(ws[0].next_poll_at - (now + 19.0)) < 0.5
+    # fell-behind worker is re-phased from NOW, not burst-caught-up
+    ws[0].next_poll_at = now - 100.0
+    pool._advance_schedule(ws[0])
+    assert ws[0].next_poll_at > time.monotonic() + 9.0
+
+
+def test_pool_add_mid_run_keeps_jittered_phase():
+    from goleft_tpu.obs.fleetplane import poll_jitter_frac
+
+    pool = _quiet_pool(["http://127.0.0.1:9304"], interval=10.0)
+    url = "http://127.0.0.1:9305"
+    t0 = time.monotonic()
+    pool.add(url)
+    w = pool.workers[url]
+    expect = poll_jitter_frac(url) * 10.0
+    assert abs((w.next_poll_at - t0) - expect) < 0.5
+    # not swept into an immediate poll: the phase offset holds
+    if expect > 1.0:
+        assert w not in pool._due_workers(time.monotonic())
+
+
+def test_federation_schedule_access_holds_the_pool_lock():
+    from goleft_tpu.fleet.federation import FleetPool
+
+    pool = FleetPool(["http://127.0.0.1:9306"],
+                     poll_interval_s=10.0)
+    f = next(iter(pool.fleets.values()))
+    done = threading.Event()
+
+    def advance():
+        pool._advance_schedule(f)
+        done.set()
+
+    with pool._lock:
+        t = threading.Thread(target=advance)
+        t.start()
+        assert not done.wait(0.15)
+    assert done.wait(2.0)
+    t.join(timeout=5.0)
+    # and the semantics match the router's
+    now = time.monotonic()
+    f.next_poll_at = now - 1.0
+    assert pool._due_fleets(now) == [f]
+    f.next_poll_at = now - 100.0
+    pool._advance_schedule(f)
+    assert f.next_poll_at > time.monotonic() + 9.0
