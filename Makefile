@@ -7,7 +7,8 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        fleet-obs-smoke federation-chaos decode-smoke perf-gate \
+        fleet-obs-smoke federation-chaos decode-smoke \
+        dataplane-smoke perf-gate \
         lint lint-changed lint-ci plan-lint check clean
 
 native: build/libgoleftio.so
@@ -172,10 +173,25 @@ fleet-obs-smoke:
 federation-chaos:
 	python -m goleft_tpu.fleet.federation_smoke
 
+# object-store data plane end-to-end: the same CRAM/BAM cohorts staged
+# in a loopback stub object store — cohortdepth/depth/indexcov CLIs
+# byte-identical over https:// URLs vs local paths (--prefetch-depth
+# and --decode-device composing), an injected transient fault at the
+# fetch site retried to identical bytes, a 404'd object quarantining
+# only its own sample (exit 3), mid-run ETag drift detected as
+# stale-input (never silently mixed), a real serve worker
+# byte-identical over URLs, and two real fleets with DISTINCT
+# --shared-cache dirs behind a federation: cachesync replicates the
+# warm entry, the home fleet is SIGKILLed, and the survivor answers
+# byte-identically from the REPLICATED cache with zero device passes.
+# Host-pinned like the other smokes.
+dataplane-smoke:
+	python -m goleft_tpu.io.dataplane_smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
 # the test suite, then the end-to-end proofs
-check: lint plan-lint test decode-smoke fleet-smoke fleet-chaos \
-       fleet-obs-smoke federation-chaos
+check: lint plan-lint test decode-smoke dataplane-smoke fleet-smoke \
+       fleet-chaos fleet-obs-smoke federation-chaos
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -1483,7 +1483,74 @@ def host_suite(quick: bool, emit=None) -> dict:
         _put("wire_decode", _wire_decode_entry(quick))
     except Exception as e:  # noqa: BLE001
         _put("wire_decode", {"error": repr(e)})
+    try:
+        _put("remote_fetch", _remote_fetch_entry(quick))
+    except Exception as e:  # noqa: BLE001
+        _put("remote_fetch", {"error": repr(e)})
     return out
+
+
+def _remote_fetch_entry(quick: bool) -> dict:
+    """Object-store data plane staging throughput (io/remote.py): the
+    same blob read whole through the local ByteSource vs the HTTP
+    Range backend against the loopback stub store, plus the
+    sequential ranged-read path with and without read-ahead — the
+    ``overlap_efficiency`` leaf is how much block coalescing buys
+    over one-request-per-block when a consumer walks the object in
+    sub-block reads."""
+    import os as _os
+    import tempfile
+
+    from goleft_tpu.io import remote
+    from goleft_tpu.io.remote_stub import StubServer
+
+    size_mb = 8 if quick else 32
+    blob = np.random.default_rng(11).bytes(size_mb << 20)
+    step = 256 << 10  # sub-block consumer stride
+
+    def _mb_s(dt):
+        return round(size_mb / max(dt, 1e-9), 1)
+
+    def _seq(url, readahead):
+        _os.environ["GOLEFT_TPU_FETCH_READAHEAD"] = str(readahead)
+        try:
+            t0 = time.perf_counter()
+            with remote.open_source(url) as src:
+                for off in range(0, len(blob), step):
+                    src.read(off, step)
+            return time.perf_counter() - t0
+        finally:
+            _os.environ.pop("GOLEFT_TPU_FETCH_READAHEAD", None)
+
+    with tempfile.TemporaryDirectory(prefix="goleft_rf_") as d:
+        p = _os.path.join(d, "blob.bin")
+        with open(p, "wb") as fh:
+            fh.write(blob)
+        with StubServer() as srv:
+            url = srv.put("blob.bin", blob)
+            t0 = time.perf_counter()
+            if remote.fetch_bytes(p) != blob:
+                raise RuntimeError("local staging corrupted")
+            t_local = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            if remote.fetch_bytes(url) != blob:
+                raise RuntimeError("remote staging corrupted")
+            t_remote = time.perf_counter() - t0
+            t_ra = _seq(url, 4)
+            t_no = _seq(url, 0)
+    return {
+        "size_mb": size_mb,
+        "local_mb_per_s": _mb_s(t_local),
+        "remote_mb_per_s": _mb_s(t_remote),
+        "readahead_mb_per_s": _mb_s(t_ra),
+        "no_readahead_mb_per_s": _mb_s(t_no),
+        "overlap_efficiency": round(t_no / max(t_ra, 1e-9), 2),
+        "platform": "cpu",
+        "note": "loopback stub object store; remote = HTTP Range "
+                "ByteSource (block cache + coalesced read-ahead), "
+                "overlap_efficiency = sub-block sequential walk "
+                "no-readahead/readahead wall ratio",
+    }
 
 
 def _wire_decode_entry(quick: bool) -> dict:

@@ -148,10 +148,13 @@ def cohort_matrix_blocks(
 
     # resolve regions FIRST: a bad fai/bed/chrom must fail before the
     # (potentially huge) cohort of BAM handles is opened
+    from ..io import remote
+
     fai_path = fai or (reference + ".fai" if reference else None)
     if fai_path is None:
         raise SystemExit("cohortdepth: need -r reference or --fai")
-    if not os.path.exists(fai_path) and reference:
+    if not remote.exists(fai_path) and reference \
+            and not remote.is_remote(reference):
         write_fai(reference)
     fai_records = read_fai(fai_path)
     regions = cohort_regions(fai_records, chrom, window, bed)
@@ -176,7 +179,7 @@ def cohort_matrix_blocks(
         h = open_bam_file(b, lazy=True)
         if getattr(h, "is_cram", False):
             return h, None, get_short_name(b)
-        bai_p = b + ".bai" if os.path.exists(b + ".bai") else \
+        bai_p = b + ".bai" if remote.exists(b + ".bai") else \
             b[:-4] + ".bai"
         return h, read_bai(bai_p), get_short_name(b)
 

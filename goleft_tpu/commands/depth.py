@@ -340,10 +340,12 @@ def run_depth(
     the bench reads the same numbers ``--profile`` logs."""
     handle = open_bam_file(bam, lazy=True)
     hdr = handle.header
+    from ..io import remote
+
     if getattr(handle, "is_cram", False):
         bai = None  # CRAM random access rides the .crai inside the handle
     else:
-        bai = read_bai(bam + ".bai" if os.path.exists(bam + ".bai")
+        bai = read_bai(bam + ".bai" if remote.exists(bam + ".bai")
                        else bam[:-4] + ".bai")
     fai_path = fai or (reference + ".fai" if reference else None)
     if bed is None:
@@ -351,8 +353,9 @@ def run_depth(
             raise SystemExit(
                 "depth: need -r reference (with .fai) or -b bed regions"
             )
-        if not os.path.exists(fai_path):
-            if reference and os.path.exists(reference):
+        if not remote.exists(fai_path):
+            if reference and not remote.is_remote(reference) \
+                    and os.path.exists(reference):
                 from ..io.fai import write_fai
 
                 write_fai(reference)

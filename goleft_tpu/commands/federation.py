@@ -107,6 +107,27 @@ def main(argv=None) -> int:
                         "keys (existing keys stay for cache warmth; "
                         "spilled keys migrate home on recovery; "
                         "0 disables spillover)")
+    p.add_argument("--spill-recover", type=float, default=None,
+                   help="spilled keys return home only once the home "
+                        "fleet's burn rate falls to/below this "
+                        "(default: --spill-threshold — the two-sided "
+                        "hysteresis band that stops burn-rate "
+                        "flapping near the threshold from thrashing "
+                        "key migration)")
+    p.add_argument("--quota", action="append", default=[],
+                   metavar="TENANT=RATE[:BURST]",
+                   help="federation-level admission: per-tenant "
+                        "token-bucket request quota enforced at the "
+                        "front door (429 + retry_after_s before any "
+                        "fleet budget burns; '*' sets the default "
+                        "tenant; repeatable)")
+    p.add_argument("--cache-sync-interval", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="replicate the fleets' shared result caches "
+                        "(anti-entropy over /fleet/cache) every this "
+                        "many seconds, plus immediately on half-open "
+                        "rejoin (0 disables the timer; rejoin "
+                        "warm-up still runs)")
     p.add_argument("--tenant-burn-threshold", type=float,
                    default=0.0,
                    help="shed a tenant's best-effort traffic "
@@ -178,6 +199,9 @@ def main(argv=None) -> int:
         down_after=a.down_after,
         default_timeout_s=a.timeout_s,
         spill_threshold=a.spill_threshold,
+        spill_recover=a.spill_recover,
+        quotas=a.quota,
+        cache_sync_interval_s=a.cache_sync_interval,
         tenant_burn_threshold=a.tenant_burn_threshold,
         tenant_shed_min_requests=a.tenant_shed_min,
         error_budget=a.error_budget,

@@ -338,7 +338,8 @@ def main(argv=None) -> int:
                     redirect=a.redirect,
                     vnodes=a.vnodes,
                     registry=registry,
-                    error_budget=a.error_budget)
+                    error_budget=a.error_budget,
+                    cache_dir=a.shared_cache)
     if supervisor is not None:
         supervisor.bind(app)
     app.start()

@@ -56,10 +56,12 @@ class SampleIndex:
             self.mapped = 0
             self.unmapped = 0
         else:
+            from ..io import remote
+
             bai_path = path
             if not path.endswith(".bai"):
                 bai_path = path + ".bai"
-                if not os.path.exists(bai_path):
+                if not remote.exists(bai_path):
                     bai_path = path[:-4] + ".bai"
             idx = read_bai(bai_path)
             self.sizes = idx.sizes()
@@ -177,11 +179,13 @@ def _index_file(path: str) -> str:
     bind (a .bam input's evidence is its .bai; rewriting the index
     must invalidate the sample's shards even when the BAM is
     untouched)."""
+    from ..io import remote
+
     if path.endswith(".cram"):
         return path + ".crai"
     if path.endswith((".crai", ".bai")):
         return path
-    if os.path.exists(path + ".bai"):
+    if remote.exists(path + ".bai"):
         return path + ".bai"
     return path[:-4] + ".bai"
 

@@ -80,6 +80,7 @@ from ..obs.fleetplane import (
 )
 from ..obs.logging import get_logger
 from ..obs.metrics import MetricsRegistry
+from .admission import QuotaExceeded
 from .router import HashRing, request_affinity_key
 
 log = get_logger("fleet.federation")
@@ -202,12 +203,26 @@ class FleetPool:
     def __init__(self, urls: list[str], poll_interval_s: float = 2.0,
                  down_after: int = 2, timeout_s: float = 5.0,
                  spill_threshold: float = 0.0,
+                 spill_recover: float | None = None,
                  registry: MetricsRegistry | None = None):
         self.fleets = {u.rstrip("/"): _Fleet(u) for u in urls}
         self.poll_interval_s = poll_interval_s
         self.down_after = down_after
         self.timeout_s = timeout_s
         self.spill_threshold = spill_threshold
+        # two-sided spill hysteresis (the autoscaler's pattern): spill
+        # when burn rises past spill_threshold, return home only once
+        # it falls to/below spill_recover — a burn rate flapping in
+        # the (recover, threshold] band keeps its current placement
+        # instead of thrashing key migration. Default = threshold,
+        # which reproduces the historical single-threshold behavior.
+        self.spill_recover = spill_threshold \
+            if spill_recover is None else min(spill_recover,
+                                              spill_threshold)
+        # called with the fleet URL after a half-open probe succeeds
+        # (outside the pool lock) — the federation wires cache
+        # replication's rejoin warm-up here
+        self.on_rejoin = None
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self._lock = threading.Lock()
@@ -283,9 +298,14 @@ class FleetPool:
             f.healthy_workers = int(h.get("healthy") or 0)
             f.burn_rate = burn if isinstance(burn, (int, float)) \
                 else None
-            f.saturated = (self.spill_threshold > 0
-                           and f.burn_rate is not None
-                           and f.burn_rate > self.spill_threshold)
+            if self.spill_threshold <= 0 or f.burn_rate is None:
+                f.saturated = False
+            elif f.burn_rate > self.spill_threshold:
+                f.saturated = True
+            elif f.burn_rate <= self.spill_recover:
+                f.saturated = False
+            # else: inside the (recover, threshold] band — hold the
+            # previous saturation verdict (hysteresis, no thrash)
             f.tenants = slo.get("tenants") or {}
             if offset is not None:
                 f.clock_offset_s = offset if f.clock_offset_s is None \
@@ -372,16 +392,26 @@ class FleetPool:
         f = self.fleets.get(url.rstrip("/"))
         if f is None:
             return
+        rejoined = False
         with self._lock:
             if f.state != PROBE:
                 return
             f.probing = False
             if ok:
                 f.state = UP
+                rejoined = True
                 log.warning("federation: fleet %s probe succeeded — "
                             "rejoined", f.url)
                 self.registry.counter(
                     "federation.fleet_rejoin_total").inc()
+        if rejoined and self.on_rejoin is not None:
+            # outside the lock: the hook does network I/O (cache
+            # replication warm-up) and must never block polling
+            try:
+                self.on_rejoin(f.url)
+            except Exception as e:  # noqa: BLE001 — hook is best-effort
+                log.warning("federation: on_rejoin hook failed for "
+                            "%s: %s", f.url, e)
 
     # ---- routing state ----
 
@@ -445,6 +475,7 @@ class FederationRouter:
                  down_after: int = 2,
                  default_timeout_s: float = 120.0,
                  spill_threshold: float = 0.0,
+                 spill_recover: float | None = None,
                  tenant_burn_threshold: float = 0.0,
                  tenant_shed_min_requests: int = 4,
                  error_budget: float = 0.01,
@@ -452,7 +483,9 @@ class FederationRouter:
                  slo_window_s: float = 300.0,
                  vnodes: int = 64,
                  registry: MetricsRegistry | None = None,
-                 flight_records: int = 64):
+                 flight_records: int = 64,
+                 quotas: list[str] | None = None,
+                 cache_sync_interval_s: float = 0.0):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.ring = HashRing(fleet_urls, vnodes=vnodes)
@@ -460,9 +493,26 @@ class FederationRouter:
                               poll_interval_s=poll_interval_s,
                               down_after=down_after,
                               spill_threshold=spill_threshold,
+                              spill_recover=spill_recover,
                               registry=self.registry)
         self.default_timeout_s = default_timeout_s
         self.spill_threshold = spill_threshold
+        # federation-level admission: the fleet tier's token-bucket
+        # table lifted to the front door, so a flooding tenant is
+        # refused in ONE place instead of burning N fleets' budgets
+        from .admission import QuotaTable
+
+        self.quotas = QuotaTable(quotas)
+        # cross-fleet cache replication (anti-entropy rounds over the
+        # UP fleets + an immediate warm-up on half-open rejoin)
+        from .cachesync import CacheSync
+
+        self.cache_sync = CacheSync(
+            lambda: sorted(self.pool.eligible()),
+            interval_s=cache_sync_interval_s,
+            registry=self.registry)
+        self.pool.on_rejoin = \
+            lambda url: self.cache_sync.sync_now("rejoin")
         self.tenant_burn_threshold = tenant_burn_threshold
         self.tenant_shed_min_requests = tenant_shed_min_requests
         self.error_budget = error_budget
@@ -486,9 +536,11 @@ class FederationRouter:
 
     def start(self) -> "FederationRouter":
         self.pool.start()
+        self.cache_sync.start()
         return self
 
     def close(self) -> None:
+        self.cache_sync.close()
         self.pool.close()
         self._tracer.remove_listener(self.flight.on_span)
 
@@ -639,6 +691,18 @@ class FederationRouter:
                                   self.default_timeout_s))
         self.registry.counter(
             f"federation.requests_total.{kind}").inc()
+        try:
+            self.quotas.check(tenant)
+        except QuotaExceeded as e:
+            # admission rejections mirror tenant sheds: honest
+            # retry_after_s, and NOT recorded in the SLO tracker — a
+            # refused request burned no fleet budget
+            self.registry.counter(
+                f"federation.admission_rejected_total.{tenant}").inc()
+            return 429, {"error": f"tenant {tenant!r} over quota",
+                         "shed": "admission",
+                         "tenant": tenant,
+                         "retry_after_s": e.retry_after_s}
         shed = self._maybe_shed_tenant(tenant, priority)
         if shed is not None:
             # NOT recorded in the tracker: the shed's own 429s must

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -77,9 +78,16 @@ def _file_key(path: str) -> tuple:
     duplicated here because importing it drags the whole
     ``goleft_tpu.parallel`` package — and jax — into the router
     process, whose entire point is staying a cheap jax-free
-    forwarder."""
+    forwarder. Remote URLs route through ``io.remote.remote_file_key``
+    (jax-free, parity-pinned): the SAME (url, length, etag) identity
+    in both mirrors keeps fleet and worker affinity aligned."""
     import os
 
+    if "://" in path:
+        from ..io import remote
+
+        if remote.is_remote(path):
+            return remote.remote_file_key(path)
     st = os.stat(path)
     return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
 
@@ -117,7 +125,10 @@ def request_affinity_key(kind: str, req: dict) -> str:
     for p in paths:
         try:
             parts.append(repr(_file_key(p)))
-        except OSError:
+        except (OSError, ValueError):
+            # OSError: unstat'able path / unreachable URL past the
+            # fetch retry budget; ValueError: unresolvable scheme —
+            # either way the raw path still routes deterministically
             parts.append(p)
     return "|".join(parts)
 
@@ -512,7 +523,8 @@ class RouterApp:
                  vnodes: int = 64,
                  registry: MetricsRegistry | None = None,
                  error_budget: float = 0.01,
-                 flight_records: int = 64):
+                 flight_records: int = 64,
+                 cache_dir: str | None = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.ring = HashRing(worker_urls, vnodes=vnodes)
@@ -539,6 +551,84 @@ class RouterApp:
         self.flight = FlightRecorder(max_records=flight_records)
         self._tracer = obs.get_tracer()
         self._tracer.add_listener(self.flight.on_span)
+        # the fleet's shared result-cache directory, advertised at
+        # GET/PUT /fleet/cache/* for cross-fleet replication (the
+        # federation's CacheSync pulls/pushes content-keyed entries)
+        self.cache_dir = cache_dir
+
+    # ---- the cache replication endpoint (fleet/cachesync.py) ----
+
+    _CACHE_NAME_RE = None  # compiled lazily (class attr, shared)
+
+    @classmethod
+    def _cache_name_ok(cls, name: str) -> bool:
+        """Only ResultCache's own filenames replicate: 32 hex chars +
+        ``.pkl`` — content-keyed by construction, and no path
+        traversal is expressible in the alphabet."""
+        import re as _re
+
+        if cls._CACHE_NAME_RE is None:
+            cls._CACHE_NAME_RE = _re.compile(r"^[0-9a-f]{32}\.pkl$")
+        return bool(cls._CACHE_NAME_RE.match(name))
+
+    def cache_list(self) -> tuple[int, dict]:
+        if not self.cache_dir:
+            return 404, {"error": "no shared cache on this fleet"}
+        entries = []
+        try:
+            # gtlint: ok det-unsorted-iter — sorted below
+            for name in os.listdir(self.cache_dir):
+                if not self._cache_name_ok(name):
+                    continue
+                try:
+                    st = os.stat(os.path.join(self.cache_dir, name))
+                except OSError:
+                    continue
+                entries.append({"name": name, "size": st.st_size})
+        except OSError as e:
+            return 503, {"error": f"cache dir unreadable: {e}"}
+        entries.sort(key=lambda e: e["name"])
+        return 200, {"entries": entries}
+
+    def cache_get(self, name: str):
+        """(code, bytes-or-error-dict) for one entry's raw bytes."""
+        if not self.cache_dir:
+            return 404, {"error": "no shared cache on this fleet"}
+        if not self._cache_name_ok(name):
+            return 400, {"error": f"bad cache entry name {name!r}"}
+        try:
+            with open(os.path.join(self.cache_dir, name), "rb") as fh:
+                data = fh.read()
+        except FileNotFoundError:
+            return 404, {"error": f"no cache entry {name}"}
+        except OSError as e:
+            return 503, {"error": f"cache read failed: {e}"}
+        self.registry.counter("fleet.cache_served_total").inc()
+        return 200, data
+
+    def cache_put(self, name: str, data: bytes) -> tuple[int, dict]:
+        """Store one replicated entry (tmp + atomic rename: a reader
+        never sees a torn entry, and concurrent pushes of the same
+        content-keyed name converge on identical bytes)."""
+        if not self.cache_dir:
+            return 404, {"error": "no shared cache on this fleet"}
+        if not self._cache_name_ok(name):
+            return 400, {"error": f"bad cache entry name {name!r}"}
+        dest = os.path.join(self.cache_dir, name)
+        tmp = dest + f".push.{os.getpid()}.tmp"
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, dest)
+        except OSError as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return 503, {"error": f"cache write failed: {e}"}
+        self.registry.counter("fleet.cache_stored_total").inc()
+        return 204, {}
 
     def start(self) -> "RouterApp":
         self.pool.start()
@@ -937,11 +1027,49 @@ class _RouterHandler(BaseHTTPRequestHandler):
             trace_id = unquote(u.path[len("/fleet/trace/"):])
             code, body = self.app.fleet_trace(trace_id)
             self._respond_json(code, body)
+        elif u.path == "/fleet/cache/" or u.path == "/fleet/cache":
+            code, body = self.app.cache_list()
+            self._respond_json(code, body)
+        elif u.path.startswith("/fleet/cache/"):
+            name = unquote(u.path[len("/fleet/cache/"):])
+            code, body = self.app.cache_get(name)
+            if isinstance(body, bytes):
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+                self.close_connection = True
+            else:
+                self._respond_json(code, body)
         elif u.path == "/metrics":
             self._respond_json(200, self.app.metrics_snapshot())
         else:
             self._respond_json(404,
                                {"error": f"no route {self.path}"})
+
+    def do_PUT(self):  # noqa: N802 — http.server contract
+        from urllib.parse import unquote, urlparse
+
+        u = urlparse(self.path)
+        if not u.path.startswith("/fleet/cache/"):
+            self._respond_json(404,
+                               {"error": f"no route {self.path}"})
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        data = self.rfile.read(n)
+        name = unquote(u.path[len("/fleet/cache/"):])
+        code, body = self.app.cache_put(name, data)
+        if code == 204:
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+        else:
+            self._respond_json(code, body)
 
     def do_POST(self):  # noqa: N802 — http.server contract
         n = int(self.headers.get("Content-Length", "0"))

@@ -478,6 +478,13 @@ class BamFile:
 
     @classmethod
     def from_file(cls, path: str, lazy: bool = False) -> "BamFile":
+        from . import remote
+
+        if remote.is_remote(path):
+            # no mmap over the network: stage the object once (the
+            # fetch tier's block cache + read-ahead overlap the
+            # round trips) and hand the codec plain bytes
+            return cls(remote.fetch_bytes(path), lazy=lazy)
         if lazy:
             import mmap
 
@@ -784,9 +791,26 @@ class _PyBamAdapter:
 
 def read_header_only(path: str, initial: int = 1 << 20) -> BamHeader:
     """Parse just the BAM header, reading a growing file prefix — avoids
-    pulling multi-GB files into memory for an SM-tag lookup."""
+    pulling multi-GB files into memory for an SM-tag lookup. Remote
+    URLs read the same growing prefix as ranged fetches — an SM-tag
+    lookup against an object store costs a few round trips, not the
+    object."""
     import os
 
+    from . import remote
+
+    if remote.is_remote(path):
+        with remote.open_source(path) as src:
+            size = src.length
+            n = min(initial, size)
+            while True:
+                data = src.read(0, n)
+                try:
+                    return BamReader(data).header
+                except Exception:
+                    if n >= size:
+                        raise
+                    n = min(n * 4, size)
     size = os.path.getsize(path)
     n = min(initial, size)
     while True:
@@ -822,8 +846,13 @@ def open_bam(data, lazy: bool = False):
 
 def read_alignment_header(path: str) -> BamHeader:
     """Header of a BAM or CRAM file (magic-dispatched)."""
-    with open(path, "rb") as fh:
-        magic = fh.read(4)
+    from . import remote
+
+    if remote.is_remote(path):
+        magic = remote.read_range(path, 0, 4)
+    else:
+        with open(path, "rb") as fh:
+            magic = fh.read(4)
     if magic == b"CRAM":
         from .cram import CramFile
 
@@ -837,10 +866,13 @@ def open_bam_file(path: str, lazy: bool = True):
     not the file (or its ~4x inflated body). CRAM files route to the
     clean-room CRAM 3.0 decoder (io/cram.py), which presents the same
     read_columns/stream_columns surface."""
-    from . import native
+    from . import native, remote
 
-    with open(path, "rb") as fh:
-        magic = fh.read(4)
+    if remote.is_remote(path):
+        magic = remote.read_range(path, 0, 4)
+    else:
+        with open(path, "rb") as fh:
+            magic = fh.read(4)
     if magic == b"CRAM":
         from .cram import CramFile
 
@@ -851,8 +883,7 @@ def open_bam_file(path: str, lazy: bool = True):
     try:
         if lazy and native.get_lib() is not None:
             return BamFile.from_file(path, lazy=True)
-        with open(path, "rb") as fh:
-            return open_bam(fh.read(), lazy=False)
+        return open_bam(remote.fetch_bytes(path), lazy=False)
     except ValueError as e:
         # clean CLI surface for corrupt/truncated input, mirroring the
         # CRAM branch above

@@ -112,6 +112,10 @@ class BgzfReader:
 
     @classmethod
     def from_file(cls, path: str) -> "BgzfReader":
+        from . import remote
+
+        if remote.is_remote(path):
+            return cls(remote.fetch_bytes(path))
         with open(path, "rb") as fh:
             return cls(fh.read())
 

@@ -100,8 +100,9 @@ def read_crai(path_or_bytes) -> CraiIndex:
     if isinstance(path_or_bytes, (bytes, bytearray)):
         data = bytes(path_or_bytes)
     else:
-        with open(path_or_bytes, "rb") as fh:
-            data = fh.read()
+        from . import remote
+
+        data = remote.fetch_bytes(path_or_bytes)
     if data[:2] == b"\x1f\x8b":
         # typed error surface: corrupt/truncated compressed bytes must
         # come out as the module's ValueError, not raw zlib/EOF errors

@@ -1448,9 +1448,17 @@ class CramFile:
         import mmap
         import os
 
+        from . import remote
+
+        crai = path + ".crai"
+        if remote.is_remote(path):
+            # stage the object once (block-cached ranged fetches);
+            # the .crai sibling resolves through the same data plane
+            data = remote.fetch_bytes(path)
+            return cls(memoryview(data),
+                       crai_path=crai if remote.exists(crai) else None)
         with open(path, "rb") as fh:
             mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
-        crai = path + ".crai"
         return cls(memoryview(mm),
                    crai_path=crai if os.path.exists(crai) else None)
 
@@ -1595,8 +1603,17 @@ def _sam_header_text(data: bytes) -> str:
 
 
 def _load_crai_entries(path: str):
+    import io as _pyio
+
+    from . import remote
+
     entries = []
-    with gzip.open(path, "rt") as fh:
+    if remote.is_remote(path):
+        fh = _pyio.TextIOWrapper(gzip.GzipFile(
+            fileobj=_pyio.BytesIO(remote.fetch_bytes(path))))
+    else:
+        fh = gzip.open(path, "rt")
+    with fh:
         for line in fh:
             t = line.split("\t")
             if len(t) < 6:

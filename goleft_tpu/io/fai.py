@@ -22,9 +22,15 @@ class FaiRecord:
 
 
 def read_fai(path: str) -> list[FaiRecord]:
+    from . import remote
+
     out = []
-    with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
+    if remote.is_remote(path):
+        lines = remote.fetch_bytes(path).decode().splitlines()
+    else:
+        with open(path) as fh:
+            lines = fh.read().splitlines()
+    for lineno, line in enumerate(lines, 1):
             line = line.rstrip("\n")
             if not line:
                 continue
@@ -81,16 +87,23 @@ class Faidx:
     """Random access to FASTA subsequences via the .fai index."""
 
     def __init__(self, fasta_path: str, fai_path: str | None = None):
+        from . import remote
+
         self.path = fasta_path
         if fai_path:
             self.records = {r.name: r for r in read_fai(fai_path)}
+        elif remote.is_remote(fasta_path):
+            # no on-the-fly indexing over the network: the .fai
+            # sibling must exist in the object store
+            self.records = {
+                r.name: r for r in read_fai(fasta_path + ".fai")}
         else:
             try:
                 self.records = {
                     r.name: r for r in read_fai(fasta_path + ".fai")}
             except FileNotFoundError:
                 self.records = {r.name: r for r in write_fai(fasta_path)}
-        self._fh = open(fasta_path, "rb")
+        self._fh = remote.source_io(fasta_path)
 
     def close(self) -> None:
         self._fh.close()

@@ -279,7 +279,16 @@ def file_key(path: str) -> tuple:
     """Cache-key component identifying a file's content cheaply.
 
     Uses ``st_mtime_ns``: truncating to whole seconds aliased a
-    same-second same-size rewrite to a stale cache hit."""
+    same-second same-size rewrite to a stale cache hit. Remote URLs
+    mirror the same 3-tuple shape as ``(url, length, etag-token)``
+    (``io.remote.remote_file_key``) — an object rewrite changes the
+    key exactly like a local mtime bump, so caching, checkpointing,
+    dedup and ring affinity compose unchanged."""
+    if "://" in path:
+        from ..io import remote
+
+        if remote.is_remote(path):
+            return remote.remote_file_key(path)
     st = os.stat(path)
     return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
 

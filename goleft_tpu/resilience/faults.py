@@ -30,6 +30,10 @@ Sites are plain strings; the instrumented ones are
             dispatch (ops/rans_device.py DeviceBlockDecoder under
             --decode-device — a content-keyed plan Step, retried
             under the RetryPolicy like every other dispatch)
+    fetch   the remote data plane's network round trips (io/remote.py
+            — identity probes and ranged reads against an object
+            store, each one a retried plan Step; a transient fault
+            here is a dropped HTTP response, a permanent one a 404)
 
 Example: ``shard:after=3:kill`` SIGKILLs the process at the 3rd shard
 execution — the chaos smoke's mid-flight death; ``bgzf:every=100:p=0``

@@ -42,6 +42,12 @@ from typing import Sequence
 import numpy as np
 
 from .. import obs
+from ..io import remote as _remote
+
+# the data-plane existence check: _exists for paths, an
+# identity probe for http(s)/s3 URLs — what lets every executor accept
+# remote inputs wherever it accepted a path
+_exists = _remote.exists
 
 
 class BadRequest(ValueError):
@@ -107,8 +113,9 @@ def _resolve_fai(req: dict) -> str:
     fai_path = fai or (reference + ".fai" if reference else None)
     if fai_path is None:
         raise BadRequest("need 'reference' (with .fai) or 'fai'")
-    if not os.path.exists(fai_path):
-        if reference and os.path.exists(reference):
+    if not _exists(fai_path):
+        if reference and not _remote.is_remote(reference) \
+                and os.path.exists(reference):
             from ..io.fai import write_fai
 
             write_fai(reference)
@@ -129,7 +136,7 @@ class DepthExecutor:
 
     def validate(self, req: dict) -> None:
         bam = _require(req, "bam")
-        if not os.path.exists(bam):
+        if not _exists(bam):
             raise BadRequest(f"no such file: {bam}")
         if not req.get("bed"):
             _resolve_fai(req)
@@ -183,7 +190,7 @@ class DepthExecutor:
                 bai = None
             else:
                 b = req["bam"]
-                bai = read_bai(b + ".bai" if os.path.exists(b + ".bai")
+                bai = read_bai(b + ".bai" if _exists(b + ".bai")
                                else b[:-4] + ".bai")
             tid_of = {n: i
                       for i, n in enumerate(handle.header.ref_names)}
@@ -240,10 +247,10 @@ class IndexcovExecutor:
 
     def validate(self, req: dict) -> None:
         for p in _require(req, "bams"):
-            if not os.path.exists(p):
+            if not _exists(p):
                 raise BadRequest(f"no such file: {p}")
         fai = _require(req, "fai")  # batching needs one shared ref dict
-        if not os.path.exists(fai):
+        if not _exists(fai):
             raise BadRequest(f"no such file: {fai}")
 
     def group_key(self, req: dict) -> tuple:
@@ -273,9 +280,9 @@ class IndexcovExecutor:
         # reads) alongside the named path, so a rebuilt .bai/.crai
         # changes the key even when the bam itself did not move
         def _input_keys(p):
-            keys = [file_key(p)] if os.path.exists(p) else [p]
+            keys = [file_key(p)] if _exists(p) else [p]
             for ext in (".bai", ".crai"):
-                if os.path.exists(p + ext):
+                if _exists(p + ext):
                     keys.append(file_key(p + ext))
             return tuple(keys)
 
@@ -349,10 +356,10 @@ class PairhmmExecutor:
 
     def validate(self, req: dict) -> None:
         path = _require(req, "input")
-        if not os.path.exists(path):
+        if not _exists(path):
             raise BadRequest(f"no such file: {path}")
         cand = req.get("candidates")
-        if cand and not os.path.exists(cand):
+        if cand and not _exists(cand):
             raise BadRequest(f"no such file: {cand}")
         # parse up front: a malformed document is this request's 400,
         # never a 500 poisoning everyone who shared its batch
@@ -451,7 +458,7 @@ class CohortdepthExecutor:
                 "checkpoint: true needs the daemon started with "
                 "--checkpoint-root")
         for p in _require(req, "bams"):
-            if not os.path.exists(p):
+            if not _exists(p):
                 raise BadRequest(f"no such file: {p}")
         _resolve_fai(req)
 

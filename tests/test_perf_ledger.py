@@ -543,3 +543,40 @@ def test_fleet_failover_recovery_entry_ingests(tmp_path):
     assert len(back) == 1
     assert back[0]["metrics"]["failover_seconds"] \
         == pytest.approx(0.207)
+
+
+def test_remote_fetch_entry_ingests(tmp_path):
+    """The object-store data plane bench entry (remote_fetch: local
+    vs stub-remote staging MB/s + read-ahead overlap efficiency)
+    lands in the ledger as host evidence with the throughput leaves
+    gated higher-is-better."""
+    entry = {
+        "size_mb": 32,
+        "local_mb_per_s": 1100.4, "remote_mb_per_s": 160.2,
+        "readahead_mb_per_s": 300.7, "no_readahead_mb_per_s": 120.9,
+        "overlap_efficiency": 2.49,
+        "platform": "cpu",
+        "note": "loopback stub object store",
+    }
+    recs = ledger.live_run_records({"remote_fetch": entry}, None)
+    rec = {r["entry"]: r for r in recs}["remote_fetch"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("local_mb_per_s", "remote_mb_per_s",
+                "readahead_mb_per_s", "overlap_efficiency"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["overlap_efficiency"] == pytest.approx(2.49)
+    # staging throughput and the overlap ratio gate higher-is-better
+    from goleft_tpu.obs.sentinel import metric_direction
+
+    assert metric_direction("remote_fetch",
+                            "remote_mb_per_s") == "higher"
+    assert metric_direction("remote_fetch",
+                            "overlap_efficiency") == "higher"
+    # round-trips through the on-disk ledger (what perf check reads)
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "remote_fetch"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["remote_mb_per_s"] \
+        == pytest.approx(160.2)
