@@ -127,7 +127,10 @@ def main(argv=None) -> int:
                         "(anti-entropy over /fleet/cache) every this "
                         "many seconds, plus immediately on half-open "
                         "rejoin (0 disables the timer; rejoin "
-                        "warm-up still runs)")
+                        "warm-up still runs); pushes are HMAC-signed "
+                        "with GOLEFT_TPU_FLEET_SECRET, which must be "
+                        "set identically here and on every fleet or "
+                        "replication stays disabled")
     p.add_argument("--tenant-burn-threshold", type=float,
                    default=0.0,
                    help="shed a tenant's best-effort traffic "

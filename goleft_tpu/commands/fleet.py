@@ -233,7 +233,9 @@ def main(argv=None) -> int:
                           "shared by ALL spawned workers (passes "
                           "--cache DIR --cache-shared through): "
                           "restarts and ring resizes replay instead "
-                          "of recompute")
+                          "of recompute; also advertised at "
+                          "/fleet/cache for cross-fleet replication "
+                          "(pushes require GOLEFT_TPU_FLEET_SECRET)")
     sup.add_argument("--quarantine-manifest", default=None,
                      metavar="PATH",
                      help="write the slot-quarantine JSON manifest "

@@ -9,23 +9,34 @@ every entry any fleet holds is pushed to every fleet missing it. When
 the home fleet dies, the survivor already holds its content-keyed
 results — failover is cache replay, not recompute (the dataplane
 smoke pins ``serve_device_passes_total == 0`` on the survivor). A
-half-open rejoin triggers an immediate round (the federation wires
-``FleetPool.on_rejoin`` to :meth:`CacheSync.sync_now`), so a healed
-fleet is re-warmed before its first probe request lands.
+half-open rejoin kicks off an immediate round on a background thread
+(the federation wires ``FleetPool.on_rejoin`` to
+:meth:`CacheSync.sync_soon` — the hook fires inside a live request's
+``settle_forward``, so the warm-up must never run inline), re-warming
+a healed fleet while it serves.
 
-Safety argument (why blind replication cannot corrupt results):
+Safety argument (why replication cannot corrupt results):
 
-  - entries are **content-keyed**: a ResultCache filename is
+  - pushes are **authenticated**: cache entries are pickles, so an
+    unauthenticated PUT endpoint would hand code execution to anyone
+    who can reach the router port. Every push carries an HMAC-SHA256
+    over ``name NUL data`` keyed by the shared fleet secret
+    (``GOLEFT_TPU_FLEET_SECRET``); a router without the secret
+    refuses pushes outright — replication is strictly opt-in;
+  - existing entries are **never overwritten**: names are
+    content-keyed (a ResultCache filename is
     ``sha256(repr(key))[:32] + ".pkl"`` where the key pins every
-    input's content identity (``file_key``/``remote_file_key``) plus
-    the canonical parameters — two fleets computing the same name
-    computed the same bytes, so replication can only ever *copy* a
-    result, never alias two different ones;
+    input's content identity, ``file_key``/``remote_file_key``, plus
+    the canonical parameters), so "same name" means "same bytes" and
+    a replayed or duplicate push is an idempotent no-op;
   - writes are **atomic** (tmp + ``os.replace`` on the receiving
     router), so readers never observe a torn entry;
   - the name alphabet (32 hex chars + ``.pkl``) is validated on both
     ends — no traversal, and nothing that is not a ResultCache entry
-    replicates.
+    replicates;
+  - entries above :data:`MAX_ENTRY_BYTES` are refused server-side
+    (413 before the body is read), so a misbehaving peer cannot
+    exhaust the jax-free router's memory.
 
 Replication is best-effort by design: a failed pull/push is counted
 (``cachesync.errors_total``) and retried on the next round; the cache
@@ -34,6 +45,8 @@ is an optimization tier and correctness never depends on it.
 
 from __future__ import annotations
 
+import hmac as _hmac
+import os
 import threading
 import urllib.error
 import urllib.request
@@ -41,6 +54,23 @@ import urllib.request
 from ..obs.logging import get_logger
 
 log = get_logger("fleet.cachesync")
+
+#: header carrying the push's HMAC (hex) — see :func:`entry_hmac`
+CACHE_AUTH_HEADER = "X-Goleft-Cache-Auth"
+
+
+def fleet_secret() -> str | None:
+    """The shared fleet secret (``GOLEFT_TPU_FLEET_SECRET``), or None
+    when replication is disabled."""
+    return os.environ.get("GOLEFT_TPU_FLEET_SECRET") or None
+
+
+def entry_hmac(secret: str, name: str) -> "_hmac.HMAC":
+    """A fresh HMAC-SHA256 over ``name NUL data`` keyed by the fleet
+    secret; callers ``update()`` with the entry bytes (streamed or
+    whole) and compare hexdigests with ``compare_digest``."""
+    return _hmac.new(secret.encode(), name.encode() + b"\x00",
+                     "sha256")
 
 #: don't replicate entries bigger than this (a runaway pickle should
 #: not saturate the control plane); env-free constant — the cap is a
@@ -59,15 +89,18 @@ class CacheSync:
     """
 
     def __init__(self, fleet_urls, interval_s: float = 5.0,
-                 registry=None, timeout_s: float = 30.0):
+                 registry=None, timeout_s: float = 30.0,
+                 secret: str | None = None):
         self.fleet_urls = fleet_urls
         self.interval_s = interval_s
         self.timeout_s = timeout_s
+        self.secret = secret if secret is not None else fleet_secret()
         self._registry = registry
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        self._warned_no_secret = False
 
     # ---- registry plumbing (works with or without metrics) ----
 
@@ -83,8 +116,12 @@ class CacheSync:
                                     timeout=self.timeout_s) as r:
             return r.read()
 
-    def _put(self, url: str, data: bytes) -> None:
-        req = urllib.request.Request(url, data=data, method="PUT")
+    def _put(self, url: str, name: str, data: bytes) -> None:
+        mac = entry_hmac(self.secret, name)
+        mac.update(data)
+        req = urllib.request.Request(
+            url, data=data, method="PUT",
+            headers={CACHE_AUTH_HEADER: mac.hexdigest()})
         with urllib.request.urlopen(req,
                                     timeout=self.timeout_s) as r:
             r.read()
@@ -106,10 +143,29 @@ class CacheSync:
 
     def sync_now(self, reason: str = "interval") -> dict:
         """One anti-entropy round; returns a summary dict (the tests'
-        and the rejoin hook's observable). Serialized under a lock —
-        a rejoin-triggered round never interleaves with the timer's."""
+        observable). Serialized under a lock — a rejoin-triggered
+        round never interleaves with the timer's."""
         with self._lock:
             return self._sync_locked(reason)
+
+    def sync_soon(self, reason: str = "rejoin") -> threading.Thread:
+        """Run one round on a background daemon thread and return it
+        (tests join it). This is what event hooks wire up — a full
+        round lists/pulls/pushes every entry across every fleet under
+        per-call network timeouts, so running it synchronously from
+        ``FleetPool.settle_forward`` would stall the live client
+        request that triggered the rejoin."""
+        def _run():
+            try:
+                self.sync_now(reason)
+            except Exception as e:  # noqa: BLE001 — hook must not raise
+                log.warning("cachesync %s round failed: %s", reason, e)
+                self._inc("cachesync.errors_total")
+
+        t = threading.Thread(target=_run, name="cachesync-" + reason,
+                             daemon=True)
+        t.start()
+        return t
 
     def _sync_locked(self, reason: str) -> dict:
         fleets = [u.rstrip("/") for u in self.fleet_urls()]
@@ -118,6 +174,17 @@ class CacheSync:
         self._inc("cachesync.rounds_total")
         if reason == "rejoin":
             self._inc("cachesync.rejoin_syncs_total")
+        if self.secret is None:
+            # pushes would be refused (403) without the shared
+            # secret — don't burn pulls on rounds that cannot land
+            if not self._warned_no_secret:
+                self._warned_no_secret = True
+                log.warning(
+                    "cachesync: no fleet secret configured (set "
+                    "GOLEFT_TPU_FLEET_SECRET on every fleet and the "
+                    "federation) — cache replication is disabled")
+            summary["disabled"] = True
+            return summary
         if len(fleets) < 2:
             return summary
         have: dict = {}
@@ -151,7 +218,7 @@ class CacheSync:
                 continue
             for m in missing:
                 try:
-                    self._put(m + "/fleet/cache/" + name, data)
+                    self._put(m + "/fleet/cache/" + name, name, data)
                     self._inc("cachesync.entries_replicated_total")
                     self._inc("cachesync.bytes_replicated_total",
                               len(data))

@@ -405,8 +405,10 @@ class FleetPool:
                 self.registry.counter(
                     "federation.fleet_rejoin_total").inc()
         if rejoined and self.on_rejoin is not None:
-            # outside the lock: the hook does network I/O (cache
-            # replication warm-up) and must never block polling
+            # outside the lock — and the hook itself must return
+            # promptly: settle_forward runs on live request threads
+            # as well as the poller, so a warm-up that does network
+            # I/O has to happen on its own thread (sync_soon)
             try:
                 self.on_rejoin(f.url)
             except Exception as e:  # noqa: BLE001 — hook is best-effort
@@ -504,7 +506,11 @@ class FederationRouter:
 
         self.quotas = QuotaTable(quotas)
         # cross-fleet cache replication (anti-entropy rounds over the
-        # UP fleets + an immediate warm-up on half-open rejoin)
+        # UP fleets + an immediate warm-up on half-open rejoin).
+        # sync_soon, not sync_now: the hook fires from settle_forward
+        # on a live request thread — an inline round (every
+        # list/pull/push under its network timeout) would block that
+        # client for the round's whole duration
         from .cachesync import CacheSync
 
         self.cache_sync = CacheSync(
@@ -512,7 +518,7 @@ class FederationRouter:
             interval_s=cache_sync_interval_s,
             registry=self.registry)
         self.pool.on_rejoin = \
-            lambda url: self.cache_sync.sync_now("rejoin")
+            lambda url: self.cache_sync.sync_soon("rejoin")
         self.tenant_burn_threshold = tenant_burn_threshold
         self.tenant_shed_min_requests = tenant_shed_min_requests
         self.error_budget = error_budget

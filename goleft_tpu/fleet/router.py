@@ -78,16 +78,20 @@ def _file_key(path: str) -> tuple:
     duplicated here because importing it drags the whole
     ``goleft_tpu.parallel`` package — and jax — into the router
     process, whose entire point is staying a cheap jax-free
-    forwarder. Remote URLs route through ``io.remote.remote_file_key``
-    (jax-free, parity-pinned): the SAME (url, length, etag) identity
-    in both mirrors keeps fleet and worker affinity aligned."""
+    forwarder. Remote URLs route through
+    ``io.remote.routing_file_key`` (jax-free, parity-pinned — on
+    success it returns the SAME (url, length, etag) identity as
+    ``remote_file_key``, keeping fleet and worker affinity aligned),
+    whose probe gets ONE attempt under a tight routing timeout with
+    failures negative-cached: a slow object store must not stall the
+    request path for the full fetch retry budget."""
     import os
 
     if "://" in path:
         from ..io import remote
 
         if remote.is_remote(path):
-            return remote.remote_file_key(path)
+            return remote.routing_file_key(path)
     st = os.stat(path)
     return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
 
@@ -524,7 +528,8 @@ class RouterApp:
                  registry: MetricsRegistry | None = None,
                  error_budget: float = 0.01,
                  flight_records: int = 64,
-                 cache_dir: str | None = None):
+                 cache_dir: str | None = None,
+                 cache_secret: str | None = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.ring = HashRing(worker_urls, vnodes=vnodes)
@@ -553,8 +558,14 @@ class RouterApp:
         self._tracer.add_listener(self.flight.on_span)
         # the fleet's shared result-cache directory, advertised at
         # GET/PUT /fleet/cache/* for cross-fleet replication (the
-        # federation's CacheSync pulls/pushes content-keyed entries)
+        # federation's CacheSync pulls/pushes content-keyed entries).
+        # Entries are pickles, so PUT requires an HMAC keyed by the
+        # shared fleet secret — without one, pushes are refused
+        from .cachesync import fleet_secret
+
         self.cache_dir = cache_dir
+        self.cache_secret = cache_secret if cache_secret is not None \
+            else fleet_secret()
 
     # ---- the cache replication endpoint (fleet/cachesync.py) ----
 
@@ -590,36 +601,114 @@ class RouterApp:
         entries.sort(key=lambda e: e["name"])
         return 200, {"entries": entries}
 
-    def cache_get(self, name: str):
-        """(code, bytes-or-error-dict) for one entry's raw bytes."""
-        if not self.cache_dir:
-            return 404, {"error": "no shared cache on this fleet"}
-        if not self._cache_name_ok(name):
-            return 400, {"error": f"bad cache entry name {name!r}"}
-        try:
-            with open(os.path.join(self.cache_dir, name), "rb") as fh:
-                data = fh.read()
-        except FileNotFoundError:
-            return 404, {"error": f"no cache entry {name}"}
-        except OSError as e:
-            return 503, {"error": f"cache read failed: {e}"}
-        self.registry.counter("fleet.cache_served_total").inc()
-        return 200, data
+    def cache_open(self, name: str):
+        """(code, file-handle-or-error-dict, size) for one entry —
+        the streaming form the HTTP handler uses (the whole entry is
+        never buffered in router memory). Entries above the
+        replication size cap are refused: nothing that big should
+        have replicated in."""
+        from .cachesync import MAX_ENTRY_BYTES
 
-    def cache_put(self, name: str, data: bytes) -> tuple[int, dict]:
-        """Store one replicated entry (tmp + atomic rename: a reader
-        never sees a torn entry, and concurrent pushes of the same
-        content-keyed name converge on identical bytes)."""
+        if not self.cache_dir:
+            return 404, {"error": "no shared cache on this fleet"}, 0
+        if not self._cache_name_ok(name):
+            return 400, {"error": f"bad cache entry name {name!r}"}, 0
+        path = os.path.join(self.cache_dir, name)
+        try:
+            size = os.stat(path).st_size
+            if size > MAX_ENTRY_BYTES:
+                return 413, {"error": f"cache entry {name} exceeds "
+                                      f"{MAX_ENTRY_BYTES} bytes"}, 0
+            fh = open(path, "rb")
+        except FileNotFoundError:
+            return 404, {"error": f"no cache entry {name}"}, 0
+        except OSError as e:
+            return 503, {"error": f"cache read failed: {e}"}, 0
+        self.registry.counter("fleet.cache_served_total").inc()
+        return 200, fh, size
+
+    def cache_get(self, name: str):
+        """(code, bytes-or-error-dict) for one entry's raw bytes —
+        the in-process convenience over :meth:`cache_open`."""
+        code, body, _size = self.cache_open(name)
+        if code != 200:
+            return code, body
+        with body:
+            return 200, body.read()
+
+    def cache_put(self, name: str, body, length: int | None = None,
+                  auth: str | None = None) -> tuple[int, dict]:
+        """Store one replicated entry. ``body`` is bytes or a
+        file-like reader (``length`` required for a reader — the HTTP
+        handler streams the request body straight to the tmp file in
+        chunks). The write is tmp + atomic rename, so a reader never
+        sees a torn entry.
+
+        Entries are pickles, so this endpoint is the fleet's code-
+        execution boundary and every push must authenticate: ``auth``
+        carries an HMAC-SHA256 over ``name NUL data`` keyed by the
+        shared fleet secret. No secret configured ⇒ replication is
+        disabled (403). An entry that already exists is NEVER
+        overwritten — names are content-keyed, so the push is an
+        idempotent no-op (204) — meaning even a leaked signature
+        cannot replace an existing result."""
+        from .cachesync import (
+            CACHE_AUTH_HEADER, MAX_ENTRY_BYTES, entry_hmac,
+        )
+
+        reject = self.registry.counter("fleet.cache_put_rejected_total")
         if not self.cache_dir:
             return 404, {"error": "no shared cache on this fleet"}
         if not self._cache_name_ok(name):
+            reject.inc()
             return 400, {"error": f"bad cache entry name {name!r}"}
+        if isinstance(body, (bytes, bytearray)):
+            length = len(body)
+        elif length is None:
+            reject.inc()
+            return 400, {"error": "length required for streamed put"}
+        if length > MAX_ENTRY_BYTES:
+            reject.inc()
+            return 413, {"error": f"cache entry {name} exceeds "
+                                  f"{MAX_ENTRY_BYTES} bytes"}
+        if not self.cache_secret:
+            reject.inc()
+            return 403, {"error":
+                         "cache replication disabled: no fleet secret "
+                         "(set GOLEFT_TPU_FLEET_SECRET)"}
+        if auth is None:
+            reject.inc()
+            return 401, {"error": f"missing {CACHE_AUTH_HEADER}"}
         dest = os.path.join(self.cache_dir, name)
+        if os.path.exists(dest):
+            # content-keyed: same name ⇒ same bytes — idempotent no-op
+            return 204, {}
+        mac = entry_hmac(self.cache_secret, name)
         tmp = dest + f".push.{os.getpid()}.tmp"
         try:
             os.makedirs(self.cache_dir, exist_ok=True)
             with open(tmp, "wb") as fh:
-                fh.write(data)
+                if isinstance(body, (bytes, bytearray)):
+                    mac.update(body)
+                    fh.write(body)
+                else:
+                    remaining = length
+                    while remaining > 0:
+                        chunk = body.read(min(remaining, 1 << 20))
+                        if not chunk:
+                            raise OSError(
+                                f"truncated push body for {name}: "
+                                f"{remaining} bytes short")
+                        mac.update(chunk)
+                        fh.write(chunk)
+                        remaining -= len(chunk)
+            import hmac as _hmac_mod
+
+            if not _hmac_mod.compare_digest(mac.hexdigest(),
+                                            auth.strip().lower()):
+                os.unlink(tmp)
+                reject.inc()
+                return 403, {"error": "bad cache entry signature"}
             os.replace(tmp, dest)
         except OSError as e:
             try:
@@ -1032,15 +1121,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._respond_json(code, body)
         elif u.path.startswith("/fleet/cache/"):
             name = unquote(u.path[len("/fleet/cache/"):])
-            code, body = self.app.cache_get(name)
-            if isinstance(body, bytes):
+            code, body, size = self.app.cache_open(name)
+            if code == 200:
+                # stream the entry file in chunks — the router never
+                # holds a whole (up to MAX_ENTRY_BYTES) entry in memory
                 self.send_response(code)
                 self.send_header("Content-Type",
                                  "application/octet-stream")
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Length", str(size))
                 self.send_header("Connection", "close")
                 self.end_headers()
-                self.wfile.write(body)
+                with body:
+                    while True:
+                        chunk = body.read(1 << 20)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
                 self.close_connection = True
             else:
                 self._respond_json(code, body)
@@ -1053,15 +1149,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def do_PUT(self):  # noqa: N802 — http.server contract
         from urllib.parse import unquote, urlparse
 
+        from .cachesync import CACHE_AUTH_HEADER, MAX_ENTRY_BYTES
+
         u = urlparse(self.path)
         if not u.path.startswith("/fleet/cache/"):
             self._respond_json(404,
                                {"error": f"no route {self.path}"})
             return
-        n = int(self.headers.get("Content-Length", "0"))
-        data = self.rfile.read(n)
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            self._respond_json(400, {"error": "bad Content-Length"})
+            self.close_connection = True
+            return
+        if n > MAX_ENTRY_BYTES:
+            # refuse BEFORE reading: an oversized push must not
+            # buffer (or even transit) on the jax-free router
+            self._respond_json(
+                413, {"error": f"entry exceeds {MAX_ENTRY_BYTES} "
+                               "bytes"})
+            self.close_connection = True
+            return
         name = unquote(u.path[len("/fleet/cache/"):])
-        code, body = self.app.cache_put(name, data)
+        code, body = self.app.cache_put(
+            name, self.rfile, length=n,
+            auth=self.headers.get(CACHE_AUTH_HEADER))
         if code == 204:
             self.send_response(204)
             self.send_header("Content-Length", "0")

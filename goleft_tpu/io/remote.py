@@ -61,7 +61,7 @@ __all__ = [
     "ByteSource", "HttpByteSource", "LocalByteSource",
     "StaleRemoteInput", "exists", "fetch_bytes", "invalidate_identity",
     "is_remote", "open_source", "read_range", "remote_file_key",
-    "resolve_url", "source_io",
+    "resolve_url", "routing_file_key", "source_io",
 ]
 
 #: schemes the data plane accepts (s3:// is endpoint-mapped onto http)
@@ -99,6 +99,18 @@ def _cache_blocks() -> int:
 
 def _timeout_s() -> float:
     return _env_float("GOLEFT_TPU_FETCH_TIMEOUT_S", 30.0)
+
+
+def _routing_timeout_s() -> float:
+    """Budget for identity probes made from a request-routing path
+    (the fleet router's affinity computation): a slow object store
+    must never stall routing for the full fetch retry budget."""
+    return _env_float("GOLEFT_TPU_FETCH_ROUTING_TIMEOUT_S", 1.0)
+
+
+def _identity_cap() -> int:
+    """Max identities kept in the TTL cache (LRU beyond this)."""
+    return max(16, _env_int("GOLEFT_TPU_FETCH_IDENTITY_CACHE", 4096))
 
 
 def _fetch_policy() -> RetryPolicy:
@@ -167,16 +179,22 @@ class _ConnectionPool:
     def _limit(self) -> int:
         return max(1, _env_int("GOLEFT_TPU_FETCH_POOL", 4))
 
-    def acquire(self, scheme: str, host: str, port: int):
+    def acquire(self, scheme: str, host: str, port: int,
+                timeout_s: float | None = None):
+        t = timeout_s if timeout_s is not None else _timeout_s()
         with self._lock:
             idle = self._idle.get((scheme, host, port))
             if idle:
-                return idle.pop()
+                conn = idle.pop()
+                # normalize the deadline every acquire: a pooled
+                # connection may carry the previous caller's budget
+                conn.timeout = t
+                if getattr(conn, "sock", None) is not None:
+                    conn.sock.settimeout(t)
+                return conn
         if scheme == "https":
-            return http.client.HTTPSConnection(
-                host, port, timeout=_timeout_s())
-        return http.client.HTTPConnection(
-            host, port, timeout=_timeout_s())
+            return http.client.HTTPSConnection(host, port, timeout=t)
+        return http.client.HTTPConnection(host, port, timeout=t)
 
     def release(self, scheme: str, host: str, port: int, conn) -> None:
         with self._lock:
@@ -207,6 +225,14 @@ _POOL = _ConnectionPool()
 #: Step at the ``fetch`` site, so retry/backoff/fault-injection
 #: compose exactly like shard/device/decode dispatches do
 _EXECUTOR = Executor(policy=_fetch_policy())
+
+#: the routing-probe executor: identity probes issued from a
+#: request-routing path get ONE attempt under a tight deadline —
+#: routing degrades to the raw URL on failure, so burning the full
+#: fetch retry budget there only stalls live requests
+_PROBE_EXECUTOR = Executor(policy=RetryPolicy(
+    retries=0, base_delay_s=0.01, max_delay_s=0.1,
+    deadline_s=_routing_timeout_s()))
 
 _MAX_REDIRECTS = 4
 
@@ -239,7 +265,8 @@ def _status_error(url: str, status: int, reason: str) -> Exception:
     return OSError(f"HTTP {status} {reason} for {url}")
 
 
-def _http_roundtrip(url: str, method: str, headers: dict):
+def _http_roundtrip(url: str, method: str, headers: dict,
+                    timeout_s: float | None = None):
     """One HTTP request/response against the resolved URL, following
     a bounded number of redirects. Returns ``(status, headers, body)``
     for terminal 2xx; raises the mapped error otherwise. Never
@@ -254,7 +281,7 @@ def _http_roundtrip(url: str, method: str, headers: dict):
         path = parts.path or "/"
         if parts.query:
             path += "?" + parts.query
-        conn = _POOL.acquire(scheme, host, port)
+        conn = _POOL.acquire(scheme, host, port, timeout_s=timeout_s)
         try:
             conn.request(method, path, headers=headers)
             resp = conn.getresponse()
@@ -293,7 +320,13 @@ def _fetch_step(url: str, key: tuple, fn, what: str):
 
 _IDENTITY_TTL_DEFAULT = 5.0
 _identity_lock = threading.Lock()
-_identity_cache: dict = {}
+#: url -> (monotonic, (length, token)); insertion-ordered (oldest
+#: first), bounded by ``_identity_cap()`` — long-lived processes
+#: touching many distinct URLs must not grow it without limit
+_identity_cache: collections.OrderedDict = collections.OrderedDict()
+#: url -> monotonic of the last FAILED routing probe: a dead endpoint
+#: costs routing one short probe per TTL, not one per request
+_identity_neg: collections.OrderedDict = collections.OrderedDict()
 
 
 def _identity_ttl() -> float:
@@ -301,31 +334,70 @@ def _identity_ttl() -> float:
                       _IDENTITY_TTL_DEFAULT)
 
 
+def _cache_insert(cache: collections.OrderedDict, url: str,
+                  value) -> None:
+    """Insert under ``_identity_lock``: newest at the back, expired
+    swept from the front (insertion order IS staleness order), LRU
+    beyond the cap."""
+    ttl = _identity_ttl()
+    now = time.monotonic()
+    cache[url] = value
+    cache.move_to_end(url)
+    while cache:
+        ts = next(iter(cache.values()))
+        ts = ts[0] if isinstance(ts, tuple) else ts
+        if now - ts <= ttl:
+            break
+        cache.popitem(last=False)
+    cap = _identity_cap()
+    while len(cache) > cap:
+        cache.popitem(last=False)
+
+
 def invalidate_identity(url: str | None = None) -> None:
-    """Drop cached identities (one URL, or all). Tests use this to
-    observe server-side mutation without waiting out the TTL."""
+    """Drop cached identities — positive and negative — for one URL,
+    or all. Tests use this to observe server-side mutation without
+    waiting out the TTL."""
     with _identity_lock:
         if url is None:
             _identity_cache.clear()
+            _identity_neg.clear()
         else:
             _identity_cache.pop(url, None)
+            _identity_neg.pop(url, None)
 
 
-def _probe_identity(url: str) -> tuple:
+def _probe_identity(url: str, routing: bool = False) -> tuple:
     """HEAD the object: ``(length, token)``. Raises the mapped error
     (404 → FileNotFoundError) — callers wanting existence semantics
-    catch it."""
+    catch it.
+
+    ``routing=True`` is the request-routing variant: one attempt
+    under ``_routing_timeout_s()`` instead of the full fetch retry
+    budget, and failures are negative-cached for the identity TTL so
+    an unreachable store stalls at most one request per TTL (the
+    affinity computation falls back to the raw URL either way)."""
     now = time.monotonic()
     with _identity_lock:
         hit = _identity_cache.get(url)
         if hit is not None and now - hit[0] <= _identity_ttl():
             return hit[1]
+        if routing:
+            neg = _identity_neg.get(url)
+            if neg is not None and now - neg <= _identity_ttl():
+                get_registry().counter(
+                    "fetch.identity_neg_hits_total").inc()
+                raise OSError(
+                    f"identity probe for {url} failed recently "
+                    "(negative-cached)")
     resolved = resolve_url(url)
 
     def head():
         reg = get_registry()
         reg.counter("fetch.identity_probes_total").inc()
-        status, headers, _body = _http_roundtrip(resolved, "HEAD", {})
+        status, headers, _body = _http_roundtrip(
+            resolved, "HEAD", {},
+            timeout_s=_routing_timeout_s() if routing else None)
         try:
             length = int(headers.get("Content-Length", "-1"))
         except ValueError:
@@ -336,10 +408,21 @@ def _probe_identity(url: str) -> tuple:
                 f"(status {status})")
         return (length, _identity_token(headers))
 
-    ident = _fetch_step(url, ("fetch", "identity", url), head,
-                        "identity")
+    executor = _PROBE_EXECUTOR if routing else _EXECUTOR
+    try:
+        ident = executor.run(Step(
+            key=("fetch", "identity", url), fn=head, site="fetch",
+            retry=True, span="fetch.range",
+            attrs={"url": url, "what": "identity"}))
+    except Exception:
+        if routing:
+            with _identity_lock:
+                _cache_insert(_identity_neg, url, time.monotonic())
+        raise
     with _identity_lock:
-        _identity_cache[url] = (time.monotonic(), ident)
+        _cache_insert(_identity_cache, url,
+                      (time.monotonic(), ident))
+        _identity_neg.pop(url, None)
     return ident
 
 
@@ -349,6 +432,18 @@ def remote_file_key(url: str) -> tuple:
     same property (an object rewrite changes the key), so caching,
     checkpointing, dedup and ring affinity compose unchanged."""
     length, token = _probe_identity(url)
+    return (url, length, token)
+
+
+def routing_file_key(url: str) -> tuple:
+    """``remote_file_key`` for request-routing paths (the fleet
+    router's affinity computation): the SAME identity tuple on
+    success — parity with ``remote_file_key`` holds — but the probe
+    gets one attempt under ``GOLEFT_TPU_FETCH_ROUTING_TIMEOUT_S``
+    and failures are negative-cached for the identity TTL, so a slow
+    or dead object store cannot stall live request routing for the
+    full fetch retry budget on every request."""
+    length, token = _probe_identity(url, routing=True)
     return (url, length, token)
 
 

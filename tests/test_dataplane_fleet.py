@@ -16,7 +16,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
-from goleft_tpu.fleet.cachesync import CacheSync
+from goleft_tpu.fleet.cachesync import (
+    CACHE_AUTH_HEADER, CacheSync, entry_hmac,
+)
 from goleft_tpu.fleet.federation import (
     DOWN, PROBE, UP, FederationRouter, FleetPool,
 )
@@ -25,10 +27,18 @@ from goleft_tpu.obs.metrics import MetricsRegistry
 
 GOOD = "0" * 32 + ".pkl"
 GOOD2 = "ab" * 16 + ".pkl"
+SECRET = "test-fleet-secret"
+
+
+def _sign(name: str, data: bytes, secret: str = SECRET) -> str:
+    mac = entry_hmac(secret, name)
+    mac.update(data)
+    return mac.hexdigest()
 
 
 def _app(tmp_path, name="cache", **kw):
     kw.setdefault("poll_interval_s", 30.0)
+    kw.setdefault("cache_secret", SECRET)
     cache = tmp_path / name
     cache.mkdir(exist_ok=True)
     return RouterApp(["http://127.0.0.1:1"], cache_dir=str(cache),
@@ -53,17 +63,19 @@ def test_cache_name_validation():
 
 def test_cache_endpoints_without_cache_dir(tmp_path):
     app = RouterApp(["http://127.0.0.1:1"],
-                    registry=MetricsRegistry())
+                    registry=MetricsRegistry(), cache_secret=SECRET)
     assert app.cache_list()[0] == 404
     assert app.cache_get(GOOD)[0] == 404
-    assert app.cache_put(GOOD, b"x")[0] == 404
+    assert app.cache_put(GOOD, b"x",
+                         auth=_sign(GOOD, b"x"))[0] == 404
 
 
 def test_cache_endpoints_contract(tmp_path):
     app, cache = _app(tmp_path)
     code, body = app.cache_list()
     assert (code, body) == (200, {"entries": []})
-    code, body = app.cache_put(GOOD, b"payload")
+    code, body = app.cache_put(GOOD, b"payload",
+                               auth=_sign(GOOD, b"payload"))
     assert code == 204
     assert (cache / GOOD).read_bytes() == b"payload"
     code, body = app.cache_list()
@@ -73,7 +85,8 @@ def test_cache_endpoints_contract(tmp_path):
     assert (code, data) == (200, b"payload")
     assert app.cache_get(GOOD2)[0] == 404       # absent entry
     assert app.cache_get("../etc/passwd")[0] == 400
-    assert app.cache_put("../" + GOOD, b"x")[0] == 400
+    assert app.cache_put("../" + GOOD, b"x",
+                         auth=_sign("../" + GOOD, b"x"))[0] == 400
     # non-conforming names in the dir never appear in listings
     (cache / "stray.txt").write_bytes(b"x")
     assert app.cache_list()[1]["entries"] == \
@@ -83,11 +96,66 @@ def test_cache_endpoints_contract(tmp_path):
     assert reg.counter("fleet.cache_stored_total").value == 1
 
 
+def test_cache_put_requires_valid_hmac(tmp_path):
+    """The push endpoint is the fleet's code-execution boundary
+    (entries are pickles): unsigned and mis-signed pushes are
+    refused, and nothing lands on disk."""
+    app, cache = _app(tmp_path)
+    assert app.cache_put(GOOD, b"evil")[0] == 401          # unsigned
+    assert app.cache_put(GOOD, b"evil",
+                         auth="0" * 64)[0] == 403          # bad sig
+    # signed with the WRONG secret
+    bad = _sign(GOOD, b"evil", secret="not-the-secret")
+    assert app.cache_put(GOOD, b"evil", auth=bad)[0] == 403
+    # signature over DIFFERENT bytes than the body
+    assert app.cache_put(GOOD, b"evil",
+                         auth=_sign(GOOD, b"other"))[0] == 403
+    assert list(cache.iterdir()) == []                     # no writes
+    assert app.registry.counter(
+        "fleet.cache_put_rejected_total").value == 4
+
+
+def test_cache_put_refused_without_secret(tmp_path):
+    """No shared fleet secret configured ⇒ replication is disabled:
+    every push is refused, signed or not."""
+    app, _cache = _app(tmp_path, cache_secret="")
+    code, body = app.cache_put(GOOD, b"x", auth=_sign(GOOD, b"x"))
+    assert code == 403
+    assert "disabled" in body["error"]
+
+
+def test_cache_put_never_overwrites(tmp_path):
+    """An existing entry is never replaced — names are content-keyed,
+    so a duplicate push is an idempotent no-op (even a correctly
+    signed push cannot swap the bytes under a name)."""
+    app, cache = _app(tmp_path)
+    assert app.cache_put(GOOD, b"original",
+                         auth=_sign(GOOD, b"original"))[0] == 204
+    assert app.cache_put(GOOD, b"replacement",
+                         auth=_sign(GOOD, b"replacement"))[0] == 204
+    assert (cache / GOOD).read_bytes() == b"original"
+
+
+def test_cache_put_size_cap(tmp_path, monkeypatch):
+    import goleft_tpu.fleet.cachesync as cachesync
+
+    monkeypatch.setattr(cachesync, "MAX_ENTRY_BYTES", 8)
+    app, cache = _app(tmp_path)
+    big = b"x" * 9
+    assert app.cache_put(GOOD, big,
+                         auth=_sign(GOOD, big))[0] == 413
+    assert list(cache.iterdir()) == []
+    ok = b"x" * 8
+    assert app.cache_put(GOOD, ok, auth=_sign(GOOD, ok))[0] == 204
+
+
 def test_cache_endpoints_over_http(tmp_path):
     app, cache = _app(tmp_path)
     with RouterThread(app) as url:
-        req = urllib.request.Request(url + "/fleet/cache/" + GOOD,
-                                     data=b"bytes!", method="PUT")
+        req = urllib.request.Request(
+            url + "/fleet/cache/" + GOOD, data=b"bytes!",
+            method="PUT",
+            headers={CACHE_AUTH_HEADER: _sign(GOOD, b"bytes!")})
         with urllib.request.urlopen(req, timeout=10) as r:
             assert r.status == 204
         with urllib.request.urlopen(url + "/fleet/cache/",
@@ -101,6 +169,36 @@ def test_cache_endpoints_over_http(tmp_path):
             urllib.request.urlopen(
                 url + "/fleet/cache/" + GOOD2, timeout=10)
         assert exc.value.code == 404
+        # unsigned PUT over the wire: refused, nothing written
+        req = urllib.request.Request(
+            url + "/fleet/cache/" + GOOD2, data=b"evil",
+            method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 401
+        assert not (cache / GOOD2).exists()
+
+
+def test_cache_put_oversize_rejected_before_read(tmp_path,
+                                                 monkeypatch):
+    """An oversized Content-Length is 413'd BEFORE the router reads
+    the body — a misbehaving peer cannot buffer arbitrary bytes into
+    the jax-free forwarder's memory."""
+    import goleft_tpu.fleet.cachesync as cachesync
+
+    monkeypatch.setattr(cachesync, "MAX_ENTRY_BYTES", 16)
+    app, cache = _app(tmp_path)
+    with RouterThread(app) as url:
+        data = b"y" * 64
+        req = urllib.request.Request(
+            url + "/fleet/cache/" + GOOD, data=data, method="PUT",
+            headers={CACHE_AUTH_HEADER: _sign(GOOD, data)})
+        with pytest.raises((urllib.error.HTTPError,
+                            urllib.error.URLError)) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        if isinstance(exc.value, urllib.error.HTTPError):
+            assert exc.value.code == 413
+        assert list(cache.iterdir()) == []
 
 
 # ---------------- CacheSync ----------------
@@ -114,7 +212,7 @@ def test_cachesync_replicates_and_is_idempotent(tmp_path):
     reg = MetricsRegistry()
     with RouterThread(app_a) as ua, RouterThread(app_b) as ub:
         sync = CacheSync(lambda: [ua, ub], interval_s=0,
-                         registry=reg)
+                         registry=reg, secret=SECRET)
         s = sync.sync_now("test")
         assert s["replicated"] == 2 and s["errors"] == 0
         assert (cache_b / GOOD).read_bytes() == b"result-one"
@@ -130,16 +228,30 @@ def test_cachesync_replicates_and_is_idempotent(tmp_path):
 
 
 def test_cachesync_single_fleet_is_a_noop(tmp_path):
-    sync = CacheSync(lambda: ["http://127.0.0.1:1"], interval_s=0)
+    sync = CacheSync(lambda: ["http://127.0.0.1:1"], interval_s=0,
+                     secret=SECRET)
     s = sync.sync_now("test")
     assert s["replicated"] == 0 and s["fleets"] == 1
 
 
 def test_cachesync_rejoin_counter(tmp_path):
     reg = MetricsRegistry()
-    sync = CacheSync(lambda: [], interval_s=0, registry=reg)
+    sync = CacheSync(lambda: [], interval_s=0, registry=reg,
+                     secret=SECRET)
     sync.sync_now("rejoin")
     assert reg.counter("cachesync.rejoin_syncs_total").value == 1
+
+
+def test_cachesync_disabled_without_secret(tmp_path, monkeypatch):
+    monkeypatch.delenv("GOLEFT_TPU_FLEET_SECRET", raising=False)
+    app_a, cache_a = _app(tmp_path, "a")
+    app_b, _cache_b = _app(tmp_path, "b")
+    (cache_a / GOOD).write_bytes(b"x")
+    with RouterThread(app_a) as ua, RouterThread(app_b) as ub:
+        sync = CacheSync(lambda: [ua, ub], interval_s=0)
+        s = sync.sync_now("test")
+        assert s.get("disabled") is True
+        assert s["replicated"] == 0
 
 
 def test_cachesync_tolerates_unreachable_fleet(tmp_path):
@@ -148,11 +260,55 @@ def test_cachesync_tolerates_unreachable_fleet(tmp_path):
     with RouterThread(app_a) as ua:
         sync = CacheSync(
             lambda: [ua, "http://127.0.0.1:1"], interval_s=0,
-            timeout_s=0.5)
+            timeout_s=0.5, secret=SECRET)
         s = sync.sync_now("test")
         # the dead fleet cannot be listed: the round degrades to a
         # single reachable fleet and moves nothing
         assert s["replicated"] == 0
+
+
+def test_sync_soon_runs_round_off_thread(tmp_path):
+    """The rejoin hook's entry point: one round on a background
+    thread — sync_soon returns immediately and the round's effects
+    land once the thread is joined."""
+    app_a, cache_a = _app(tmp_path, "a")
+    app_b, cache_b = _app(tmp_path, "b")
+    (cache_a / GOOD).write_bytes(b"warm")
+    reg = MetricsRegistry()
+    with RouterThread(app_a) as ua, RouterThread(app_b) as ub:
+        sync = CacheSync(lambda: [ua, ub], interval_s=0,
+                         registry=reg, secret=SECRET)
+        t = sync.sync_soon("rejoin")
+        assert t is not threading.current_thread()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert (cache_b / GOOD).read_bytes() == b"warm"
+    assert reg.counter("cachesync.rejoin_syncs_total").value == 1
+
+
+def test_federation_rejoin_hook_is_nonblocking():
+    """The federation wires on_rejoin to sync_soon: a rejoin settling
+    on a live request thread must not wait out a full anti-entropy
+    round."""
+    app = FederationRouter(["http://127.0.0.1:1"],
+                           registry=MetricsRegistry())
+    try:
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_round(reason="interval"):
+            started.set()
+            release.wait(10)
+            return {}
+
+        app.cache_sync.sync_now = slow_round
+        t0 = time.monotonic()
+        app.pool.on_rejoin("http://127.0.0.1:1")
+        assert time.monotonic() - t0 < 1.0   # returned immediately
+        assert started.wait(10)              # round DID start
+        release.set()
+    finally:
+        app.close()
 
 
 # ---------------- rejoin hook ----------------

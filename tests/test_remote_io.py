@@ -182,6 +182,37 @@ def test_file_key_parity_local_and_remote(tmp_path, srv):
     assert _file_key(url) == file_key(url)
 
 
+def test_routing_file_key_parity_on_success(srv):
+    """The routing-budget probe returns the SAME identity tuple as
+    the full-budget one — fleet affinity stays parity-pinned."""
+    url = srv.put("f.bin", DATA)
+    assert remote.routing_file_key(url) == remote.remote_file_key(url)
+
+
+def test_routing_probe_failure_is_negative_cached():
+    """A dead endpoint costs routing one short probe per TTL: the
+    failure is negative-cached, so subsequent probes raise without
+    touching the network — and invalidate_identity clears it."""
+    url = "http://127.0.0.1:1/nope.bam"
+    with pytest.raises(OSError):
+        remote.routing_file_key(url)
+    assert url in remote._identity_neg
+    with pytest.raises(OSError) as exc:
+        remote.routing_file_key(url)
+    assert "negative-cached" in str(exc.value)
+    remote.invalidate_identity(url)
+    assert url not in remote._identity_neg
+
+
+def test_identity_cache_is_bounded(srv, monkeypatch):
+    """Long-lived routers/workers touching many distinct URLs must
+    not grow the identity cache without bound."""
+    monkeypatch.setenv("GOLEFT_TPU_FETCH_IDENTITY_CACHE", "16")
+    for i in range(40):
+        remote.remote_file_key(srv.put(f"many/{i}.bin", b"x" * i))
+    assert len(remote._identity_cache) <= 16
+
+
 def test_affinity_key_survives_unreachable_url(monkeypatch):
     """Routing degrades to the raw path for a URL nobody answers —
     never a 500 out of the affinity computation."""
