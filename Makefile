@@ -8,7 +8,7 @@ SRC = csrc/fastio.cpp
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
         fleet-obs-smoke federation-chaos decode-smoke \
-        dataplane-smoke perf-gate \
+        dataplane-smoke biobank-smoke perf-gate \
         lint lint-changed lint-ci plan-lint check clean
 
 native: build/libgoleftio.so
@@ -188,10 +188,19 @@ federation-chaos:
 dataplane-smoke:
 	python -m goleft_tpu.io.dataplane_smoke
 
+# biobank-scale cohort QC end-to-end: a 12-sample URL cohort over the
+# stub object store scans byte-identical to local indexcov, appending
+# 3 samples performs exactly 3×n_chroms QC computations (manifest-
+# counter pinned), and a SIGKILL mid-scan resumes byte-identically
+# from the checkpoint journal. Host-pinned like the other smokes.
+biobank-smoke:
+	python -m goleft_tpu.cohort.biobank_smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
 # the test suite, then the end-to-end proofs
-check: lint plan-lint test decode-smoke dataplane-smoke fleet-smoke \
-       fleet-chaos fleet-obs-smoke federation-chaos
+check: lint plan-lint test decode-smoke dataplane-smoke \
+       biobank-smoke fleet-smoke fleet-chaos fleet-obs-smoke \
+       federation-chaos
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

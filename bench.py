@@ -643,7 +643,130 @@ def bench_suite(quick: bool, emit=None) -> dict:
     # measurement, judge-visible here)
     _rec("decode_thread_scaling", _thread_scaling_entry)
     _rec("cram31_codec_decode", lambda: _cram31_codec_entry(quick))
+    # biobank cohortscan (ISSUE-17): streaming chunked QC vs one-shot
+    # indexcov vs incremental append, with per-leg peak RSS
+    _rec("cohort_scan", lambda: bench_cohort_scan(quick))
     return out
+
+
+_COHORT_SCAN_DRIVER = '''\
+import json, os, resource, sys, time
+
+spec = json.load(open(sys.argv[1]))
+if spec["mode"] == "monolithic":
+    from goleft_tpu.commands.indexcov import run_indexcov as _run
+else:
+    from goleft_tpu.cohort.scan import run_cohortscan as _run
+
+t0 = time.perf_counter()
+if spec["mode"] == "monolithic":
+    _run(spec["bams"], spec["out"], fai=spec["fai"],
+         write_html=False, write_png=False)
+    qc = None
+else:
+    res = _run(spec["bams"], spec["out"], fai=spec["fai"],
+               chunk_samples=spec["chunk_samples"],
+               resume=spec["resume"])
+    qc = res["qc"]
+dt = time.perf_counter() - t0
+print(json.dumps({
+    "seconds": dt, "qc": qc,
+    "peak_rss_kb": resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss}))
+'''
+
+
+def bench_cohort_scan(quick: bool = False) -> dict:
+    """Biobank cohortscan (cohort/scan.py) vs one-shot indexcov on the
+    same hermetic 3-chromosome cohort: (a) monolithic ``run_indexcov``,
+    (b) streaming chunked ``run_cohortscan``, (c) an incremental
+    ``resume`` append of k new samples over the content-keyed manifest.
+    Each leg runs in its OWN subprocess so ``ru_maxrss`` is a per-leg
+    peak (it is a process-lifetime high-water mark — in-process legs
+    would inherit the first leg's watermark) and the append leg's QC
+    counters are asserted, making the samples/s numbers trustworthy:
+    the append leg really did compute only the k new columns."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    from goleft_tpu.cohort.biobank_smoke import (
+        REFS, _make_biobank_cohort,
+    )
+
+    n = 8 if quick else 16
+    k = 2 if quick else 4
+    chunk = 4
+    d = tempfile.mkdtemp(prefix="goleft_cscan_")
+    try:
+        bams, fai = _make_biobank_cohort(d, n=n)
+        driver = os.path.join(d, "driver.py")
+        with open(driver, "w") as fh:
+            fh.write(_COHORT_SCAN_DRIVER)
+        import goleft_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(goleft_tpu.__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GOLEFT_TPU_PROBE="0", PYTHONPATH=repo)
+        env.pop("GOLEFT_TPU_FAULTS", None)
+
+        def leg(mode, leg_bams, out, resume=False):
+            spec = {"mode": mode, "bams": leg_bams, "out": out,
+                    "fai": fai, "chunk_samples": chunk,
+                    "resume": resume}
+            sp = os.path.join(
+                d, f"{mode}{'_r' if resume else ''}.json")
+            with open(sp, "w") as fh:
+                json.dump(spec, fh)
+            rc = subprocess.run(
+                [sys.executable, driver, sp], env=env,
+                capture_output=True, text=True, timeout=600)
+            if rc.returncode != 0:
+                raise RuntimeError(
+                    f"cohort_scan {mode} leg failed: "
+                    f"{rc.stderr[-2000:]}")
+            return json.loads(rc.stdout.splitlines()[-1])
+
+        mono = leg("monolithic", bams, os.path.join(d, "m", "out"))
+        cold = leg("cohortscan", bams, os.path.join(d, "c", "out"))
+        inc = os.path.join(d, "i", "out")
+        leg("cohortscan", bams[: n - k], inc)  # prefill (untimed)
+        app = leg("cohortscan", bams, inc, resume=True)
+        n_chroms = len(REFS)
+        if app["qc"] != {"computed": k * n_chroms,
+                         "resumed": (n - k) * n_chroms}:
+            raise RuntimeError(
+                f"append leg QC counters off: {app['qc']} "
+                f"(want {k}x{n_chroms} computed)")
+
+        def _leg_out(r, n_done):
+            return {
+                "seconds": round(r["seconds"], 3),
+                "samples_per_sec": round(n_done / r["seconds"], 2),
+                "peak_rss_mb": round(r["peak_rss_kb"] / 1024, 1),
+            }
+
+        return {
+            "samples": n, "chromosomes": n_chroms,
+            "chunk_samples": chunk, "platform": "cpu",
+            "monolithic": _leg_out(mono, n),
+            "chunked": _leg_out(cold, n),
+            "incremental_append": dict(
+                _leg_out(app, k), samples_appended=k,
+                qc_computed=app["qc"]["computed"],
+                qc_resumed=app["qc"]["resumed"]),
+            "peak_rss_delta_mb": round(
+                cold["peak_rss_kb"] / 1024
+                - mono["peak_rss_kb"] / 1024, 1),
+            "note": "per-leg subprocess ru_maxrss; append leg's QC "
+                    "counters asserted (only the k new samples' "
+                    "columns computed); artifacts byte-identical by "
+                    "tests/test_cohortscan.py",
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _build_cohort_fixture(n_samples: int, ref_len: int, coverage: int,

@@ -83,6 +83,8 @@ PROGS = {
              "lock discipline", _lazy(".analysis.cli"), False),
     "cohortdepth": ("depth matrix for many bams in one device pass",
                     _lazy(".commands.cohortdepth"), True),
+    "cohortscan": ("streaming, incremental indexcov for biobank-scale "
+                   "cohorts", _lazy(".commands.cohortscan"), True),
     "cnv": ("CNV calls straight from bams (cohort depth + EM)",
             _lazy(".commands.cnv"), True),
     "serve": ("warm-mesh coverage daemon with request micro-batching",

@@ -1,0 +1,28 @@
+"""Cohort plane: streaming, sharded, incremental indexcov at scale.
+
+The one-shot ``indexcov`` path holds the whole (samples × bins) matrix
+in memory and normalizes it in a single fused scan — fine for a
+thousand samples, hopeless for the 100k-sample continuously-updatable
+QC service the roadmap targets. This package is the scale-out of that
+path, built so that *nothing changes in the output bytes*:
+
+- :mod:`.streaming` — the two-pass cross-sample normalization: an
+  exact, chunk-invariant per-length-class statistics pass plus a
+  per-sample device finalize. Chunked output is byte-identical to the
+  monolithic path on any chunking (docs/cohort.md derives why).
+- :mod:`.pca` — sharded Gram/power-iteration PCA over sample chunks,
+  with ``ops.indexcov_ops.pca_project`` kept as the small-cohort
+  oracle.
+- :mod:`.manifest` — the content-keyed cohort manifest
+  (``goleft-tpu.cohort-manifest/1``): per-sample ``file_key`` /
+  ``remote_file_key`` identities layered on the PR-5 CheckpointStore,
+  so an appended sample recomputes only its own columns.
+- :mod:`.scan` — the chunked/incremental engine behind the
+  ``goleft-tpu cohortscan`` CLI and the serve ``/v1/cohortscan``
+  executor, emitting bed.gz/.roc/.ped byte-identical to one-shot
+  ``indexcov`` on the same inputs.
+"""
+
+from .streaming import (  # noqa: F401
+    NormStats, apply_normalization, normalize_across_samples_chunked,
+)

@@ -190,6 +190,52 @@ def _index_file(path: str) -> str:
     return path[:-4] + ".bai"
 
 
+def write_bed_block(bed, ref_name: str, lo: int, hi: int,
+                    mat_cols: np.ndarray, valid_cols: np.ndarray) -> None:
+    """Format + write bed rows for bins [lo, hi) of one chromosome.
+
+    ``mat_cols``/``valid_cols`` are the (samples, hi-lo) column slice.
+    The ONE formatting path for both the monolithic ``indexcov`` loop
+    and the chunked ``cohortscan`` engine — shorter samples print 0
+    (indexcov.go:678-680, depthsFor :1038-1048); C++ formats the block
+    when the native lib is built (byte-identical to np.char.mod
+    "%.3g"). The emitted bytes depend only on the slice values, never
+    on how the caller blocked its writes (BgzfWriter re-chunks to its
+    fixed block size).
+    """
+    from ..io import native
+
+    idx = np.arange(lo, hi, dtype=np.int64)
+    if native.get_lib() is not None:
+        bed.write(native.format_float_matrix_rows(
+            ref_name, idx * TILE, (idx + 1) * TILE, mat_cols, valid_cols,
+        ))
+        return
+    block = np.char.mod("%.3g", mat_cols.T)
+    block[~valid_cols.T] = "0"
+    starts_col = np.char.mod("%d", idx * TILE)
+    ends_col = np.char.mod("%d", (idx + 1) * TILE)
+    rows_txt = [
+        ref_name + "\t" + starts_col[i] + "\t" + ends_col[i]
+        + "\t" + "\t".join(block[i]) + "\n"
+        for i in range(hi - lo)
+    ]
+    bed.write("".join(rows_txt).encode())
+
+
+def write_roc_rows(roc_fh, ref_name: str, rocs: np.ndarray) -> None:
+    """One chromosome's ROC block (SLOTS rows), one vectorized format
+    pass — shared by indexcov and cohortscan for byte-parity."""
+    cov_col = np.char.mod(
+        "%.2f", np.arange(ops.SLOTS) / (ops.SLOTS * ops.SLOTS_MID),
+    )
+    cells = np.char.mod("%.2f", rocs.T)  # (SLOTS, S)
+    roc_fh.write("".join(
+        ref_name + "\t" + cov_col[i] + "\t" + "\t".join(cells[i]) + "\n"
+        for i in range(ops.SLOTS)
+    ))
+
+
 def run_indexcov(
     bams: list[str],
     directory: str,
@@ -314,34 +360,13 @@ def run_indexcov(
                     np.asarray(packed_dev), n_samples
                 )
 
-        # bed.gz rows: longest sample defines row count; shorter samples
-        # print 0 (indexcov.go:678-680, depthsFor :1038-1048).
-        # C++ formats the whole block (byte-identical to np.char.mod
-        # "%.3g", which itself replaced the Python f-string loop);
-        # chunked so a big cohort's formatted block stays bounded in RAM
-        from ..io import native
-
-        use_native_fmt = native.get_lib() is not None
+        # bed.gz rows: chunked so a big cohort's formatted block stays
+        # bounded in RAM (write_bed_block is the shared formatter)
         with timer.stage("bed_gz"):
             for lo in range(0, longest, 2048):
                 hi = min(lo + 2048, longest)
-                idx = np.arange(lo, hi, dtype=np.int64)
-                if use_native_fmt:
-                    bed.write(native.format_float_matrix_rows(
-                        ref_name, idx * TILE, (idx + 1) * TILE,
-                        mat[:, lo:hi], valid[:, lo:hi],
-                    ))
-                    continue
-                block = np.char.mod("%.3g", mat[:, lo:hi].T)
-                block[~valid[:, lo:hi].T] = "0"
-                starts_col = np.char.mod("%d", idx * TILE)
-                ends_col = np.char.mod("%d", (idx + 1) * TILE)
-                rows_txt = [
-                    ref_name + "\t" + starts_col[i] + "\t" + ends_col[i]
-                    + "\t" + "\t".join(block[i]) + "\n"
-                    for i in range(hi - lo)
-                ]
-                bed.write("".join(rows_txt).encode())
+                write_bed_block(bed, ref_name, lo, hi,
+                                mat[:, lo:hi], valid[:, lo:hi])
 
         if is_sex:
             if longest > 0:
@@ -358,18 +383,8 @@ def run_indexcov(
                     counters[k] += chrom_counters[k]
 
         if longest > 0:
-            # one vectorized format pass for the whole ROC block
             with timer.stage("roc"):
-                cov_col = np.char.mod(
-                    "%.2f",
-                    np.arange(ops.SLOTS) / (ops.SLOTS * ops.SLOTS_MID),
-                )
-                cells = np.char.mod("%.2f", rocs.T)  # (SLOTS, S)
-                roc_fh.write("".join(
-                    ref_name + "\t" + cov_col[i] + "\t"
-                    + "\t".join(cells[i]) + "\n"
-                    for i in range(ops.SLOTS)
-                ))
+                write_roc_rows(roc_fh, ref_name, rocs)
             if (include_gl or not ref_name.startswith("GL")) and longest > 2:
                 if not is_sex and longest > 100:
                     slopes += ops.update_slopes(rocs, ref_len / 1e6)
@@ -462,7 +477,11 @@ def run_indexcov(
             pca_mat = np.concatenate(pca_blocks, axis=1).astype(
                 np.float32)
             if pca_mat.shape[1] >= 3 and n_samples >= 3:
-                proj, frac = ops.pca_project(pca_mat, k=5)
+                # k clamps to the sample count: same projection values
+                # (the SVD only has min(n, bins) right vectors anyway),
+                # but inside pca_project's guarded domain
+                proj, frac = ops.pca_project(
+                    pca_mat, k=min(5, n_samples))
                 pcs, var_frac = np.asarray(proj), np.asarray(frac)
 
         ped_path = _write_ped(

@@ -101,6 +101,7 @@ AFFINITY_FIELDS = {
     "depth": ("bam",),
     "indexcov": ("bams",),
     "cohortdepth": ("bams",),
+    "cohortscan": ("bams",),
     "pairhmm": ("input",),
 }
 

@@ -748,13 +748,18 @@ class ParsedNx16:
     stream, or the raw bytes for CAT) — what actually crosses the
     wire under ``--decode-device``; ``freq``/``cum`` are the shipped
     int32 table arrays the device expands into its 4096-entry slot
-    tables. ORDER1 ships the COMPACT per-context rows instead:
-    ``ctx_freq`` holds one int32 row per context present in the
-    alphabet and ``ctx_index`` maps context symbol → row (−1 marks an
+    tables. ORDER1 ships the COMPACT per-context rows instead,
+    compacted on BOTH axes: ``ctx_freq`` holds one int32 row per
+    context present in the alphabet, its columns covering only the
+    alphabet symbols (``alphabet[k]`` names column ``k`` — contexts
+    and emitted symbols share the one alphabet, so the matrix is
+    (n_ctx, n_ctx), not (n_ctx, 256)); ``ctx_index`` maps context
+    symbol → row (−1 marks an
     absent context, the device diag for the host's missing-context
     error). A STRIPE stream is a container: ``children`` holds one
     ParsedNx16 per byte-interleaved lane. ``table_bytes`` counts the
-    shipped table/metadata arrays for wire accounting."""
+    shipped table/metadata arrays for wire accounting — ORDER1 pays
+    n_ctx² int16 cells (alphabet-compacted columns), not n_ctx·256."""
 
     flags: int
     n_states: int
@@ -777,7 +782,8 @@ class ParsedNx16:
     shift: int = TF_SHIFT     # ORDER1 frequency precision (target=2^s)
     n_ctx: int = 0            # contexts present in the alphabet
     ctx_index: np.ndarray | None = None  # (256,) int16 ctx → row | -1
-    ctx_freq: np.ndarray | None = None   # (n_ctx, 256) int32 rows
+    ctx_freq: np.ndarray | None = None   # (n_ctx, n_ctx) int32 rows
+    alphabet: np.ndarray | None = None   # (n_ctx,) int16 col → symbol
     stripe: bool = False
     n_lanes: int = 0
     children: list["ParsedNx16"] | None = None
@@ -787,8 +793,11 @@ class ParsedNx16:
         """Logical bytes of the table/metadata arrays as they ship
         over the wire: freq goes int16 and cum is expanded on device
         (a cumsum), so a non-CAT ORDER0 block pays ~0.5KB of table
-        while an ORDER1 block pays ~(n_ctx+2)·0.5KB for its compact
-        context rows plus the ctx→row map."""
+        while an ORDER1 block pays 2·n_ctx² bytes for its doubly
+        compact context rows plus the ctx→row map and the
+        column→symbol alphabet — a 40-symbol quality stream ships
+        ~3.2KB of rows instead of the 20KB a 256-wide row matrix
+        would cost."""
         if self.stripe:
             return sum(ch.table_bytes for ch in self.children or [])
         n = 0
@@ -797,9 +806,13 @@ class ParsedNx16:
         if self.freq is not None:
             n += 256 * 2  # int16 on the wire; cum derives on device
         if self.ctx_freq is not None:
-            # compact int16 rows + the int16 ctx→row map; per-context
+            # compact int16 rows over compact columns + the int16
+            # ctx→row map + the column→symbol alphabet; per-context
             # cum rows and slot tables derive on device
-            n += self.ctx_freq.shape[0] * 256 * 2 + 256 * 2
+            n += (self.ctx_freq.shape[0] * self.ctx_freq.shape[1] * 2
+                  + 256 * 2)
+        if self.alphabet is not None:
+            n += int(self.alphabet.shape[0]) * 2
         if self.rle_tab is not None:
             n += int(self.rle_tab.nbytes)
         if self.rle_runs is not None:
@@ -832,8 +845,8 @@ class ParsedNx16:
                 crc = ch.table_crc(crc)
             return crc
         for a in (self.states, self.freq, self.ctx_index,
-                  self.ctx_freq, self.rle_tab, self.rle_runs,
-                  self.pack_map):
+                  self.ctx_freq, self.alphabet, self.rle_tab,
+                  self.rle_runs, self.pack_map):
             if a is not None:
                 crc = zlib.crc32(np.ascontiguousarray(a).tobytes(),
                                  crc)
@@ -972,6 +985,7 @@ def parse_nx16(data: bytes,
                 syms, freqs, cums, _, pos = _read_freqs1_rows(
                     buf, pos, target)
             ctx_index = np.full(256, -1, dtype=np.int16)
+            alpha = np.asarray(syms, dtype=np.int64)
             rows = []
             for k, c in enumerate(syms):
                 if int(cums[c][256]) != target:
@@ -980,12 +994,16 @@ def parse_nx16(data: bytes,
                     # searchsorted expansion — keep host semantics
                     return None
                 ctx_index[c] = k
-                rows.append(freqs[c])
+                # columns compacted to the alphabet: every nonzero
+                # frequency lives on an alphabet symbol by
+                # construction (_read_freqs1_rows only fills syms)
+                rows.append(freqs[c][alpha])
             parsed.order1 = True
             parsed.shift = shift
             parsed.n_ctx = len(syms)
             parsed.ctx_index = ctx_index
             parsed.ctx_freq = np.stack(rows).astype(np.int32)
+            parsed.alphabet = alpha.astype(np.int16)
             parsed.states = np.array(
                 struct.unpack_from(f"<{n_states}I", buf, pos),
                 dtype=np.uint32)

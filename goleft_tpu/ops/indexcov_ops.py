@@ -30,8 +30,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.dtypes import preferred_float
-
 SLOTS = 70
 SLOTS_MID = 2.0 / 3.0
 MAX_CN = 8.0
@@ -148,7 +146,6 @@ def get_cn(depths: jax.Array, valid: jax.Array, ploidy: int = PLOIDY
     return jax.vmap(one)(depths, valid)
 
 
-@jax.jit
 def normalize_across_samples(
     depths: jax.Array, lengths: jax.Array
 ) -> jax.Array:
@@ -157,64 +154,32 @@ def normalize_across_samples(
     Column j is divided by the cohort mean of its 3-bin neighborhood —
     where columns < j were already normalized+smoothed — then smoothed with
     a 7-tap window mixing processed (j-3..j) and still-raw (j+1..j+3)/m
-    values. The feedback makes this a scan over the bin axis with a carry
-    of the last three processed columns.
+    values.
+
+    Since PR 17 this lowers onto the streaming two-pass form
+    (:mod:`goleft_tpu.cohort.streaming`): a host f64 per-length-class
+    statistics pass yields the per-bin cohort scalars — the reference
+    accumulates this neighborhood mean in float64 (indexcov.go:560-581),
+    which the host pass now honors on every backend, TPU included —
+    then a jitted per-sample scan applies them. The monolithic call here
+    and the chunked cohort path share both passes, so chunked output is
+    byte-identical to this function on any chunking of the sample axis.
 
     depths: (n_samples, n_bins) zero-padded; lengths: per-sample bin counts.
     Returns processed depths (same shape).
     """
-    n_samples, n_bins = depths.shape
+    from ..cohort.streaming import NormStats, apply_normalization
+
+    d = np.asarray(depths, dtype=np.float32)
+    n_samples, n_bins = d.shape
     if n_samples < 5:
         return depths
-    lengths = lengths.astype(jnp.int32)
-
-    raw = depths
-    # raw columns at j+1, j+2, j+3 (zero-padded past the end)
-    pad = jnp.zeros((n_samples, 3), raw.dtype)
-    raw_p = jnp.concatenate([raw, pad], axis=1)
-
-    # the reference accumulates the neighborhood mean in float64
-    # (indexcov.go:560-581); honor that wherever the backend has f64
-    # (CPU/x64 — where bit-parity is tested), degrading to f32 on TPU
-    acc_t = preferred_float()
-
-    def step(carry, j):
-        prev3 = carry  # (n_samples, 3): processed j-3, j-2, j-1
-        col = raw[:, j]
-        valid_j = lengths > j
-        valid_jm1 = (j > 0) & valid_j  # len > j implies len > j-1
-        valid_jp1 = lengths - 1 > j
-        m_sum = (
-            jnp.where(valid_j, col, 0.0).astype(acc_t).sum()
-            + jnp.where(valid_jm1, prev3[:, 2], 0.0).astype(acc_t).sum()
-            + jnp.where(valid_jp1, raw_p[:, j + 1], 0.0).astype(acc_t).sum()
-        )
-        n = (
-            valid_j.sum() + valid_jm1.sum() + valid_jp1.sum()
-        ).astype(acc_t)
-        m_acc = m_sum / jnp.maximum(n, 1.0)
-        # skip test happens on the f64 mean (indexcov.go:581-584); the
-        # divisions below use float32(m) like the reference
-        skip = (n.astype(jnp.int32) < 3 * n_samples - 4) | (m_acc < 0.1)
-        m = m_acc.astype(raw.dtype)
-
-        scaled = jnp.where(valid_j, col / m, col)
-        do_smooth = valid_j & (j > 2) & (j < lengths - 3)
-        smoothed = (
-            prev3[:, 0] + prev3[:, 1] + prev3[:, 2] + scaled
-            + raw_p[:, j + 1] / m + raw_p[:, j + 2] / m + raw_p[:, j + 3] / m
-        ) / 7.0
-        out = jnp.where(do_smooth, smoothed, scaled)
-        out = jnp.where(skip, col, out)
-        new_carry = jnp.concatenate(
-            [prev3[:, 1:], out[:, None]], axis=1
-        )
-        return new_carry, out
-
-    init = jnp.zeros((n_samples, 3), raw.dtype)
-    _, cols = jax.lax.scan(step, init,
-                           jnp.arange(n_bins, dtype=jnp.int32))
-    return cols.T  # (n_samples, n_bins)
+    lengths_np = np.asarray(lengths, dtype=np.int64)
+    stats = NormStats()
+    stats.accumulate(d, lengths_np)
+    m, skip = stats.finalize(n_bins)
+    return apply_normalization(
+        d, lengths_np.astype(np.int32), m, skip)
 
 
 def quantize_depths(
@@ -236,13 +201,7 @@ def quantize_depths(
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def pca_project(mat: jax.Array, k: int = 5) -> tuple[jax.Array, jax.Array]:
-    """Principal-component projection (indexcov.go:773-807).
-
-    gonum's stat.PC column-centers the matrix for the SVD; the reference
-    then projects the *raw* matrix onto the top-k right singular vectors.
-    Returns (proj (n, k), variance fractions (k,)).
-    """
+def _pca_project_jit(mat: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     x = mat.astype(jnp.float32)
     centered = x - x.mean(axis=0, keepdims=True)
     _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
@@ -251,6 +210,33 @@ def pca_project(mat: jax.Array, k: int = 5) -> tuple[jax.Array, jax.Array]:
     frac = vars_ / vars_.sum()
     proj = x @ vt[:k].T
     return proj, frac[:k]
+
+
+def pca_project(mat, k: int = 5) -> tuple[jax.Array, jax.Array]:
+    """Principal-component projection (indexcov.go:773-807).
+
+    gonum's stat.PC column-centers the matrix for the SVD; the reference
+    then projects the *raw* matrix onto the top-k right singular vectors.
+    Returns (proj (n, k), variance fractions (k,)).
+
+    This is the small-cohort oracle; biobank-scale cohorts go through
+    :func:`goleft_tpu.cohort.pca.sharded_pca`, which never materializes
+    the full matrix. Degenerate requests fail here with a clear error
+    instead of a backend-dependent solver failure: ``k`` may not exceed
+    the sample count (the SVD has no k-th right singular vector to
+    project onto), and a single-sample cohort has no cross-sample
+    variance to decompose.
+    """
+    n_samples = int(np.asarray(mat.shape[0]))
+    if n_samples < 2:
+        raise ValueError(
+            f"pca: need at least 2 samples, got {n_samples} — a "
+            "single-sample cohort has no cross-sample variance")
+    if k > n_samples:
+        raise ValueError(
+            f"pca: k={k} components exceed n_samples={n_samples}; "
+            "pass k <= n_samples (indexcov clamps to min(5, n_samples))")
+    return _pca_project_jit(mat, k)
 
 
 @jax.jit

@@ -169,9 +169,9 @@ def _admit_signatures(sigs: list[tuple]) -> bool:
 # without jax (the jax-free fleet/router processes import the package)
 def _decode_bucket_impl(payload, plen, states, freq, inner_len,
                         rle_tab, runs, rle_out, pmap, bits, final_len,
-                        ctx_index, ctx_freq, *, rounds, n_states, cat,
-                        rle, pack, order1, shift, n_ctx_cap, lit_cap,
-                        mid_cap, out_cap):
+                        ctx_index, ctx_freq, alphabet, *, rounds,
+                        n_states, cat, rle, pack, order1, shift,
+                        n_ctx_cap, lit_cap, mid_cap, out_cap):
     """One padded bucket: (B, …) arrays → ((B, out_cap) uint8 bytes,
     (B, 4) int32 diagnostics [rle_total, marked_total, pack_vmax,
     missing_ctx]).
@@ -190,35 +190,40 @@ def _decode_bucket_impl(payload, plen, states, freq, inner_len,
     ms = jnp.arange(TOTFREQ, dtype=jnp.int32)
 
     def one(payload, plen, R0, freq, inner_len, rle_tab, runs,
-            rle_out, pmap, bits, final_len, ctx_index, ctx_freq):
+            rle_out, pmap, bits, final_len, ctx_index, ctx_freq,
+            alphabet):
         P = payload.shape[0]
         bad_ctx = jnp.int32(0)
         if cat:
             lit = payload[:lit_cap]
         elif order1:
-            # per-context slot tables: the shipped compact (n_ctx,
-            # 256) rows expand into (n_ctx_cap, 2^shift) sym/freq/
-            # bias tables by the same searchsorted used for ORDER0 —
-            # the slot lookup becomes a (ctx_row, slot) gather. Each
-            # lane carries its previous symbol; ctx_index maps it to
-            # its table row (-1 = context absent from the alphabet →
-            # the host's missing-context error, carried as a diag
-            # bit). Lane j decodes the contiguous slice [j·F,
-            # (j+1)·F) with the last lane carrying the tail, so the
-            # active mask is per-lane-length, not round-robin.
+            # per-context slot tables: the shipped doubly compact
+            # (n_ctx, n_ctx) rows (columns are alphabet positions,
+            # not raw symbols) expand into (n_ctx_cap, 2^shift)
+            # sym/freq/bias tables by the same searchsorted used for
+            # ORDER0 — the slot lookup becomes a (ctx_row, slot)
+            # gather, with the alphabet mapping the compact column
+            # index back to the emitted byte. Each lane carries its
+            # previous symbol; ctx_index maps it to its table row
+            # (-1 = context absent from the alphabet → the host's
+            # missing-context error, carried as a diag bit). Lane j
+            # decodes the contiguous slice [j·F, (j+1)·F) with the
+            # last lane carrying the tail, so the active mask is
+            # per-lane-length, not round-robin.
             target = 1 << shift
             ms1 = jnp.arange(target, dtype=jnp.int32)
             cf = ctx_freq.astype(jnp.int32)
             cum1 = jnp.concatenate([
                 jnp.zeros((n_ctx_cap, 1), jnp.int32),
                 jnp.cumsum(cf, axis=1, dtype=jnp.int32)], axis=1)
-            sym1 = jnp.clip(jax.vmap(
+            col1 = jnp.clip(jax.vmap(
                 lambda c: jnp.searchsorted(c, ms1, side="right"))(
-                    cum1).astype(jnp.int32) - 1, 0, 255)
-            freq1 = jnp.take_along_axis(cf, sym1, axis=1) \
+                    cum1).astype(jnp.int32) - 1, 0, n_ctx_cap - 1)
+            freq1 = jnp.take_along_axis(cf, col1, axis=1) \
                 .astype(jnp.uint32)
             bias1 = (ms1[None, :] - jnp.take_along_axis(
-                cum1, sym1, axis=1)).astype(jnp.uint32)
+                cum1, col1, axis=1)).astype(jnp.uint32)
+            sym1 = alphabet.astype(jnp.int32)[col1]
             ci = ctx_index.astype(jnp.int32)
             F = inner_len // N
             rem = inner_len - F * N
@@ -365,7 +370,7 @@ def _decode_bucket_impl(payload, plen, states, freq, inner_len,
 
     return jax.vmap(one)(payload, plen, states, freq, inner_len,
                          rle_tab, runs, rle_out, pmap, bits,
-                         final_len, ctx_index, ctx_freq)
+                         final_len, ctx_index, ctx_freq, alphabet)
 
 
 def _interleave_impl(lanes_arr, final_len, *, n_lanes, out_cap):
@@ -621,11 +626,13 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
         pmap = np.zeros((B, 16), np.int32)
         bits = np.zeros(B, np.int32)
         final = np.zeros(B, np.int32)
-        # ORDER1 compact context rows (int16 on the wire, ≤ 4096
-        # each) + the ctx→row map; (B, 1, 256) dummies for ORDER0
-        # groups so the jit signature stays uniform
+        # ORDER1 doubly compact context rows (int16 on the wire,
+        # ≤ 4096 each; columns are alphabet positions) + the ctx→row
+        # map + the column→symbol alphabet; (B, 1, 1)/(B, 1) dummies
+        # for ORDER0 groups so the jit signature stays uniform
         ctx_index = np.full((B, 256), -1, np.int16)
-        ctx_freq = np.zeros((B, n_ctx_cap, 256), np.int16)
+        ctx_freq = np.zeros((B, n_ctx_cap, n_ctx_cap), np.int16)
+        alphabet = np.zeros((B, n_ctx_cap), np.int16)
         for j, p in enumerate(grp):
             payload[j, :p.payload.shape[0]] = p.payload
             plen[j] = p.payload.shape[0]
@@ -635,8 +642,9 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                 states[j] = p.states
                 if order1:
                     ctx_index[j] = p.ctx_index
-                    ctx_freq[j, :p.n_ctx] = \
+                    ctx_freq[j, :p.n_ctx, :p.n_ctx] = \
                         p.ctx_freq.astype(np.int16)
+                    alphabet[j, :p.n_ctx] = p.alphabet
                 else:
                     freq[j] = p.freq.astype(np.int16)
             if rle:
@@ -650,7 +658,7 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                     freq=freq, inner=inner, rle_tab=rle_tab,
                     runs=runs, rle_out=rle_out, pmap=pmap, bits=bits,
                     final=final, ctx_index=ctx_index,
-                    ctx_freq=ctx_freq)
+                    ctx_freq=ctx_freq, alphabet=alphabet)
         if stage is None:
             import jax
 
@@ -668,6 +676,7 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                 dev["inner"], dev["rle_tab"], dev["runs"],
                 dev["rle_out"], dev["pmap"], dev["bits"],
                 dev["final"], dev["ctx_index"], dev["ctx_freq"],
+                dev["alphabet"],
                 rounds=0, n_states=n, cat=True,
                 rle=rle, pack=pack, order1=False, shift=TF_SHIFT,
                 n_ctx_cap=n_ctx_cap, lit_cap=lit.shape[1],
@@ -678,7 +687,7 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                 dev["freq"], dev["inner"],
                 dev["rle_tab"], dev["runs"], dev["rle_out"],
                 dev["pmap"], dev["bits"], dev["final"],
-                dev["ctx_index"], dev["ctx_freq"],
+                dev["ctx_index"], dev["ctx_freq"], dev["alphabet"],
                 rounds=rounds, n_states=n, cat=cat, rle=rle,
                 pack=pack, order1=order1, shift=shift,
                 n_ctx_cap=n_ctx_cap, lit_cap=lit_cap,

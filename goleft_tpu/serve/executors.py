@@ -579,3 +579,144 @@ class CohortdepthExecutor:
             "samples": names[lo:hi],
             "windows": int(total_windows),
         } for b, (lo, hi) in zip(bufs, zip(bounds, bounds[1:]))]
+
+
+class CohortscanExecutor:
+    """`/v1/cohortscan`: the streaming incremental cohort QC scan —
+    the indexcov artifact surface (bed.gz/.roc/.ped, byte-identical)
+    produced with O(chunk × bins) peak memory and per-(sample,
+    chromosome) content-keyed checkpoints.
+
+    Requests are NOT coalesced across each other: a cohortscan is
+    already one whole-cohort device pipeline, and mixing two cohorts
+    would change each one's normalization scalars. ``run`` therefore
+    loops requests (the batcher's bisect isolation still applies).
+
+    ``checkpoint: true`` (needs the daemon's ``--checkpoint-root``)
+    pins the scan's checkpoint store + manifest under a directory
+    keyed by the scan *parameters* — NOT the sample list — so a
+    re-issued request resumes byte-identically after a daemon restart,
+    and an appended cohort (same params, +k samples) computes exactly
+    the k new samples' QC blocks: the per-sample blocks are keyed by
+    each input's own content identity (file_key / remote ETag), so
+    old samples keep matching and a changed input invalidates only
+    itself. Without the flag each request scans into a throwaway
+    store."""
+
+    kind = "cohortscan"
+
+    def __init__(self, processes: int = 8, metrics=None,
+                 checkpoint_root: str | None = None):
+        self.processes = processes
+        self.metrics = metrics
+        self.checkpoint_root = checkpoint_root
+
+    def validate(self, req: dict) -> None:
+        if req.get("checkpoint") and not self.checkpoint_root:
+            raise BadRequest(
+                "checkpoint: true needs the daemon started with "
+                "--checkpoint-root")
+        for p in _require(req, "bams"):
+            if not _exists(p):
+                raise BadRequest(f"no such file: {p}")
+        fai = _require(req, "fai")  # URL inputs carry no local .fai
+        if not _exists(fai):
+            raise BadRequest(f"no such file: {fai}")
+        cs = req.get("chunk_samples")
+        if cs is not None and int(cs) < 1:
+            raise BadRequest("chunk_samples must be >= 1")
+
+    def group_key(self, req: dict) -> tuple:
+        from ..commands.indexcov import DEFAULT_EXCLUDE
+
+        return (self.kind, req["fai"], req.get("chrom", "") or "",
+                req.get("excludepatt", DEFAULT_EXCLUDE),
+                req.get("sex", "X,Y"),
+                bool(req.get("extranormalize")),
+                bool(req.get("checkpoint")))
+
+    def cache_files(self, req: dict) -> list[str]:
+        return list(req["bams"])
+
+    def _scan_dir(self, req: dict) -> tuple[str, str | None, bool]:
+        """(output directory, checkpoint_dir, resume) for one request.
+
+        Persistent mode keys the store directory by the canonical scan
+        parameters + the reference identity — deliberately NOT the
+        sample list, so append-k re-requests land in the same store
+        and resume every previously committed sample."""
+        import hashlib
+        import json as _json
+        import tempfile
+
+        from ..commands.indexcov import DEFAULT_EXCLUDE
+
+        if not (req.get("checkpoint") and self.checkpoint_root):
+            return tempfile.mkdtemp(prefix="cohortscan-"), None, False
+        from ..parallel.scheduler import file_key
+
+        ident = _json.dumps([
+            "serve.cohortscan", list(file_key(req["fai"])),
+            req.get("chrom", "") or "",
+            req.get("excludepatt", DEFAULT_EXCLUDE),
+            req.get("sex", "X,Y"), bool(req.get("extranormalize")),
+        ], sort_keys=True)
+        digest = hashlib.sha256(ident.encode()).hexdigest()[:24]
+        root = os.path.join(self.checkpoint_root, "cohortscan", digest)
+        out_dir = os.path.join(root, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        return out_dir, os.path.join(root, "ck"), True
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        import base64
+        import shutil
+
+        from ..cohort.scan import run_cohortscan
+        from ..commands.indexcov import DEFAULT_EXCLUDE
+
+        out = []
+        for req in reqs:
+            out_dir, ck_dir, resume = self._scan_dir(req)
+            try:
+                res = _dispatch(
+                    self.metrics, "serve.cohortscan.dispatch",
+                    lambda: run_cohortscan(
+                        list(req["bams"]), out_dir,
+                        sex=req.get("sex", "X,Y"),
+                        exclude_patt=req.get("excludepatt",
+                                             DEFAULT_EXCLUDE),
+                        chrom=req.get("chrom", "") or "",
+                        fai=req["fai"],
+                        extra_normalize=bool(
+                            req.get("extranormalize")),
+                        include_gl=bool(req.get("includegl")),
+                        chunk_samples=int(
+                            req.get("chunk_samples", 256)),
+                        resume=resume, checkpoint_dir=ck_dir,
+                        pca_mode=req.get("pca", "auto"),
+                    ),
+                    # a half-finished scan is not safely re-attemptable
+                    # in-place; failures go to the batcher's bisect
+                    # isolation (and a checkpointed re-request resumes)
+                    retry=False, count_passes=True,
+                    samples=len(req["bams"]))
+                with open(res["bed"], "rb") as f:
+                    bed_b64 = base64.b64encode(f.read()).decode("ascii")
+                with open(res["roc"]) as f:
+                    roc = f.read()
+                with open(res["ped"]) as f:
+                    ped = f.read()
+                out.append({
+                    "bed_gz_b64": bed_b64,
+                    "roc": roc,
+                    "ped": ped,
+                    "samples": len(req["bams"]),
+                    "chroms": res["chrom_names"],
+                    "qc": res["qc"],
+                    "diff": {k: len(v)
+                             for k, v in res["diff"].items()},
+                })
+            finally:
+                if ck_dir is None:  # throwaway scan: no resume value
+                    shutil.rmtree(out_dir, ignore_errors=True)
+        return out

@@ -32,6 +32,8 @@ Routes:
   POST /v1/indexcov     {bams: [...], fai, chrom?, excludepatt?}
   POST /v1/cohortdepth  {bams: [...], reference|fai, window?, mapq?,
                          chrom?, bed?, engine?}
+  POST /v1/cohortscan   {bams: [...], fai, sex?, chrom?, excludepatt?,
+                         extranormalize?, chunk_samples?, checkpoint?}
   POST /v1/pairhmm      {input, candidates?, gap_open?, gap_ext?,
                          f64?}
   GET  /healthz         GET /metrics        GET /debug/flight
@@ -52,8 +54,8 @@ from .batcher import (
     PoisonRequest,
 )
 from .executors import (
-    BadRequest, CohortdepthExecutor, DepthExecutor, IndexcovExecutor,
-    PairhmmExecutor,
+    BadRequest, CohortdepthExecutor, CohortscanExecutor, DepthExecutor,
+    IndexcovExecutor, PairhmmExecutor,
 )
 from .flight import FlightRecorder
 from .metrics import ServeMetrics
@@ -107,6 +109,8 @@ class ServeApp:
                 IndexcovExecutor(max(processes, 8), self.metrics),
                 CohortdepthExecutor(processes, self.metrics,
                                     checkpoint_root=checkpoint_root),
+                CohortscanExecutor(max(processes, 8), self.metrics,
+                                   checkpoint_root=checkpoint_root),
                 PairhmmExecutor(processes, self.metrics),
             )
         }

@@ -1,0 +1,92 @@
+"""Sharded PCA (cohort/pca.py) against the full-matrix oracle
+(ops.indexcov_ops.pca_project) + the dimension guards both share."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.cohort.pca import ShardedPCA, sharded_pca
+from goleft_tpu.ops.indexcov_ops import pca_project
+
+
+def _rank_separated(rng, n=60, bins=48, k=4):
+    """A cohort whose top-k spectrum is well separated (distinct
+    decades) so power iteration and the SVD agree tightly."""
+    basis = np.linalg.qr(rng.standard_normal((bins, k)))[0]
+    scales = 10.0 ** np.arange(k, 0, -1)
+    scores = rng.standard_normal((n, k)) * scales
+    x = scores @ basis.T + 0.001 * rng.standard_normal((n, bins))
+    return x.astype(np.float32)
+
+
+def _chunks(x, size):
+    return lambda: (x[lo:lo + size] for lo in range(0, len(x), size))
+
+
+# ---------------------------------------------------------- guards
+
+def test_pca_project_rejects_single_sample():
+    with pytest.raises(ValueError, match="single-sample"):
+        pca_project(np.ones((1, 8), np.float32), k=1)
+
+
+def test_pca_project_rejects_k_above_n_samples():
+    with pytest.raises(ValueError, match="k=5"):
+        pca_project(np.ones((3, 8), np.float32), k=5)
+
+
+def test_pca_project_k_equals_n_samples_ok():
+    rng = np.random.default_rng(0)
+    mat = rng.standard_normal((4, 8)).astype(np.float32)
+    proj, frac = pca_project(mat, k=4)
+    assert proj.shape == (4, 4) and frac.shape == (4,)
+
+
+def test_sharded_pca_same_guards():
+    rng = np.random.default_rng(1)
+    one = rng.standard_normal((1, 8)).astype(np.float32)
+    with pytest.raises(ValueError, match="single-sample"):
+        sharded_pca(_chunks(one, 1), k=1)
+    with pytest.raises(ValueError, match="empty"):
+        sharded_pca(lambda: iter(()), k=2)
+
+
+# --------------------------------------------------- oracle parity
+
+def test_sharded_matches_oracle_on_separated_spectrum():
+    rng = np.random.default_rng(42)
+    x = _rank_separated(rng, n=60, bins=48, k=4)
+    want_proj, want_frac = pca_project(x, k=4)
+    fit = sharded_pca(_chunks(x, 13), k=4, iters=48, seed=3)
+    assert isinstance(fit, ShardedPCA)
+    np.testing.assert_allclose(fit.frac_, want_frac,
+                               rtol=1e-4, atol=1e-5)
+    got = np.vstack([fit.project(c) for c in _chunks(x, 13)()])
+    # singular-vector signs are pinned independently by the two
+    # implementations; compare up to a per-component sign
+    for j in range(4):
+        a, b = got[:, j], np.asarray(want_proj)[:, j]
+        err = min(np.linalg.norm(a - b), np.linalg.norm(a + b))
+        assert err <= 1e-3 * np.linalg.norm(b), (j, err)
+
+
+def test_sharded_chunk_size_and_rerun_deterministic():
+    """Same cohort through different chunkings (and a repeat run)
+    lands on the same components — the manifest's resume story needs
+    re-runs to be deterministic."""
+    rng = np.random.default_rng(9)
+    x = _rank_separated(rng, n=30, bins=24, k=3)
+    fits = [sharded_pca(_chunks(x, s), k=3, iters=40, seed=7)
+            for s in (5, 30, 5)]
+    np.testing.assert_array_equal(fits[0].components_,
+                                  fits[2].components_)
+    np.testing.assert_allclose(fits[0].components_,
+                               fits[1].components_,
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_k_clamps_to_cohort_size():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 16)).astype(np.float32)
+    fit = sharded_pca(_chunks(x, 2), k=3, iters=16)
+    assert fit.components_.shape == (16, 3)
+    assert fit.frac_.shape == (3,)

@@ -1,0 +1,127 @@
+"""Streaming cross-sample normalization (cohort/streaming.py): the
+chunk-parity property — two-pass chunked normalization is
+byte-identical to the monolithic array under ANY contiguous chunking —
+plus the per-length-class statistics that make it hold."""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.cohort.streaming import (
+    NormStats, apply_normalization, normalize_across_samples_chunked,
+)
+from goleft_tpu.ops.indexcov_ops import normalize_across_samples
+
+
+def _ragged_cohort(rng, n=11, width=96):
+    """A ragged cohort engineered to hit every scalar branch: varied
+    sample lengths (several length classes), near-zero bins (the
+    m < 0.1 skip), and sparse tail columns (the n < 3·n-4 skip)."""
+    lengths = rng.integers(width // 3, width + 1, size=n).astype(
+        np.int32)
+    lengths[0] = width            # one full-length sample
+    lengths[1] = width // 3       # one short class
+    depths = (rng.random((n, width), dtype=np.float32) * 2.0)
+    depths[:, 5] *= 1e-4          # a skipped low-coverage bin
+    for i, ln in enumerate(lengths):
+        depths[i, ln:] = 0.0
+    return depths.astype(np.float32), lengths
+
+
+def _chunk(depths, lengths, size):
+    n = depths.shape[0]
+    return [(depths[lo:lo + size], lengths[lo:lo + size])
+            for lo in range(0, n, size)]
+
+
+@pytest.mark.parametrize("chunk_samples", [1, 3, 10, 11])
+def test_chunked_byte_identical_to_monolithic(chunk_samples):
+    """The tentpole property: any contiguous chunking reproduces the
+    monolithic normalize_across_samples EXACTLY (np.array_equal, not
+    allclose) — chunk sizes 1, 3, n-1 and n."""
+    rng = np.random.default_rng(11)
+    depths, lengths = _ragged_cohort(rng)
+    want = np.asarray(normalize_across_samples(depths, lengths))
+    got = normalize_across_samples_chunked(
+        _chunk(depths, lengths, chunk_samples))
+    assert len(got) == len(_chunk(depths, lengths, chunk_samples))
+    stacked = np.vstack([np.asarray(g)[:, :depths.shape[1]]
+                         for g in got])
+    assert stacked.dtype == np.float32
+    assert np.array_equal(stacked, want)
+
+
+def test_scalars_invariant_under_chunking():
+    """The per-bin (m, skip) scalars — and their digest — must not
+    depend on how samples were grouped into accumulate() calls."""
+    rng = np.random.default_rng(5)
+    depths, lengths = _ragged_cohort(rng, n=9, width=64)
+    digests = set()
+    finals = []
+    for size in (1, 2, 8, 9):
+        st = NormStats()
+        for d, ln in _chunk(depths, lengths, size):
+            st.accumulate(d, ln)
+        assert st.n_samples == 9
+        m, skip = st.finalize(depths.shape[1])
+        finals.append((m, skip))
+        digests.add(st.scalars_digest(depths.shape[1]))
+    assert len(digests) == 1
+    m0, s0 = finals[0]
+    for m, s in finals[1:]:
+        assert np.array_equal(m, m0) and np.array_equal(s, s0)
+    assert s0.any(), "fixture must exercise the skip branch"
+    assert not s0.all()
+
+
+def test_skip_branches_fire():
+    """Low-mean bins skip; bins past most samples' length skip via the
+    n < 3·n_total − 4 sparsity rule."""
+    n, w = 8, 32
+    depths = np.ones((n, w), np.float32)
+    lengths = np.full(n, w, np.int32)
+    lengths[1:] = 10              # only sample 0 covers bins >= 10
+    for i, ln in enumerate(lengths):
+        depths[i, ln:] = 0.0
+    # the m scalar windows (j-1, j, j+1): a run of tiny bins drops
+    # the windowed mean below 0.1 at the middle bin
+    depths[:, 2:5] = 1e-6
+    st = NormStats()
+    st.accumulate(depths, lengths)
+    _m, skip = st.finalize(w)
+    assert skip[3]
+    assert skip[12:].all()        # sparse tail: one sample of eight
+    assert not skip[1]
+
+
+def test_small_cohort_returns_input_unchanged():
+    """n < 5 cohorts are returned as-is by the public op (goleft's
+    own rule) — the chunked path is only engaged for real cohorts."""
+    rng = np.random.default_rng(3)
+    depths = rng.random((3, 16), dtype=np.float32)
+    lengths = np.full(3, 16, np.int32)
+    out = np.asarray(normalize_across_samples(depths, lengths))
+    assert np.array_equal(out, depths)
+
+
+def test_apply_normalization_width_padding_is_inert():
+    """Zero-padding a chunk to a wider bin axis must not change the
+    real columns' bytes (chunks spill at their own width; the cohort
+    width only exists at finalize time)."""
+    rng = np.random.default_rng(8)
+    depths, lengths = _ragged_cohort(rng, n=6, width=40)
+    st = NormStats()
+    st.accumulate(depths, lengths)
+    m, skip = st.finalize(40)
+    a = np.asarray(apply_normalization(depths, lengths, m, skip))
+    wide = np.pad(depths, ((0, 0), (0, 24)))
+    m_w = np.pad(m, (0, 24))
+    skip_w = np.pad(skip, (0, 24), constant_values=True)
+    b = np.asarray(apply_normalization(wide, lengths, m_w, skip_w))
+    assert np.array_equal(b[:, :40], a)
+
+
+def test_accumulate_rejects_mismatched_shapes():
+    st = NormStats()
+    with pytest.raises(ValueError):
+        st.accumulate(np.zeros((2, 8), np.float32),
+                      np.zeros(3, np.int32))

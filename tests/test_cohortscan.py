@@ -1,0 +1,266 @@
+"""cohortscan (cohort/scan.py + the CLI + the serve executor): the
+biobank tentpole's acceptance properties — byte-identity with one-shot
+indexcov under any chunking, append-k incrementality with exact
+per-sample QC-compute counters, content-keyed invalidation of a
+changed input, and crash-resume after a mid-scan SIGKILL."""
+
+import gzip
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import goleft_tpu
+from goleft_tpu.cohort.manifest import FORMAT, CohortManifest
+from goleft_tpu.cohort.scan import run_cohortscan
+from goleft_tpu.commands.indexcov import run_indexcov
+from helpers import random_reads, write_bam_and_bai
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.abspath(goleft_tpu.__file__)))
+
+REFS = ("chr1", "X", "Y")
+LENS = (900_000, 400_000, 200_000)
+
+
+def _header(sample):
+    sq = "".join(f"@SQ\tSN:{n}\tLN:{l}\n"
+                 for n, l in zip(REFS, LENS))
+    return f"@HD\tVN:1.6\tSO:coordinate\n{sq}@RG\tID:rg\tSM:{sample}\n"
+
+
+def _make_cohort(tmp_path, n=7, seed=7, depth_reads=3000):
+    paths = []
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        male = i % 2 == 0
+        sample = f"s{'M' if male else 'F'}{i}"
+        reads = random_reads(rng, depth_reads, 0, LENS[0])
+        x_n = depth_reads * LENS[1] // LENS[0]
+        reads += random_reads(rng, x_n // 2 if male else x_n, 1,
+                              LENS[1])
+        if male:
+            reads += random_reads(
+                rng, depth_reads * LENS[2] // LENS[0] // 2, 2,
+                LENS[2])
+        p = str(tmp_path / f"{sample}.bam")
+        write_bam_and_bai(p, reads, ref_names=REFS, ref_lens=LENS,
+                          header_text=_header(sample))
+        paths.append(p)
+    return paths
+
+
+def _artifact_digests(outdir):
+    name = os.path.basename(os.path.abspath(outdir))
+    out = {}
+    for suffix in (".bed.gz", ".roc", ".ped"):
+        p = os.path.join(outdir, f"{name}-indexcov{suffix}")
+        data = open(p, "rb").read()
+        if suffix == ".bed.gz":
+            data = gzip.decompress(data)
+        out[suffix] = hashlib.sha256(data).hexdigest()
+    return out
+
+
+# --------------------------------------------- one-shot byte parity
+
+@pytest.mark.parametrize("extra_normalize", [False, True])
+def test_chunked_scan_matches_indexcov_bytes(tmp_path,
+                                             extra_normalize):
+    paths = _make_cohort(tmp_path)
+    ref = str(tmp_path / "oneshot")
+    run_indexcov(paths, ref, sex="X,Y",
+                 extra_normalize=extra_normalize, write_png=False)
+    got_dir = str(tmp_path / "oneshot")  # same dir NAME ⇒ same header
+    got_dir = str(tmp_path / "scan" / "oneshot")
+    res = run_cohortscan(paths, got_dir, sex="X,Y",
+                         extra_normalize=extra_normalize,
+                         chunk_samples=3)
+    assert _artifact_digests(got_dir) == _artifact_digests(ref)
+    assert res["qc"] == {"computed": 7 * 3, "resumed": 0}
+    man = CohortManifest.load(res["manifest"])
+    assert [s["path"] for s in man.samples] == paths
+    assert all(s["name"] for s in man.samples)
+
+
+def test_chunk_size_does_not_change_bytes(tmp_path):
+    paths = _make_cohort(tmp_path, n=6, seed=3)
+    digests = set()
+    for size in (1, 5, 6):
+        d = str(tmp_path / f"c{size}" / "out")
+        run_cohortscan(paths, d, chunk_samples=size,
+                       extra_normalize=True)
+        digests.add(tuple(sorted(_artifact_digests(d).items())))
+    assert len(digests) == 1
+
+
+# ------------------------------------------------- incrementality
+
+def test_append_k_computes_exactly_k_columns(tmp_path):
+    paths = _make_cohort(tmp_path, n=9, seed=11)
+    out = str(tmp_path / "inc" / "out")
+    first = run_cohortscan(paths[:7], out, chunk_samples=3)
+    n_chroms = len(first["chrom_names"])
+    assert first["qc"] == {"computed": 7 * n_chroms, "resumed": 0}
+
+    # append 2 samples: exactly 2 per-sample columns per chromosome
+    # recompute; everything else resumes from the store
+    second = run_cohortscan(paths, out, chunk_samples=3, resume=True)
+    assert second["qc"] == {"computed": 2 * n_chroms,
+                            "resumed": 7 * n_chroms}
+    assert second["diff"]["new"] == paths[7:]
+    assert second["diff"]["unchanged"] == paths[:7]
+    man = CohortManifest.load(second["manifest"])
+    assert man.counters["chrom_qc_samples_computed_total"] \
+        == 2 * n_chroms
+    assert man.counters["samples_new"] == 2
+
+    # the incremental result is byte-identical to a fresh one-shot
+    ref = str(tmp_path / "fresh" / "out")
+    run_cohortscan(paths, ref, chunk_samples=9)
+    assert _artifact_digests(out) == _artifact_digests(ref)
+
+
+def test_changed_input_invalidates_only_itself(tmp_path):
+    paths = _make_cohort(tmp_path, n=5, seed=23)
+    out = str(tmp_path / "chg" / "out")
+    first = run_cohortscan(paths, out, chunk_samples=2)
+    n_chroms = len(first["chrom_names"])
+
+    # rewrite one sample (new content ⇒ new file_key): only its own
+    # blocks stop matching
+    rng = np.random.default_rng(99)
+    reads = random_reads(rng, 2500, 0, LENS[0])
+    reads += random_reads(rng, 900, 1, LENS[1])
+    write_bam_and_bai(paths[2], reads, ref_names=REFS, ref_lens=LENS,
+                      header_text=_header("sM2"))
+    second = run_cohortscan(paths, out, chunk_samples=2, resume=True)
+    assert second["diff"]["changed"] == [paths[2]]
+    assert second["qc"] == {"computed": 1 * n_chroms,
+                            "resumed": 4 * n_chroms}
+
+
+def test_foreign_manifest_is_rejected_loudly(tmp_path):
+    p = str(tmp_path / "m.json")
+    with open(p, "w") as f:
+        json.dump({"format": "something-else/9", "params": {},
+                   "samples": []}, f)
+    with pytest.raises(ValueError, match=FORMAT):
+        CohortManifest.load(p)
+
+
+def test_param_drift_invalidation_is_exactly_scoped(tmp_path):
+    paths = _make_cohort(tmp_path, n=5, seed=31)
+    out = str(tmp_path / "drift" / "out")
+    run_cohortscan(paths, out, chunk_samples=5)
+    # flipping extra_normalize changes the normalization-scalars
+    # signature in each AUTOSOME block's key (chr1 here) — those
+    # recompute; the sex chromosomes never normalize, so their blocks
+    # are genuinely unchanged and resume. Key-scoped invalidation,
+    # not a blanket flush.
+    second = run_cohortscan(paths, out, chunk_samples=5, resume=True,
+                            extra_normalize=True)
+    assert second["qc"] == {"computed": 5, "resumed": 10}
+
+
+# ------------------------------------------------ crash-resume (CLI)
+
+def test_sigkill_mid_scan_then_resume_byte_identical(tmp_path):
+    """SIGKILL the scan subprocess mid-QC (deterministic injected
+    kill), then --resume: artifacts byte-identical to an uninterrupted
+    run and the manifest counters prove only the tail recomputed."""
+    paths = _make_cohort(tmp_path, n=7, seed=17)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("GOLEFT_TPU_FAULTS", None)
+
+    ref = str(tmp_path / "cold" / "out")
+    run_cohortscan(paths, ref, chunk_samples=3)
+
+    out = str(tmp_path / "kill" / "out")
+    ck = str(tmp_path / "kill" / "ck")
+    base = [sys.executable, "-m", "goleft_tpu", "cohortscan",
+            "-d", out, "--chunk-samples", "3",
+            "--checkpoint-dir", ck]
+    kill = subprocess.run(
+        base + ["--inject-faults", "shard:after=4:kill"] + paths,
+        env=env, capture_output=True, timeout=300)
+    assert kill.returncode in (-9, 137), kill.stderr.decode()
+    # the kill fires ON the 4th dispatch (the first X chunk), so the
+    # three chr1 chunks' blocks — chunk sizes (3, 3, 1) — committed
+    committed = sum(1 for _ in open(os.path.join(ck,
+                                                 "journal.jsonl")))
+    assert committed == 7
+
+    res = subprocess.run(base + ["--resume"] + paths, env=env,
+                         capture_output=True, timeout=300)
+    assert res.returncode == 0, res.stderr.decode()
+    assert _artifact_digests(out) == _artifact_digests(ref)
+    name = os.path.basename(out)
+    man = CohortManifest.load(
+        os.path.join(out, name + "-indexcov.manifest.json"))
+    assert man.counters["chrom_qc_samples_resumed_total"] == committed
+    assert man.counters["chrom_qc_samples_computed_total"] \
+        == 7 * 3 - committed
+
+
+# --------------------------------------------------- serve executor
+
+def test_serve_executor_validation(tmp_path):
+    from goleft_tpu.serve.executors import (
+        BadRequest, CohortscanExecutor,
+    )
+
+    paths = _make_cohort(tmp_path, n=2, seed=41)
+    fai = str(tmp_path / "ref.fai")
+    with open(fai, "w") as f:
+        for n, l in zip(REFS, LENS):
+            f.write(f"{n}\t{l}\t0\t60\t61\n")
+    ex = CohortscanExecutor(2, None)
+    with pytest.raises(BadRequest, match="checkpoint-root"):
+        ex.validate({"bams": paths, "fai": fai, "checkpoint": True})
+    with pytest.raises(BadRequest, match="no such file"):
+        ex.validate({"bams": paths + ["/nope.bam"], "fai": fai})
+    with pytest.raises(BadRequest, match="chunk_samples"):
+        ex.validate({"bams": paths, "fai": fai, "chunk_samples": 0})
+    ex.validate({"bams": paths, "fai": fai})
+
+
+def test_serve_executor_checkpointed_append(tmp_path):
+    """The service-side incremental story: same params + appended
+    samples hit the SAME parameter-keyed store, so the second request
+    computes only the new samples' blocks."""
+    import base64
+
+    from goleft_tpu.serve.executors import CohortscanExecutor
+
+    paths = _make_cohort(tmp_path, n=5, seed=53)
+    fai = str(tmp_path / "ref.fai")
+    with open(fai, "w") as f:
+        for n, l in zip(REFS, LENS):
+            f.write(f"{n}\t{l}\t0\t60\t61\n")
+    ex = CohortscanExecutor(
+        2, None, checkpoint_root=str(tmp_path / "ckroot"))
+    first = ex.run([{"bams": paths[:4], "fai": fai,
+                     "checkpoint": True, "chunk_samples": 2}])[0]
+    n_chroms = len(first["chroms"])
+    assert first["qc"] == {"computed": 4 * n_chroms, "resumed": 0}
+    second = ex.run([{"bams": paths, "fai": fai, "checkpoint": True,
+                      "chunk_samples": 2}])[0]
+    assert second["qc"] == {"computed": 1 * n_chroms,
+                            "resumed": 4 * n_chroms}
+    assert second["diff"] == {"new": 1, "changed": 0, "unchanged": 4,
+                              "removed": 0}
+    bed = gzip.decompress(base64.b64decode(second["bed_gz_b64"]))
+    assert bed.startswith(b"#chrom\tstart\tend\t")
+    assert second["roc"].startswith("#chrom\tcov\t")
+    assert len(second["ped"].splitlines()) == 6  # header + 5 samples
+
+
+def test_cli_registration():
+    from goleft_tpu.cli import PROGS
+
+    assert "cohortscan" in PROGS

@@ -100,6 +100,52 @@ def test_corrupt_ledger_line_raises_with_location(tmp_path):
         ledger.read_ledger(str(lp))
 
 
+def test_cohort_scan_bench_entry_flattens_to_live_record():
+    """Ingestion of the biobank ``cohort_scan`` bench entry (ISSUE-17
+    satellite): the nested monolithic/chunked/incremental legs flatten
+    to dotted metrics, config keys and the note stay out, and the
+    cpu-pinned legs classify as host provenance."""
+    entry = {
+        "samples": 16, "chromosomes": 3, "chunk_samples": 4,
+        "platform": "cpu",
+        "monolithic": {"seconds": 0.97, "samples_per_sec": 16.5,
+                       "peak_rss_mb": 205.1},
+        "chunked": {"seconds": 1.03, "samples_per_sec": 15.6,
+                    "peak_rss_mb": 205.7},
+        "incremental_append": {"seconds": 0.96,
+                               "samples_per_sec": 4.2,
+                               "samples_appended": 4,
+                               "qc_computed": 12, "qc_resumed": 36},
+        "peak_rss_delta_mb": 0.6,
+        "note": "per-leg subprocess ru_maxrss",
+    }
+    (rec,) = ledger.live_run_records({"cohort_scan": entry}, None)
+    assert rec["entry"] == "cohort_scan" and rec["kind"] == "live"
+    assert rec["provenance"] == "host" and not rec["stale"]
+    m = rec["metrics"]
+    assert m["monolithic.samples_per_sec"] == 16.5
+    assert m["chunked.peak_rss_mb"] == 205.7
+    assert m["incremental_append.qc_computed"] == 12.0
+    assert m["peak_rss_delta_mb"] == 0.6
+    assert "note" not in m and "samples" not in m
+
+
+def test_cohort_scan_is_in_the_committed_ledger():
+    """The seeded PERF_LEDGER.jsonl carries a cohort_scan record with
+    all three legs' samples/s plus the peak-RSS delta."""
+    recs = [r for r in ledger.read_ledger(
+        os.path.join(REPO, "PERF_LEDGER.jsonl"))
+        if r["entry"] == "cohort_scan"]
+    assert recs, "cohort_scan missing from committed PERF_LEDGER"
+    m = recs[-1]["metrics"]
+    for key in ("monolithic.samples_per_sec",
+                "chunked.samples_per_sec",
+                "incremental_append.samples_per_sec",
+                "peak_rss_delta_mb"):
+        assert key in m, key
+    assert recs[-1]["schema"] == ledger.LEDGER_SCHEMA
+
+
 # ---------------- sentinel classification: table-driven ----------
 
 

@@ -407,6 +407,24 @@ def test_parse_nx16_rejects_inconsistencies():
     assert p.table_bytes > 0
 
 
+def test_order1_column_compaction_shrinks_table_with_parity():
+    """ORDER1 context rows ship compacted on BOTH axes: a 40-ish
+    symbol quality-like alphabet pays n_ctx² int16 cells instead of
+    n_ctx·256 — ~5x less wire table — and the device decode stays
+    byte-identical through the alphabet indirection."""
+    rng = np.random.default_rng(20)
+    data = bytes(rng.integers(33, 74, 6000, dtype=np.uint8))
+    p = rx.parse_nx16(rx.encode(data, order=1))
+    assert p is not None and p.order1
+    assert p.ctx_freq.shape == (p.n_ctx, p.n_ctx)
+    assert p.alphabet.shape == (p.n_ctx,)
+    # every row maps back onto the full 256-wide matrix the host
+    # decoder builds: column k is symbol alphabet[k]
+    uncompacted_rows = p.n_ctx * 256 * 2 + 256 * 2
+    assert p.table_bytes < uncompacted_rows // 4
+    assert rd.decode_parsed([p]) == [data]
+
+
 def test_host_vectorized_loop_exactness():
     """The all-N-states-per-round numpy loop is byte-identical to the
     per-symbol scalar loop — including the intra-round renorm order
