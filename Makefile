@@ -7,7 +7,7 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        fleet-obs-smoke federation-chaos decode-smoke \
+        fleet-obs-smoke federation-chaos profile-smoke decode-smoke \
         dataplane-smoke biobank-smoke perf-gate \
         lint lint-changed lint-ci plan-lint check clean
 
@@ -173,6 +173,18 @@ fleet-obs-smoke:
 federation-chaos:
 	python -m goleft_tpu.fleet.federation_smoke
 
+# compile observatory + sampling profiler end-to-end: a real fleet
+# (router + one supervised worker at --profile-hz 50) serves traced
+# depth requests; /fleet/profile merges a non-empty window with
+# goleft_tpu frames, /debug/compiles shows the cold depth dispatch as
+# a ranked signature, `goleft-tpu warmup export` writes a validating
+# manifest whose top signature is that hot bucket, and a SIGKILL-
+# restarted worker's observatory proves the signature would cold-miss
+# there — the exact miss a prewarmer consumes the manifest to
+# prevent. Host-pinned like the other smokes.
+profile-smoke:
+	python -m goleft_tpu.obs.profile_smoke
+
 # object-store data plane end-to-end: the same CRAM/BAM cohorts staged
 # in a loopback stub object store — cohortdepth/depth/indexcov CLIs
 # byte-identical over https:// URLs vs local paths (--prefetch-depth
@@ -200,7 +212,7 @@ biobank-smoke:
 # the test suite, then the end-to-end proofs
 check: lint plan-lint test decode-smoke dataplane-smoke \
        biobank-smoke fleet-smoke fleet-chaos fleet-obs-smoke \
-       federation-chaos
+       federation-chaos profile-smoke
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -102,6 +102,12 @@ PROGS = {
     "federation": ("multi-fleet failover tier with tenant-scoped "
                    "overload isolation",
                    _lazy(".commands.federation"), False),
+    # pure HTTP clients over the observability surfaces — no device
+    "warmup": ("export the compile observatory's warmup manifest "
+               "from a live worker or router",
+               _lazy(".commands.warmup"), False),
+    "profile": ("collect + render a fleet-wide sampling CPU profile",
+                _lazy(".commands.profile_cmd"), False),
 }
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",

@@ -104,6 +104,15 @@ def main(argv=None) -> int:
                         "cohortdepth requests with checkpoint: true "
                         "commit per-region shards under this "
                         "directory and resume across daemon restarts")
+    p.add_argument("--profile-hz", type=float, default=0.0,
+                   help="sampling-profiler rate (0 = off): enables "
+                        "GET /debug/profile?seconds=N collected at "
+                        "this frequency")
+    p.add_argument("--warmup-manifest", default=None,
+                   help="write the compile observatory's warmup "
+                        "manifest (goleft-tpu.warmup-manifest/1) to "
+                        "this path at drain — merged into any "
+                        "existing manifest there")
     a = p.parse_args(argv)
 
     from .. import obs
@@ -129,7 +138,8 @@ def main(argv=None) -> int:
                    breaker_cooldown_s=a.breaker_cooldown_s,
                    checkpoint_root=a.checkpoint_root,
                    batch_mode=a.batch_mode,
-                   cache_shared=a.cache_shared)
+                   cache_shared=a.cache_shared,
+                   profile_hz=a.profile_hz)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)
@@ -166,6 +176,21 @@ def main(argv=None) -> int:
     t.join()
     httpd.server_close()  # joins in-flight handler threads
     app.close(drain=True)
+    if a.warmup_manifest:
+        # after close(): every dispatch has finished, the stats table
+        # is final — merge-on-update into any manifest already there
+        from ..obs.compiles import build_warmup_manifest, \
+            save_warmup_manifest
+
+        try:
+            save_warmup_manifest(
+                a.warmup_manifest,
+                build_warmup_manifest(app.compiles.stats()))
+            print(f"goleft-tpu serve: warmup manifest written to "
+                  f"{a.warmup_manifest}", file=sys.stderr, flush=True)
+        except (OSError, ValueError) as e:
+            print(f"goleft-tpu serve: warmup manifest write failed: "
+                  f"{e}", file=sys.stderr, flush=True)
     print("goleft-tpu serve: drained, bye", file=sys.stderr,
           flush=True)
     return 0

@@ -879,6 +879,51 @@ class FederationRouter:
         stitched["perfetto"] = perfetto_export(trace_id, stitched)
         return 200, stitched
 
+    def fleet_profile(self, seconds: float) -> dict:
+        """``GET /fleet/profile`` one level up: each fleet's merged
+        rollup collected IN PARALLEL (overlapping windows, same as
+        the fleet router over its workers) and merged stack-wise —
+        exact sums compose across tiers."""
+        from urllib.parse import quote
+
+        from ..obs.profiler import MAX_WINDOW_S, merge_profiles
+
+        seconds = max(0.0, min(float(seconds), MAX_WINDOW_S))
+        urls = sorted(self.pool.fleets)
+        bodies: list[dict | None] = [None] * len(urls)
+        errors: dict[str, str] = {}
+
+        def fetch(i: int, url: str) -> None:
+            req = urllib.request.Request(
+                url + f"/fleet/profile?seconds={quote(str(seconds))}",
+                headers={"Accept": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=seconds + 20.0) as r:
+                    bodies[i] = json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 — per-fleet fault
+                errors[url] = str(e)
+
+        threads: list[threading.Thread] = []
+        for i, url in enumerate(urls):
+            t = threading.Thread(target=fetch, args=(i, url),
+                                 name=f"goleft-fed-profile-{i}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=seconds + 40.0)
+        merged = merge_profiles([b for b in bodies if b is not None])
+        merged["seconds"] = seconds
+        merged["per_fleet"] = {
+            url: ({"error": errors[url]} if url in errors else {
+                "samples_total":
+                    int((bodies[i] or {}).get("samples_total") or 0),
+                "stacks": len((bodies[i] or {}).get("stacks") or {}),
+            })
+            for i, url in enumerate(urls)
+        }
+        return merged
+
 
 class _FederationHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -933,6 +978,16 @@ class _FederationHandler(BaseHTTPRequestHandler):
             trace_id = unquote(u.path[len("/fleet/trace/"):])
             code, body = self.app.fleet_trace(trace_id)
             self._respond_json(code, body)
+        elif u.path == "/fleet/profile":
+            q = parse_qs(u.query)
+            try:
+                seconds = float(q["seconds"][0]) \
+                    if "seconds" in q else 1.0
+            except ValueError:
+                self._respond_json(
+                    400, {"error": "seconds must be a number"})
+                return
+            self._respond_json(200, self.app.fleet_profile(seconds))
         else:
             self._respond_json(404,
                                {"error": f"no route {self.path}"})

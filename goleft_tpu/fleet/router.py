@@ -1051,6 +1051,94 @@ class RouterApp:
         stitched["perfetto"] = perfetto_export(trace_id, stitched)
         return 200, stitched
 
+    def fleet_profile(self, seconds: float) -> dict:
+        """``GET /fleet/profile?seconds=N``: collect every worker's
+        ``/debug/profile`` window IN PARALLEL (the windows must
+        overlap — serial collection would profile N disjoint
+        intervals) and merge stack-wise: each merged counter is the
+        exact arithmetic sum of the workers' counters, the PR-13
+        metrics-rollup discipline. A dead or profiling-disabled
+        worker cannot veto the rest — it is reported per-worker and
+        counted (``fleet.profile.worker_errors_total``)."""
+        from urllib.parse import quote
+
+        from ..obs.profiler import MAX_WINDOW_S, merge_profiles
+
+        seconds = max(0.0, min(float(seconds), MAX_WINDOW_S))
+        self.registry.counter("fleet.profile.requests_total").inc()
+        urls = sorted(self.pool.workers)
+        bodies: list[dict | None] = [None] * len(urls)
+        errors: dict[str, str] = {}
+
+        def fetch(i: int, url: str) -> None:
+            # a dedicated request, NOT pool._fetch_json: the worker
+            # intentionally sleeps the whole window before answering,
+            # which would blow the pool's short poll timeout
+            req = urllib.request.Request(
+                url + f"/debug/profile?seconds={quote(str(seconds))}",
+                headers={"Accept": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=seconds + 10.0) as r:
+                    bodies[i] = json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 — per-worker fault
+                errors[url] = str(e)
+
+        threads: list[threading.Thread] = []
+        for i, url in enumerate(urls):
+            t = threading.Thread(target=fetch, args=(i, url),
+                                 name=f"goleft-fleet-profile-{i}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=seconds + 30.0)
+        if errors:
+            self.registry.counter(
+                "fleet.profile.worker_errors_total").inc(len(errors))
+        merged = merge_profiles([b for b in bodies if b is not None])
+        merged["seconds"] = seconds
+        merged["per_worker"] = {
+            url: ({"error": errors[url]} if url in errors else {
+                "samples_total":
+                    int((bodies[i] or {}).get("samples_total") or 0),
+                "stacks": len((bodies[i] or {}).get("stacks") or {}),
+                "enabled":
+                    bool((bodies[i] or {}).get("enabled")),
+            })
+            for i, url in enumerate(urls)
+        }
+        return merged
+
+    def fleet_compiles(self) -> dict:
+        """``GET /fleet/compiles``: every worker's compile observatory
+        merged into one fleet-wide warmup manifest (merge-on-update
+        semantics — per-signature tallies sum across workers)."""
+        from ..obs.compiles import (
+            WARMUP_SCHEMA, merge_warmup_docs, validate_warmup_manifest,
+        )
+
+        manifests = []
+        per_worker: dict[str, dict] = {}
+        for url in sorted(self.pool.workers):
+            try:
+                d = self.pool._fetch_json(url + "/debug/compiles")
+                m = {"schema": WARMUP_SCHEMA,
+                     "signatures": d.get("signatures") or []}
+                validate_warmup_manifest(m)
+                manifests.append(m)
+                per_worker[url] = {
+                    "events_total": int(d.get("events_total") or 0),
+                    "compiles_total":
+                        int(d.get("compiles_total") or 0),
+                    "signatures": len(m["signatures"]),
+                }
+            except Exception as e:  # noqa: BLE001 — per-worker fault
+                per_worker[url] = {"error": str(e)}
+        merged = merge_warmup_docs(*manifests) if manifests \
+            else {"schema": WARMUP_SCHEMA, "signatures": []}
+        merged["per_worker"] = per_worker
+        return merged
+
 
 class _RouterHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -1117,6 +1205,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
             trace_id = unquote(u.path[len("/fleet/trace/"):])
             code, body = self.app.fleet_trace(trace_id)
             self._respond_json(code, body)
+        elif u.path == "/fleet/profile":
+            q = parse_qs(u.query)
+            try:
+                seconds = float(q["seconds"][0]) \
+                    if "seconds" in q else 1.0
+            except ValueError:
+                self._respond_json(
+                    400, {"error": "seconds must be a number"})
+                return
+            self._respond_json(200, self.app.fleet_profile(seconds))
+        elif u.path == "/fleet/compiles":
+            self._respond_json(200, self.app.fleet_compiles())
         elif u.path == "/fleet/cache/" or u.path == "/fleet/cache":
             code, body = self.app.cache_list()
             self._respond_json(code, body)

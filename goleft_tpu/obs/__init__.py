@@ -136,8 +136,16 @@ class InstrumentedDispatch:
         self.__doc__ = getattr(fn, "__doc__", None)
 
     def __call__(self, *args, **kwargs):
-        return dispatch(self._obs_name, self.__wrapped__,
-                        *args, **kwargs)
+        if _under_jit_trace():
+            return self.__wrapped__(*args, **kwargs)
+        from .compiles import TRACKER, family_of_dispatch
+
+        cache_size = getattr(self.__wrapped__, "_cache_size", None)
+        with TRACKER.observe(family_of_dispatch(self._obs_name),
+                             cache_size_fn=cache_size,
+                             trigger="dispatch"):
+            return dispatch(self._obs_name, self.__wrapped__,
+                            *args, **kwargs)
 
     def __getattr__(self, item):
         return getattr(self.__wrapped__, item)

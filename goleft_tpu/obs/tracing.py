@@ -106,6 +106,10 @@ class Tracer:
         # dispatch pipelines keep their overlap when nobody is looking
         self.device_events = bool(
             os.environ.get("GOLEFT_TPU_DEVICE_EVENTS"))
+        # thread ident -> trace id for threads currently inside
+        # trace(): the sampling profiler reads this to tag stacks
+        # taken during a traced request with that request's id
+        self._active_traces: dict[int, str] = {}
 
     # ---- trace scoping ----
 
@@ -133,14 +137,29 @@ class Tracer:
             else self.new_trace_id(kind)
         if remote_parent is not None:
             attrs = dict(attrs, remote_parent=remote_parent)
+        ident = threading.get_ident()
+        with self._lock:
+            self._active_traces[ident] = self._ctx.trace_id
         try:
             with self.span(name, **attrs) as root:
                 yield root
         finally:
+            with self._lock:
+                if prev is not None:
+                    self._active_traces[ident] = prev
+                else:
+                    self._active_traces.pop(ident, None)
             self._ctx.trace_id = prev
 
     def current_trace_id(self) -> str | None:
         return self._ctx.trace_id
+
+    def active_traces(self) -> dict[int, str]:
+        """Snapshot of {thread ident: trace id} for every thread
+        currently inside :meth:`trace` — how the sampling profiler
+        ties a stack sample back to the request it interrupted."""
+        with self._lock:
+            return dict(self._active_traces)
 
     # ---- span recording ----
 
@@ -176,6 +195,42 @@ class Tracer:
                     cb(sp)
                 except Exception:  # noqa: BLE001 — a broken listener
                     pass           # must never fail the traced work
+
+    def record_span(self, name: str, t0: float, t1: float,
+                    category: str = "", **attrs) -> Span:
+        """Record an already-measured interval as a completed span.
+
+        The compile observatory discovers a compile only after the
+        fact (cache-size delta / jax log record at observation exit),
+        so it cannot open a ``with span()`` around it; this records
+        the measured [t0, t1] perf_counter window post hoc, parented
+        to this thread's innermost open span — the compile lands
+        inside the device stage that triggered it in the flight tree.
+        """
+        th = threading.current_thread()
+        parent = self._ctx.stack[-1] if self._ctx.stack else None
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent is not None else None,
+            trace_id=self._ctx.trace_id or f"proc-{os.getpid()}",
+            t0=t0,
+            t1=t1,
+            attrs=dict(attrs) if attrs else {},
+            thread_id=th.ident or 0,
+            thread_name=th.name,
+            category=category,
+        )
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.spans_dropped += 1
+            self._spans.append(sp)
+        for cb in self._listeners:
+            try:
+                cb(sp)
+            except Exception:  # noqa: BLE001 — a broken listener
+                pass           # must never fail the recorded work
+        return sp
 
     # ---- completed-span listeners ----
 

@@ -391,10 +391,23 @@ def forward_pairs_partial(reads, quals, haps, *,
                               r_pad, h_pad, dtype)
         key = ("pairhmm", r_pad, h_pad, len(idxs))
 
-        def thunk(packed=packed):
-            contribs, shifts = obs.dispatch(
-                "pairhmm_forward", _forward_bucket, *packed,
-                trans, rescale=rescale)
+        def thunk(packed=packed, r_pad=r_pad, h_pad=h_pad):
+            from ..obs.compiles import TRACKER
+
+            # exact per-bucket compile attribution: the jit object's
+            # own cache size is the ground truth for this geometry
+            with TRACKER.observe(
+                    "pairhmm",
+                    signature={"r_pad": r_pad, "h_pad": h_pad,
+                               "rescale": rescale,
+                               "dtype": dtype.name},
+                    cache_size_fn=lambda: getattr(
+                        _FORWARD_JIT, "_cache_size", lambda: 0)()
+                    if _FORWARD_JIT is not None else 0,
+                    trigger="pairhmm_forward"):
+                contribs, shifts = obs.dispatch(
+                    "pairhmm_forward", _forward_bucket, *packed,
+                    trans, rescale=rescale)
             return np.asarray(contribs), np.asarray(shifts)
 
         reg.counter("pairhmm.buckets_total").inc()

@@ -665,33 +665,46 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
             dev = {k: jax.device_put(v) for k, v in host.items()}
         else:
             dev = stage(host)
+        from ..obs.compiles import TRACKER
+
+        # exact per-bucket compile attribution against the shared jit
+        # object's own cache (one geometry = one cache entry)
+        jit_fn = _jitted()
+        cache_size = getattr(jit_fn, "_cache_size", None)
         if backend == "pallas" and not cat and not order1:
             # the experimental kernel covers the ORDER0 rANS stage;
             # ORDER1 buckets take the XLA scan either way
             lit = _pallas_scan_bytes(grp, n, rounds, p_cap, interpret)
             # expansions reuse the XLA stages by re-entering as CAT
             # with the scan's output as payload
-            out, diag = _jitted()(
-                lit, dev["plen"], dev["states"], dev["freq"],
-                dev["inner"], dev["rle_tab"], dev["runs"],
-                dev["rle_out"], dev["pmap"], dev["bits"],
-                dev["final"], dev["ctx_index"], dev["ctx_freq"],
-                dev["alphabet"],
-                rounds=0, n_states=n, cat=True,
-                rle=rle, pack=pack, order1=False, shift=TF_SHIFT,
-                n_ctx_cap=n_ctx_cap, lit_cap=lit.shape[1],
-                mid_cap=mid_cap, out_cap=out_cap)
+            with TRACKER.observe("rans", signature=sig,
+                                 cache_size_fn=cache_size,
+                                 trigger="rans_decode"):
+                out, diag = jit_fn(
+                    lit, dev["plen"], dev["states"], dev["freq"],
+                    dev["inner"], dev["rle_tab"], dev["runs"],
+                    dev["rle_out"], dev["pmap"], dev["bits"],
+                    dev["final"], dev["ctx_index"], dev["ctx_freq"],
+                    dev["alphabet"],
+                    rounds=0, n_states=n, cat=True,
+                    rle=rle, pack=pack, order1=False, shift=TF_SHIFT,
+                    n_ctx_cap=n_ctx_cap, lit_cap=lit.shape[1],
+                    mid_cap=mid_cap, out_cap=out_cap)
         else:
-            out, diag = _jitted()(
-                dev["payload"], dev["plen"], dev["states"],
-                dev["freq"], dev["inner"],
-                dev["rle_tab"], dev["runs"], dev["rle_out"],
-                dev["pmap"], dev["bits"], dev["final"],
-                dev["ctx_index"], dev["ctx_freq"], dev["alphabet"],
-                rounds=rounds, n_states=n, cat=cat, rle=rle,
-                pack=pack, order1=order1, shift=shift,
-                n_ctx_cap=n_ctx_cap, lit_cap=lit_cap,
-                mid_cap=mid_cap, out_cap=out_cap)
+            with TRACKER.observe("rans", signature=sig,
+                                 cache_size_fn=cache_size,
+                                 trigger="rans_decode"):
+                out, diag = jit_fn(
+                    dev["payload"], dev["plen"], dev["states"],
+                    dev["freq"], dev["inner"],
+                    dev["rle_tab"], dev["runs"], dev["rle_out"],
+                    dev["pmap"], dev["bits"], dev["final"],
+                    dev["ctx_index"], dev["ctx_freq"],
+                    dev["alphabet"],
+                    rounds=rounds, n_states=n, cat=cat, rle=rle,
+                    pack=pack, order1=order1, shift=shift,
+                    n_ctx_cap=n_ctx_cap, lit_cap=lit_cap,
+                    mid_cap=mid_cap, out_cap=out_cap)
         out = np.asarray(out)
         diag = np.asarray(diag)
         for j, (i, p) in enumerate(zip(idxs, grp)):

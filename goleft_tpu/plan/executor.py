@@ -313,12 +313,18 @@ def run_device_step(name: str, fn, *, key=None, metrics=None,
     """
     import contextlib
 
+    from ..obs.compiles import TRACKER, family_of_dispatch
+
     def staged():
         if metrics is None:
             cm = contextlib.nullcontext()
         else:
             cm = metrics.timer.stage("compute")
-        with cm:
+        # the compile observation runs INSIDE the device span the
+        # Executor opens around this fn, so a jit miss surfaced here
+        # lands as a nested xla.compile.<family> span in flight trees
+        with cm, TRACKER.observe(family_of_dispatch(name),
+                                 trigger=name):
             return fn()
 
     ex = Executor(policy=policy if policy is not None

@@ -37,6 +37,7 @@ Routes:
   POST /v1/pairhmm      {input, candidates?, gap_open?, gap_ext?,
                          f64?}
   GET  /healthz         GET /metrics        GET /debug/flight
+  GET  /debug/compiles  GET /debug/profile?seconds=N
 """
 
 from __future__ import annotations
@@ -87,7 +88,8 @@ class ServeApp:
                  breaker_cooldown_s: float = 30.0,
                  checkpoint_root: str | None = None,
                  batch_mode: str = "continuous",
-                 cache_shared: bool = False):
+                 cache_shared: bool = False,
+                 profile_hz: float = 0.0):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
@@ -103,6 +105,16 @@ class ServeApp:
         self.flight = FlightRecorder(max_records=flight_records)
         self._tracer = obs.get_tracer()
         self._tracer.add_listener(self.flight.on_span)
+        # sampling profiler (--profile-hz; hz=0 → disabled, no
+        # thread) + the compile observatory behind /debug/compiles —
+        # both publish into this app's registry/tracer
+        from ..obs.compiles import get_tracker
+        from ..obs.profiler import SamplingProfiler
+
+        self.compiles = get_tracker()
+        self.profiler = SamplingProfiler(
+            hz=profile_hz, registry=self.metrics.registry,
+            tracer=self._tracer).start()
         self.executors = {
             ex.kind: ex for ex in (
                 DepthExecutor(processes, self.metrics),
@@ -423,6 +435,7 @@ class ServeApp:
                 return
             self._closed = True
         self.batcher.close(drain=drain)
+        self.profiler.close()
         self._tracer.remove_listener(self.flight.on_span)
 
 
@@ -489,6 +502,21 @@ class _Handler(BaseHTTPRequestHandler):
             kind = q["kind"][0] if "kind" in q else None
             self._respond(200, self.app.flight.to_dict(
                 n, trace_id=trace_id, kind=kind))
+        elif u.path == "/debug/compiles":
+            self._respond(200, self.app.compiles.to_doc())
+        elif u.path == "/debug/profile":
+            q = parse_qs(u.query)
+            try:
+                seconds = float(q["seconds"][0]) \
+                    if "seconds" in q else 1.0
+            except ValueError:
+                self._respond(
+                    400, {"error": "seconds must be a number"})
+                return
+            # collect-then-respond: this handler thread sleeps the
+            # window (clamped to MAX_WINDOW_S inside collect) while
+            # the sampler keeps running, then ships the delta
+            self._respond(200, self.app.profiler.collect(seconds))
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 

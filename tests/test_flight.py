@@ -51,6 +51,41 @@ def test_flight_records_span_tree_newest_first():
     assert all(c["duration_ms"] >= 0 for c in batch["children"])
 
 
+def test_flight_tree_nests_compile_span_under_device_stage():
+    """A jit cache miss observed during a device dispatch shows up in
+    the flight tree as an ``xla.compile.<family>`` child of the device
+    stage span — 'this request was slow because it compiled' is
+    readable straight off /debug/flight."""
+    from goleft_tpu.obs.compiles import CompileTracker
+    from goleft_tpu.obs.metrics import MetricsRegistry
+
+    tracer = obs.get_tracer()
+    ct = CompileTracker(registry=MetricsRegistry(), tracer=tracer)
+    fr = FlightRecorder(max_records=4)
+    tracer.add_listener(fr.on_span)
+    cache = {"n": 0}
+    try:
+        with tracer.trace("batch.depth", kind="serve-batch",
+                          status=200):
+            with tracer.span("device.depth.dispatch",
+                             category="device"):
+                with ct.observe("depth", signature=(64, 128),
+                                cache_size_fn=lambda: cache["n"],
+                                trigger="dispatch"):
+                    cache["n"] += 1  # the cold dispatch compiled
+    finally:
+        tracer.remove_listener(fr.on_span)
+    (rec,) = fr.snapshot()
+    assert rec["name"] == "batch.depth"
+    (dev,) = rec["children"]
+    assert dev["name"] == "device.depth.dispatch"
+    (comp,) = dev["children"]
+    assert comp["name"] == "xla.compile.depth"
+    assert comp["attrs"]["compiles"] == 1
+    assert comp["attrs"]["signature"] == "[64,128]"
+    assert rec["span_count"] == 3
+
+
 def test_flight_ignores_cli_traces_and_bounds_ring():
     tracer = obs.get_tracer()
     fr = FlightRecorder(max_records=3)
