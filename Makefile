@@ -7,8 +7,8 @@ SRC = csrc/fastio.cpp
 
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
-        fleet-obs-smoke federation-chaos profile-smoke decode-smoke \
-        dataplane-smoke biobank-smoke perf-gate \
+        fleet-obs-smoke federation-chaos profile-smoke memory-smoke \
+        decode-smoke dataplane-smoke biobank-smoke perf-gate \
         lint lint-changed lint-ci plan-lint check clean
 
 native: build/libgoleftio.so
@@ -185,6 +185,16 @@ federation-chaos:
 profile-smoke:
 	python -m goleft_tpu.obs.profile_smoke
 
+# memory-plane leak sentinel: RSS bounded over >= 3 sampling windows
+# while allocate/free rounds churn, a device family's live bytes
+# return to baseline when its buffer dies, a deliberate hog trips the
+# pressure band (real 503 + retry_after_s over HTTP) and recovers,
+# and a fleet supervisor recycles a worker over --mem-recycle-mb with
+# the memory_recycle event visible through the real events CLI.
+# Host-pinned like the other smokes.
+memory-smoke:
+	python -m goleft_tpu.obs.memory_smoke
+
 # object-store data plane end-to-end: the same CRAM/BAM cohorts staged
 # in a loopback stub object store — cohortdepth/depth/indexcov CLIs
 # byte-identical over https:// URLs vs local paths (--prefetch-depth
@@ -212,7 +222,7 @@ biobank-smoke:
 # the test suite, then the end-to-end proofs
 check: lint plan-lint test decode-smoke dataplane-smoke \
        biobank-smoke fleet-smoke fleet-chaos fleet-obs-smoke \
-       federation-chaos profile-smoke
+       federation-chaos profile-smoke memory-smoke
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -108,6 +108,9 @@ PROGS = {
                _lazy(".commands.warmup"), False),
     "profile": ("collect + render a fleet-wide sampling CPU profile",
                 _lazy(".commands.profile_cmd"), False),
+    "memory": ("render the host/device memory observatory of a "
+               "worker or fleet",
+               _lazy(".commands.memory_cmd"), False),
 }
 
 _VALUE_FLAGS = {"--trace-out": "trace_out",

@@ -115,6 +115,12 @@ class _SpillStore:
         shutil.rmtree(self.root, ignore_errors=True)
 
 
+# spill-matrix budget for --chunk-samples 0 (auto): one chunk's raw
+# matrices should fit here; the bound is advisory (auto_chunk_samples
+# clamps to [8, 4096]), not an allocator limit
+AUTO_CHUNK_BUDGET_BYTES = 256 * 1024 * 1024
+
+
 def run_cohortscan(
     bams: list[str],
     directory: str,
@@ -132,8 +138,10 @@ def run_cohortscan(
     pca_exact_max: int = PCA_EXACT_MAX,
 ) -> dict:
     os.makedirs(directory, exist_ok=True)
-    if chunk_samples < 1:
-        raise ValueError("cohortscan: --chunk-samples must be >= 1")
+    if chunk_samples < 0:
+        raise ValueError(
+            "cohortscan: --chunk-samples must be >= 1, or 0 to "
+            "auto-size from measured per-sample bytes")
     if pca_mode not in ("auto", "exact", "sharded"):
         raise ValueError(f"cohortscan: unknown pca mode {pca_mode!r}")
     sex_chroms = [s for s in sex.split(",") if s] if sex else []
@@ -143,8 +151,8 @@ def run_cohortscan(
     bams = ic.expand_globs(bams)
     refs = ic.references(bams, fai, chrom)
     n_samples = len(bams)
-    log.info("cohortscan: %d samples in chunks of %d", n_samples,
-             chunk_samples)
+    log.info("cohortscan: %d samples in chunks of %s", n_samples,
+             chunk_samples or "auto")
 
     name = os.path.basename(os.path.abspath(directory))
     base = os.path.join(directory, name + "-indexcov")
@@ -185,6 +193,41 @@ def run_cohortscan(
 
     timer = StageTimer()
 
+    # prior run's per-chunk high-water mark (journaled via note());
+    # reported back so a --resume run knows what its predecessor
+    # actually paid without re-measuring
+    prior_peak = (int(store.meta.get("chunk_peak_bytes") or 0)
+                  if resume else 0)
+    if prior_peak:
+        log.info("cohortscan: prior run peaked at %d bytes/chunk",
+                 prior_peak)
+
+    if chunk_samples == 0:
+        # auto-size: journaled measurement from the prior run when
+        # resuming, else probe one sample's index and extrapolate
+        from ..obs.memplane import auto_chunk_samples
+
+        per_sample = int(store.meta.get("per_sample_bytes") or 0)
+        src = "journal"
+        if per_sample <= 0 and bams:
+            with timer.stage("chunk_probe"):
+                try:
+                    probe = ic.SampleIndex(bams[0])
+                except ValueError as e:
+                    raise SystemExit(f"cohortscan: {bams[0]}: {e}")
+                per_sample = sum(
+                    int(np.asarray(probe.normalized_depth(rid)).nbytes)
+                    for rid, rname, _ in refs
+                    if exclude is None or not exclude.search(rname))
+                del probe
+            src = "probe"
+        chunk_samples = auto_chunk_samples(
+            per_sample, AUTO_CHUNK_BUDGET_BYTES, n_samples)
+        log.info(
+            "cohortscan: auto chunk size %d (%s: %d bytes/sample, "
+            "budget %d)", chunk_samples, src, per_sample,
+            AUTO_CHUNK_BUDGET_BYTES)
+
     # ---- pass 1: chunked index parse + raw spills + norm stats ----
     chunks = [(lo, min(lo + chunk_samples, n_samples))
               for lo in range(0, n_samples, chunk_samples)]
@@ -205,6 +248,8 @@ def run_cohortscan(
         except ValueError as e:
             raise SystemExit(f"cohortscan: {p}: {e}")
 
+    chunk_peak_bytes = 0
+    spilled_bytes = 0
     for ci, (lo, hi) in enumerate(chunks):
         with timer.stage("index_load"):
             with cf.ThreadPoolExecutor(max_workers=8) as tex:
@@ -214,6 +259,7 @@ def run_cohortscan(
         for off, idx in enumerate(idxs):
             mapped[lo + off] = idx.mapped
             unmapped[lo + off] = idx.unmapped
+        cbytes = 0
         with timer.stage("spill"):
             for rid, rname, _rlen in refs:
                 if exclude is not None and exclude.search(rname):
@@ -222,10 +268,22 @@ def run_cohortscan(
                 mat, _valid, lens = ic._pad_rows(rows)
                 lengths_by_ref[rid][lo:hi] = lens
                 spill.put(rid, ci, "raw", mat)
+                cbytes += int(mat.nbytes)
                 st = stats_by_ref.get(rid)
                 if st is not None:
                     st.accumulate(mat, lens)
+        chunk_peak_bytes = max(chunk_peak_bytes, cbytes)
+        spilled_bytes += cbytes
         del idxs
+
+    # journal the measured footprint (fsync'd {"meta": ...} line): a
+    # --resume run reads it back (store.meta) to report the prior
+    # high-water mark and to size auto chunks from evidence instead
+    # of a probe
+    per_sample_bytes = (spilled_bytes // n_samples) if n_samples else 0
+    store.note(chunk_peak_bytes=chunk_peak_bytes,
+               per_sample_bytes=per_sample_bytes,
+               chunk_samples=chunk_samples)
 
     # ---- pass 2 + emission ----
     bed_fh = open(base + ".bed.gz", "wb")
@@ -455,6 +513,10 @@ def run_cohortscan(
         "chrom_names": chrom_names,
         "diff": diff,
         "qc": {"computed": qc_computed, "resumed": qc_resumed},
+        "memory": {"chunk_samples": chunk_samples,
+                   "chunk_peak_bytes": chunk_peak_bytes,
+                   "per_sample_bytes": per_sample_bytes,
+                   "prior_chunk_peak_bytes": prior_peak},
         "stages": {k: round(v, 3) for k, v in timer.totals.items()},
     }
 

@@ -43,7 +43,8 @@ def main(argv=None):
                         "CRAI); streamed, byte-identical to indexcov")
     p.add_argument("--chunk-samples", type=int, default=256,
                    help="samples per streaming chunk (peak memory is "
-                        "O(chunk x bins); default 256)")
+                        "O(chunk x bins); default 256; 0 = auto-size "
+                        "from measured per-sample bytes)")
     p.add_argument("--manifest", default=None,
                    help="cohort manifest path (default: "
                         "<dir>/<name>-indexcov.manifest.json) — the "

@@ -87,7 +87,8 @@ def events_main(argv) -> int:
                    metavar="TYPE",
                    help="only events of this type (spawn, restart, "
                         "death, backoff, hang_kill, quarantine, "
-                        "scale_up, scale_down, drain, ...)")
+                        "scale_up, scale_down, drain, "
+                        "memory_recycle, ...)")
     p.add_argument("--json", action="store_true",
                    help="emit the schema-stable JSON document "
                         "(goleft-tpu.fleet-events/1) instead of the "
@@ -259,6 +260,13 @@ def main(argv=None) -> int:
     obsg.add_argument("--error-budget", type=float, default=0.01,
                       help="allowed windowed 5xx fraction the burn "
                            "rate is computed against")
+    obsg.add_argument("--mem-recycle-mb", type=float, default=0.0,
+                      help="memory hard cap per worker: a healthy "
+                           "worker whose /debug/memory RSS exceeds "
+                           "this is drained and recycled (a "
+                           "memory_recycle event in the journal) "
+                           "before the kernel OOM killer acts "
+                           "(0 disables)")
     a = p.parse_args(argv)
 
     if a.workers <= 0 and not a.worker:
@@ -294,7 +302,8 @@ def main(argv=None) -> int:
             spawn_timeout_s=a.spawn_timeout_s,
             shared_cache=a.shared_cache,
             events_journal=a.events_journal,
-            burn_threshold=a.burn_threshold)
+            burn_threshold=a.burn_threshold,
+            mem_recycle_bytes=int(a.mem_recycle_mb * 1024 * 1024))
         try:
             urls = supervisor.spawn_initial(a.workers)
         except WorkerSpawnError as e:

@@ -108,6 +108,21 @@ def main(argv=None) -> int:
                    help="sampling-profiler rate (0 = off): enables "
                         "GET /debug/profile?seconds=N collected at "
                         "this frequency")
+    p.add_argument("--mem-sample-interval-s", type=float, default=0.0,
+                   help="memory-plane sampling interval (0 = no "
+                        "sampler thread; GET /debug/memory still "
+                        "answers on demand)")
+    p.add_argument("--mem-high-water-mb", type=float, default=0.0,
+                   help="arm the memory pressure controller: while "
+                        "RSS is above this, POST admissions shed "
+                        "with 503 + retry_after_s (0 = disabled)")
+    p.add_argument("--mem-low-water-mb", type=float, default=0.0,
+                   help="recovery threshold of the pressure band "
+                        "(default 80%% of the high water mark)")
+    p.add_argument("--mem-trace", action="store_true",
+                   help="run tracemalloc and ship top allocation "
+                        "sites in /debug/memory (real overhead — "
+                        "diagnostics only)")
     p.add_argument("--warmup-manifest", default=None,
                    help="write the compile observatory's warmup "
                         "manifest (goleft-tpu.warmup-manifest/1) to "
@@ -139,7 +154,13 @@ def main(argv=None) -> int:
                    checkpoint_root=a.checkpoint_root,
                    batch_mode=a.batch_mode,
                    cache_shared=a.cache_shared,
-                   profile_hz=a.profile_hz)
+                   profile_hz=a.profile_hz,
+                   mem_sample_interval_s=a.mem_sample_interval_s,
+                   mem_high_water_bytes=int(
+                       a.mem_high_water_mb * 1024 * 1024),
+                   mem_low_water_bytes=int(
+                       a.mem_low_water_mb * 1024 * 1024),
+                   mem_trace=a.mem_trace)
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)

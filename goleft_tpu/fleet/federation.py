@@ -924,6 +924,32 @@ class FederationRouter:
         }
         return merged
 
+    def fleet_memory(self) -> dict:
+        """``GET /fleet/memory`` one level up: each fleet's merged
+        memory document combined tier-wise (counter sums stay exact,
+        gauge aggregates compose min/max/sum) — the federation's
+        numbers equal a flat merge over every worker. Instant
+        collection, so serial fetch like the fleet router's."""
+        from ..obs.memplane import merge_merged_memory
+
+        bodies: list[dict] = []
+        per_fleet: dict[str, dict] = {}
+        for url in sorted(self.pool.fleets):
+            try:
+                d = self.pool._fetch_json(url + "/fleet/memory")
+                bodies.append(d)
+                per_fleet[url] = {
+                    "workers": int(d.get("workers") or 0),
+                    "workers_in_pressure":
+                        int(d.get("workers_in_pressure") or 0),
+                    "enabled": bool(d.get("enabled")),
+                }
+            except Exception as e:  # noqa: BLE001 — per-fleet fault
+                per_fleet[url] = {"error": str(e)}
+        merged = merge_merged_memory(bodies)
+        merged["per_fleet"] = per_fleet
+        return merged
+
 
 class _FederationHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -988,6 +1014,23 @@ class _FederationHandler(BaseHTTPRequestHandler):
                     400, {"error": "seconds must be a number"})
                 return
             self._respond_json(200, self.app.fleet_profile(seconds))
+        elif u.path == "/fleet/memory":
+            q = parse_qs(u.query)
+            fmt = q.get("format", [""])[0]
+            accept = self.headers.get("Accept", "")
+            if fmt in ("prom", "prometheus") or (
+                    not fmt and "text/plain" in accept
+                    and "json" not in accept):
+                from ..obs import prometheus
+                from ..obs.memplane import flatten_merged
+                from ..obs.prometheus import CONTENT_TYPE
+
+                self._respond_raw(
+                    200, prometheus.render(flatten_merged(
+                        self.app.fleet_memory())).encode(),
+                    content_type=CONTENT_TYPE)
+            else:
+                self._respond_json(200, self.app.fleet_memory())
         else:
             self._respond_json(404,
                                {"error": f"no route {self.path}"})

@@ -1139,6 +1139,56 @@ class RouterApp:
         merged["per_worker"] = per_worker
         return merged
 
+    def fleet_memory(self) -> dict:
+        """``GET /fleet/memory``: every worker's ``/debug/memory``
+        merged — counters as EXACT arithmetic sums of the worker
+        bodies (pinned by test, JSON and prom encodings both),
+        gauges as per-worker {min, max, sum}, device family bytes
+        summed family-wise. Collection is instant (each worker
+        answers from current state, no window to overlap), so the
+        serial /fleet/compiles pattern is right here; a dead worker
+        is reported per-worker and counted
+        (``fleet.memory.worker_errors_total``) but cannot veto the
+        merge."""
+        from ..obs.memplane import merge_memory
+
+        bodies: list[dict] = []
+        per_worker: dict[str, dict] = {}
+        n_err = 0
+        for url in sorted(self.pool.workers):
+            try:
+                d = self.pool._fetch_json(url + "/debug/memory")
+                bodies.append(d)
+                per_worker[url] = {
+                    "rss_bytes": int((d.get("host") or {})
+                                     .get("rss_bytes") or 0),
+                    "device_live_bytes":
+                        int((d.get("device") or {})
+                            .get("total_bytes") or 0),
+                    "pressure": (d.get("pressure") or {})
+                    .get("state") or "?",
+                    "enabled": bool(d.get("enabled")),
+                }
+            except Exception as e:  # noqa: BLE001 — per-worker fault
+                per_worker[url] = {"error": str(e)}
+                n_err += 1
+        if n_err:
+            self.registry.counter(
+                "fleet.memory.worker_errors_total").inc(n_err)
+        merged = merge_memory(bodies)
+        merged["per_worker"] = per_worker
+        return merged
+
+    def fleet_memory_prometheus(self) -> str:
+        """The same merged document as Prometheus text exposition:
+        counter sums ride verbatim (``memory_*_total`` lines ARE the
+        exact worker sums), gauges flatten to ``_min/_max/_sum``
+        series."""
+        from ..obs import prometheus
+        from ..obs.memplane import flatten_merged
+
+        return prometheus.render(flatten_merged(self.fleet_memory()))
+
 
 class _RouterHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -1217,6 +1267,25 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._respond_json(200, self.app.fleet_profile(seconds))
         elif u.path == "/fleet/compiles":
             self._respond_json(200, self.app.fleet_compiles())
+        elif u.path == "/fleet/memory":
+            q = parse_qs(u.query)
+            fmt = q.get("format", [""])[0]
+            accept = self.headers.get("Accept", "")
+            if fmt in ("prom", "prometheus") or (
+                    not fmt and "text/plain" in accept
+                    and "json" not in accept):
+                from ..obs.prometheus import CONTENT_TYPE
+
+                data = self.app.fleet_memory_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+                self.close_connection = True
+            else:
+                self._respond_json(200, self.app.fleet_memory())
         elif u.path == "/fleet/cache/" or u.path == "/fleet/cache":
             code, body = self.app.cache_list()
             self._respond_json(code, body)

@@ -194,7 +194,8 @@ class Supervisor:
                  queue_age_fn=None,
                  events_journal: str | None = None,
                  burn_threshold: float = 0.0,
-                 burn_rate_fn=None):
+                 burn_rate_fn=None,
+                 mem_recycle_bytes: int = 0):
         if min_workers < 1:
             raise ValueError(
                 f"min_workers must be >= 1 (got {min_workers})")
@@ -240,6 +241,12 @@ class Supervisor:
         # router's fleet_burn_rate at bind() time.
         self.burn_threshold = burn_threshold
         self.burn_rate_fn = burn_rate_fn
+        # the memory hard cap (--mem-recycle-mb; 0 disables): a
+        # healthy worker whose /debug/memory RSS exceeds it is
+        # drained and recycled DELIBERATELY — before the kernel OOM
+        # killer picks a victim — and the recycle does not count
+        # toward the crash window (it is maintenance, not a death)
+        self.mem_recycle_bytes = int(mem_recycle_bytes)
         # the structured event journal: every lifecycle transition,
         # fsync'd per append (obs/events.py — the checkpoint journal's
         # durability protocol), plus the bounded in-memory ring the
@@ -446,6 +453,7 @@ class Supervisor:
             return
         if self._healthz_ok(slot):
             slot.health_misses = 0
+            self._check_memory(slot, now)
             return
         slot.health_misses += 1
         if slot.health_misses < self.hang_after:
@@ -469,6 +477,62 @@ class Supervisor:
         except subprocess.TimeoutExpired:
             pass
         self._on_death(slot, now, "hung (healthz timeout)")
+
+    def _worker_rss(self, slot: WorkerSlot) -> int | None:
+        """The worker's current RSS from ``/debug/memory`` (always
+        answers, sampler thread or not), or None on any failure —
+        a worker too wedged to report memory is the hang path's
+        business, not the recycler's."""
+        try:
+            req = urllib.request.Request(
+                slot.url + "/debug/memory",
+                headers={"Accept": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self.hang_timeout_s) as r:
+                d = json.loads(r.read().decode())
+            return int((d.get("host") or {}).get("rss_bytes") or 0)
+        except Exception:  # noqa: BLE001 — no verdict, no recycle
+            return None
+
+    def _check_memory(self, slot: WorkerSlot, now: float) -> None:
+        if self.mem_recycle_bytes <= 0:
+            return
+        rss = self._worker_rss(slot)
+        if rss is None or rss <= self.mem_recycle_bytes:
+            return
+        self._recycle_for_memory(slot, rss, now)
+
+    def _recycle_for_memory(self, slot: WorkerSlot, rss_bytes: int,
+                            now: float) -> None:
+        """Drain-and-recycle a worker past the memory hard cap: the
+        scale-down drain choreography (no new traffic, in-flight
+        forwards finish, ring removal, SIGTERM so the worker's own
+        drain runs) followed by an immediate respawn through the
+        restart path. Emits ``memory_recycle`` to the fsync'd event
+        journal; deliberately NOT a death — the crash window stays
+        untouched, a leaky worker must not quarantine its slot."""
+        url = slot.url
+        slot.state = DRAINING
+        self.registry.counter("memory.recycles_total").inc()
+        self.events.emit(
+            "memory_recycle", slot=slot.index, worker=url,
+            pid=slot.proc.pid if slot.proc else None,
+            rss_bytes=rss_bytes, cap_bytes=self.mem_recycle_bytes)
+        log.warning(
+            "fleet: slot %d worker %s rss %d bytes exceeds the "
+            "%d-byte recycle cap — drain + recycle", slot.index,
+            url, rss_bytes, self.mem_recycle_bytes)
+        if self.app is not None:
+            self.app.drain_worker(url)
+            deadline = time.monotonic() + self.drain_timeout_s
+            while self.app.pool.inflight(url) > 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            self.app.remove_worker(url)
+        self._terminate(slot)
+        slot.state = RESTARTING
+        slot.next_attempt_at = now  # no backoff: planned maintenance
+        self._update_capacity()
 
     def _healthz_ok(self, slot: WorkerSlot) -> bool:
         try:

@@ -139,11 +139,17 @@ class InstrumentedDispatch:
         if _under_jit_trace():
             return self.__wrapped__(*args, **kwargs)
         from .compiles import TRACKER, family_of_dispatch
+        from .memplane import TRACKER as MEM_TRACKER
 
+        family = family_of_dispatch(self._obs_name)
         cache_size = getattr(self.__wrapped__, "_cache_size", None)
-        with TRACKER.observe(family_of_dispatch(self._obs_name),
+        # the memory plane shares this seam: buffers born during the
+        # dispatch are attributed to its family (a bare yield until a
+        # sampler arms the tracker)
+        with TRACKER.observe(family,
                              cache_size_fn=cache_size,
-                             trigger="dispatch"):
+                             trigger="dispatch"), \
+                MEM_TRACKER.observe(family):
             return dispatch(self._obs_name, self.__wrapped__,
                             *args, **kwargs)
 

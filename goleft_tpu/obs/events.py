@@ -43,7 +43,7 @@ EVENTS_NAME = "events.jsonl"
 #: the reader filters by string equality — but these are the contract)
 EVENT_TYPES = ("spawn", "restart", "death", "backoff", "hang_kill",
                "quarantine", "scale_up", "scale_down", "drain",
-               "spawn_failure", "stop")
+               "spawn_failure", "stop", "memory_recycle")
 
 
 class EventJournal:

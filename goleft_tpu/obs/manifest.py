@@ -32,7 +32,7 @@ REQUIRED_KEYS = ("schema", "ts", "argv", "env", "backend", "spans",
 #: itself changed and old readers must refuse loudly.
 SCHEMA_PREFIX = "goleft-tpu.run-manifest/"
 SCHEMA_MAJOR = 1
-SCHEMA = f"{SCHEMA_PREFIX}1.2"
+SCHEMA = f"{SCHEMA_PREFIX}1.3"
 
 #: subsystem-contributed manifest sections (1.2): name -> provider().
 #: A provider returning None omits its section; a raising provider

@@ -106,6 +106,13 @@ class Tracer:
         # dispatch pipelines keep their overlap when nobody is looking
         self.device_events = bool(
             os.environ.get("GOLEFT_TPU_DEVICE_EVENTS"))
+        # when the memory plane arms it (obs.memplane.MemorySampler.
+        # start), a zero-arg callable returning current RSS bytes:
+        # every span then carries mem_delta_bytes / mem_peak_bytes
+        # attributes (manifest 1.3). None — the default — keeps spans
+        # byte-identical to every earlier round: the Perfetto goldens
+        # of unsampled runs must not change.
+        self.mem_probe = None
         # thread ident -> trace id for threads currently inside
         # trace(): the sampling profiler reads this to tag stacks
         # taken during a traced request with that request's id
@@ -169,6 +176,10 @@ class Tracer:
         trace root when the stack is empty)."""
         th = threading.current_thread()
         parent = self._ctx.stack[-1] if self._ctx.stack else None
+        # captured once: close() may disarm the probe mid-span, and a
+        # delta needs both readings from the same probe
+        probe = self.mem_probe
+        rss0 = probe() if probe is not None else 0
         sp = Span(
             name=name,
             span_id=next(self._ids),
@@ -185,6 +196,13 @@ class Tracer:
             yield sp
         finally:
             sp.t1 = time.perf_counter()
+            if probe is not None:
+                rss1 = probe()
+                # boundary-observed: delta across the span, peak of
+                # the two readings (a spike inside the span shows in
+                # the sampler's rss_peak gauge, not here)
+                sp.attrs["mem_delta_bytes"] = rss1 - rss0
+                sp.attrs["mem_peak_bytes"] = max(rss0, rss1)
             self._ctx.stack.pop()
             with self._lock:
                 if len(self._spans) == self._spans.maxlen:

@@ -150,7 +150,16 @@ class ChunkPrefetcher:
         return value
 
     def _top_up(self) -> None:
-        while len(self._pending) < self.depth:
+        # memory backpressure: while any armed pressure controller in
+        # this process is tripped (obs.memplane — the serve daemon
+        # registers its band), staging clamps to ONE in-flight chunk
+        # instead of the configured depth. Already-staged chunks keep
+        # draining; the clamp only stops NEW allocations until RSS
+        # recovers below the low-water mark.
+        from ..obs.memplane import under_pressure
+
+        depth = 1 if under_pressure() else self.depth
+        while len(self._pending) < depth:
             try:
                 index, meta = next(self._meta)
             except StopIteration:

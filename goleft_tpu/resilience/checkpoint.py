@@ -126,6 +126,10 @@ class CheckpointStore:
         os.makedirs(self._blocks, exist_ok=True)
         self._journal_path = os.path.join(directory, JOURNAL_NAME)
         self._completed: dict[str, str] = {}  # keyhash -> block relpath
+        # run metadata journaled via note(): merged key-wise on
+        # replay, so a --resume run reads what its predecessor
+        # measured (cohortscan's per-chunk peak bytes) for free
+        self.meta: dict = {}
         self._lock = threading.Lock()
         reg = get_registry()
         self._c_written = reg.counter("checkpoint.shards_written_total")
@@ -151,6 +155,10 @@ class CheckpointStore:
                 on_torn=lambda: log.warning(
                     "journal %s: ignoring torn line",
                     self._journal_path)):
+            m = rec.get("meta")
+            if isinstance(m, dict):
+                self.meta.update(m)  # later lines win
+                continue
             rel = rec.get("f")
             kh = rec.get("k")
             if not kh or not rel:
@@ -226,6 +234,25 @@ class CheckpointStore:
                 self._completed[kh] = rel
         self._c_written.inc(len(entries))
         self._c_commits.inc()  # one fsync'd journal append group
+
+    def note(self, **fields) -> None:
+        """Durably append run metadata as a ``{"meta": {...}}``
+        journal line — no block, no key, same fsync discipline as a
+        commit. Lines merge key-wise on replay (later lines win), so
+        a ``--resume`` run reads what its predecessor measured
+        instead of re-measuring; readers from before this revision
+        skip the lines entirely (replay ignores records without
+        k/f)."""
+        if not fields:
+            return
+        with self._lock:
+            if self._fh.closed:
+                return
+            self._fh.write(json.dumps({"meta": fields},
+                                      sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.meta.update(fields)
 
     def put(self, key, value) -> None:
         """Atomically persist one block and commit it to the journal."""
@@ -310,6 +337,14 @@ class DeferredCommits:
     @property
     def dir(self) -> str:
         return self.store.dir
+
+    @property
+    def meta(self) -> dict:
+        return self.store.meta
+
+    def note(self, **fields) -> None:
+        # metadata lines are rare (one per run phase) — no batching
+        self.store.note(**fields)
 
     # ---- commits ----
 

@@ -38,6 +38,7 @@ Routes:
                          f64?}
   GET  /healthz         GET /metrics        GET /debug/flight
   GET  /debug/compiles  GET /debug/profile?seconds=N
+  GET  /debug/memory
 """
 
 from __future__ import annotations
@@ -89,7 +90,11 @@ class ServeApp:
                  checkpoint_root: str | None = None,
                  batch_mode: str = "continuous",
                  cache_shared: bool = False,
-                 profile_hz: float = 0.0):
+                 profile_hz: float = 0.0,
+                 mem_sample_interval_s: float = 0.0,
+                 mem_high_water_bytes: int = 0,
+                 mem_low_water_bytes: int = 0,
+                 mem_trace: bool = False):
         # registry=None → a private obs.MetricsRegistry (test/app
         # isolation); the serve CLI passes the process-global one so
         # the daemon's counters join the unified namespace
@@ -115,6 +120,22 @@ class ServeApp:
         self.profiler = SamplingProfiler(
             hz=profile_hz, registry=self.metrics.registry,
             tracer=self._tracer).start()
+        # memory plane (--mem-sample-interval-s; 0 → no thread, but
+        # /debug/memory still answers on demand). --mem-high-water-mb
+        # arms the pressure controller: while RSS is above the band,
+        # POST admissions shed with 503 + retry_after_s until it
+        # recovers below the low water mark. Registered process-wide
+        # so the prefetch staging pipeline can read the same state.
+        from ..obs import memplane as _memplane
+
+        self.memplane = _memplane.MemorySampler(
+            interval_s=mem_sample_interval_s,
+            registry=self.metrics.registry, tracer=self._tracer,
+            high_water_bytes=mem_high_water_bytes,
+            low_water_bytes=mem_low_water_bytes,
+            trace_top=_memplane.TRACE_TOP_N if mem_trace else 0,
+        ).start()
+        _memplane.register_controller(self.memplane.pressure)
         self.executors = {
             ex.kind: ex for ex in (
                 DepthExecutor(processes, self.metrics),
@@ -257,6 +278,17 @@ class ServeApp:
             return 404, {"error": f"unknown endpoint {kind!r}"}
         t0 = time.perf_counter()
         self.metrics.inc(f"requests_total.{kind}")
+        pressure = self.memplane.pressure
+        if pressure.should_shed():
+            # memory pressure sheds like a drain, not like an error:
+            # admissions are best-effort while RSS sits above the
+            # high-water band, and the hint tells a retry-aware
+            # client to ride out the hysteresis window
+            self.metrics.registry.counter("memory.sheds_total").inc()
+            return 503, {
+                "error": "server under memory pressure (rss above "
+                         f"{pressure.high_water_bytes} bytes)",
+                "retry_after_s": pressure.retry_after_s}
         breaker = self.breakers.get(kind)
         if breaker is not None and not breaker.allow():
             # tripped: shed immediately — no queue slot, no device
@@ -436,6 +468,10 @@ class ServeApp:
             self._closed = True
         self.batcher.close(drain=drain)
         self.profiler.close()
+        from ..obs import memplane as _memplane
+
+        self.memplane.close()
+        _memplane.unregister_controller(self.memplane.pressure)
         self._tracer.remove_listener(self.flight.on_span)
 
 
@@ -517,6 +553,8 @@ class _Handler(BaseHTTPRequestHandler):
             # window (clamped to MAX_WINDOW_S inside collect) while
             # the sampler keeps running, then ships the delta
             self._respond(200, self.app.profiler.collect(seconds))
+        elif u.path == "/debug/memory":
+            self._respond(200, self.app.memplane.snapshot())
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 

@@ -190,9 +190,12 @@ def test_sigkill_mid_scan_then_resume_byte_identical(tmp_path):
         env=env, capture_output=True, timeout=300)
     assert kill.returncode in (-9, 137), kill.stderr.decode()
     # the kill fires ON the 4th dispatch (the first X chunk), so the
-    # three chr1 chunks' blocks — chunk sizes (3, 3, 1) — committed
-    committed = sum(1 for _ in open(os.path.join(ck,
-                                                 "journal.jsonl")))
+    # three chr1 chunks' blocks — chunk sizes (3, 3, 1) — committed;
+    # count shard records only (the journal also carries {"meta": ...}
+    # footprint lines from pass 1)
+    committed = sum(
+        1 for line in open(os.path.join(ck, "journal.jsonl"))
+        if line.strip() and "\"k\"" in line)
     assert committed == 7
 
     res = subprocess.run(base + ["--resume"] + paths, env=env,
@@ -264,3 +267,55 @@ def test_cli_registration():
     from goleft_tpu.cli import PROGS
 
     assert "cohortscan" in PROGS
+
+
+# -------------------------------------- memory plane: chunk sizing
+
+def test_auto_chunk_sizing_measures_and_journals_bytes(tmp_path):
+    """``--chunk-samples 0``: the chunk size comes from measured
+    per-sample bytes, the per-chunk peak lands in the checkpoint
+    journal meta, and byte-identity with an explicit chunking
+    holds."""
+    paths = _make_cohort(tmp_path)
+    ref = str(tmp_path / "explicit")
+    run_cohortscan(paths, ref, chunk_samples=3)
+    out = str(tmp_path / "auto")
+    res = run_cohortscan(paths, out, chunk_samples=0)
+    mem = res["memory"]
+    # 7 tiny samples fit any budget -> one chunk (the clamp's floor
+    # of 8 already covers the whole cohort)
+    assert mem["chunk_samples"] >= len(paths)
+    assert mem["chunk_peak_bytes"] > 0
+    assert mem["per_sample_bytes"] > 0
+    assert mem["prior_chunk_peak_bytes"] == 0  # first run: no prior
+    assert _artifact_digests(out) != {}
+    # the measurement survives into the fsync'd journal meta
+    ck = os.path.join(out, ".cohortscan-ck")
+    metas = [json.loads(line)["meta"]
+             for line in open(os.path.join(ck, "journal.jsonl"))
+             if line.strip() and "\"meta\"" in line]
+    assert metas
+    merged = {}
+    for m in metas:
+        merged.update(m)
+    assert merged["chunk_peak_bytes"] == mem["chunk_peak_bytes"]
+    assert merged["per_sample_bytes"] == mem["per_sample_bytes"]
+
+
+def test_resume_reports_prior_runs_peak_bytes(tmp_path):
+    """A resumed scan replays the journal meta and reports the PRIOR
+    run's high-water mark — the crash-forensics breadcrumb for sizing
+    the retry."""
+    paths = _make_cohort(tmp_path)
+    out = str(tmp_path / "scan")
+    first = run_cohortscan(paths, out, chunk_samples=3)
+    peak = first["memory"]["chunk_peak_bytes"]
+    assert peak > 0
+    second = run_cohortscan(paths, out, chunk_samples=3, resume=True)
+    assert second["memory"]["prior_chunk_peak_bytes"] == peak
+
+
+def test_negative_chunk_samples_rejected(tmp_path):
+    with pytest.raises(ValueError, match="--chunk-samples"):
+        run_cohortscan(["x.bam"], str(tmp_path / "o"),
+                       chunk_samples=-1)

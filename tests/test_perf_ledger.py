@@ -436,6 +436,41 @@ def test_cohort_resume_overhead_entry_ingests(tmp_path):
     assert back[0]["metrics"]["overhead_frac"] == pytest.approx(0.019)
 
 
+def test_memory_overhead_entry_ingests(tmp_path):
+    """The memory-plane bench entry (memory_overhead) lands in the
+    ledger like any other host entry, its overhead_frac classifies as
+    info (the sentinel never flags a sampler-cost trend as a perf
+    regression), and it round-trips through the on-disk ledger."""
+    details = {
+        "memory_overhead": {
+            "interval_s": 0.01, "seconds_off": 0.61,
+            "seconds_on": 0.613, "overhead_frac": 0.005,
+            "samples": 58, "platform": "cpu",
+            "note": "numpy depth pipeline with/without 10ms memory "
+                    "sampling; budget <=1%",
+        },
+    }
+    recs = ledger.live_run_records(details, None)
+    by_entry = {r["entry"]: r for r in recs}
+    rec = by_entry["memory_overhead"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("overhead_frac", "seconds_off", "seconds_on"):
+        assert key in rec["metrics"], key
+    # "samples" is a _CONFIG_KEYS exclusion (a count, not a metric)
+    assert "samples" not in rec["metrics"]
+    assert rec["metrics"]["overhead_frac"] == pytest.approx(0.005)
+    from goleft_tpu.obs.sentinel import metric_direction
+
+    assert metric_direction("memory_overhead",
+                            "overhead_frac") is None
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "memory_overhead"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["overhead_frac"] == pytest.approx(0.005)
+
+
 def test_pairhmm_forward_entry_ingests(tmp_path):
     """The pair-HMM bench entry (pairhmm_forward) lands in the ledger
     like any other entry: numeric leaves become metrics, the platform
