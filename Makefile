@@ -8,8 +8,8 @@ SRC = csrc/fastio.cpp
 .PHONY: native asan tsan test test-native-asan test-native-tsan \
         serve-smoke obs-smoke chaos-smoke pairhmm-smoke fleet-smoke \
         fleet-obs-smoke federation-chaos profile-smoke memory-smoke \
-        decode-smoke dataplane-smoke biobank-smoke perf-gate \
-        lint lint-changed lint-ci plan-lint check clean
+        decode-smoke dataplane-smoke biobank-smoke mapper-smoke \
+        perf-gate lint lint-changed lint-ci plan-lint check clean
 
 native: build/libgoleftio.so
 
@@ -218,11 +218,22 @@ dataplane-smoke:
 biobank-smoke:
 	python -m goleft_tpu.cohort.biobank_smoke
 
+# the read mapper end-to-end: `goleft-tpu map --depth-out` maps
+# >= 95% of 10k simulated 100-150bp reads to within +-5bp of their
+# simulated origin; the fused depth bed is byte-identical to a
+# --from-tuples re-derivation; a real serve daemon's /v1/map response
+# carries the CLI's exact tuple/depth bytes; an injected transient
+# fault at the map site retries to byte-identical tuples; and a FASTQ
+# corrupted mid-stream maps everything before the bad record,
+# quarantines the file and exits 3. Host-pinned like the other smokes.
+mapper-smoke:
+	python -m goleft_tpu.mapping.smoke
+
 # the check-style aggregate: static gates first (cheap, loud), then
 # the test suite, then the end-to-end proofs
 check: lint plan-lint test decode-smoke dataplane-smoke \
        biobank-smoke fleet-smoke fleet-chaos fleet-obs-smoke \
-       federation-chaos profile-smoke memory-smoke
+       federation-chaos profile-smoke memory-smoke mapper-smoke
 
 # pair-HMM stack end-to-end: emdepth exports CNV candidates
 # (--candidates-out), the pairhmm CLI genotypes the planted het site

@@ -70,6 +70,9 @@ PROGS = {
                 _lazy(".commands.cnveval_cmd"), False),
     "pairhmm": ("pair-HMM genotype likelihoods for candidate windows",
                 _lazy(".commands.pairhmm_cmd"), True),
+    "map": ("map FASTQ reads: minimizer seeding + banded "
+            "Smith-Waterman on device",
+            _lazy(".commands.map_cmd"), True),
     # bench manages its own device probe (subprocess, non-hanging) and
     # falls back to host mode itself — dispatch must not bring the
     # backend up first

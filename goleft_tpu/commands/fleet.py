@@ -237,6 +237,12 @@ def main(argv=None) -> int:
                           "of recompute; also advertised at "
                           "/fleet/cache for cross-fleet replication "
                           "(pushes require GOLEFT_TPU_FLEET_SECRET)")
+    sup.add_argument("--warmup", default=None, metavar="PATH",
+                     help="warmup manifest forwarded to every "
+                          "spawned worker (serve --warmup): workers "
+                          "— including supervisor restarts after a "
+                          "crash/preemption — pre-compile its top "
+                          "signatures before reporting healthy")
     sup.add_argument("--quarantine-manifest", default=None,
                      metavar="PATH",
                      help="write the slot-quarantine JSON manifest "
@@ -280,6 +286,11 @@ def main(argv=None) -> int:
     supervisor = None
     urls = [u for u in a.worker]
     worker_extra = shlex.split(a.worker_args)
+    if a.warmup:
+        # same pass-through pattern as --shared-cache: every spawn —
+        # initial, scale-up, or supervisor restart — gets the
+        # manifest, so a restarted worker comes back pre-compiled
+        worker_extra += ["--warmup", a.warmup]
     env = dict(os.environ)
     if a.workers > 0 and not a.no_supervise:
         from ..fleet.supervisor import Supervisor, WorkerSpawnError

@@ -65,6 +65,18 @@ def main(argv=None) -> int:
                    help="decode threads per batch")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the startup backend/compile warm pass")
+    p.add_argument("--warmup", default=None, metavar="PATH",
+                   help="pre-compile the top signatures of this "
+                        "warmup manifest (goleft-tpu.warmup-"
+                        "manifest/1, from `goleft-tpu warmup "
+                        "export`) before the port binds — a "
+                        "restarted worker rejoins the fleet without "
+                        "cold-missing its predecessor's hot "
+                        "programs")
+    p.add_argument("--warmup-top-k", type=int, default=8,
+                   help="how many top-ranked --warmup manifest "
+                        "signatures to pre-compile (default "
+                        "%(default)s)")
     p.add_argument("--flight-records", type=int, default=32,
                    help="flight-recorder ring size (span trees of "
                         "the most recent completed requests/batches; "
@@ -164,6 +176,18 @@ def main(argv=None) -> int:
     if not a.no_warmup:
         secs = app.warmup()
         print(f"goleft-tpu serve: warmup {secs:.2f}s", file=sys.stderr)
+    if a.warmup:
+        # manifest-driven pre-compile BEFORE the port binds: until
+        # this finishes the worker is invisible to /healthz pollers
+        # and the fleet keeps routing around it — readiness means
+        # "hot", not just "up"
+        from ..serve.warmstart import warm_start
+
+        counts = warm_start(a.warmup, top_k=a.warmup_top_k)
+        print(f"goleft-tpu serve: warmstart {counts['warmed']} "
+              f"pre-compiled, {counts['skipped']} skipped, "
+              f"{counts['failed']} failed in "
+              f"{counts['seconds']:.2f}s", file=sys.stderr)
     httpd = make_server(app, a.host, a.port)
     host, port = httpd.server_address[:2]
     print(f"goleft-tpu serve: listening on http://{host}:{port}",

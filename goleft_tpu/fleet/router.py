@@ -103,6 +103,7 @@ AFFINITY_FIELDS = {
     "cohortdepth": ("bams",),
     "cohortscan": ("bams",),
     "pairhmm": ("input",),
+    "map": ("fastq",),
 }
 
 

@@ -1,0 +1,18 @@
+"""FASTQ-native read mapping: minimizer seeding + banded SW extend.
+
+The subsystem that turns goleft-tpu end-to-end: FASTQ in, windowed
+coverage out, with no external aligner. ``index`` builds the (w,k)
+minimizer tables over a FASTA reference; ``pipeline`` runs batched
+reads through on-device seeding (hash → gather → chain) and the
+banded Smith-Waterman wavefront (ops/swalign.py), then emits the
+read-tuple stream the coverage kernels consume.
+"""
+
+from .index import (  # noqa: F401
+    DEFAULT_K, DEFAULT_MAX_OCC, DEFAULT_W, MinimizerIndex,
+    build_index, get_index,
+)
+from .pipeline import (  # noqa: F401
+    MapParams, MapResult, depth_bed_from_tuples, format_tuples,
+    map_reads, parse_tuples,
+)

@@ -22,7 +22,13 @@ boundaries:
      SIGKILLed, the supervisor restarts it, and the fresh worker's
      ``/debug/compiles`` shows NO depth compile for the exported top
      signature — exactly the cold start a prewarmer would spend the
-     manifest preventing.
+     manifest preventing (this leg is the control for leg 5).
+  5. **the prewarmer prevents it**: a second fleet starts with
+     ``--warmup <manifest>`` forwarded to its worker; before ANY
+     request the worker's ``/debug/compiles`` already holds the top
+     signature compiled (trigger ``warmstart``), and after replaying
+     the same depth traffic its compile tally has NOT grown while its
+     hits have — the restarted-worker cold miss of leg 4, eliminated.
 
 Run directly::
 
@@ -229,6 +235,94 @@ def _leg_restart_would_miss(router_url, top, verbose):
               "manifest predicts exactly this cold miss")
 
 
+def _find_sig(doc: dict, top: dict) -> dict | None:
+    for s in doc.get("signatures") or []:
+        if s["family"] == top["family"] \
+                and s["signature"] == top["signature"]:
+            return s
+    return None
+
+
+def _leg_prewarm_no_cold_miss(manifest_path, top, bam, fai, env,
+                              verbose):
+    """A fresh fleet started with --warmup holds the top signature
+    compiled BEFORE any request, and real traffic then hits it warm
+    (compiles flat, hits growing) — leg 4's cold miss, eliminated."""
+    from ..serve.client import ServeClient
+
+    router = subprocess.Popen(
+        [sys.executable, "-m", "goleft_tpu", "fleet",
+         "--port", "0", "--workers", "1",
+         "--poll-interval-s", "0.3", "--down-after", "1",
+         "--supervise-interval-s", "0.1",
+         "--hang-timeout-s", "5", "--restart-limit", "8",
+         "--warmup", manifest_path,
+         "--worker-args=--no-warmup"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = router.stdout.readline()
+        if "listening on " not in line:
+            raise RuntimeError(
+                f"prewarm router never announced: {line!r}")
+        url = line.rsplit("listening on ", 1)[1].strip()
+
+        def _healthy() -> int:
+            try:
+                return _get_json(url + "/healthz").get("healthy", 0)
+            except Exception:  # noqa: BLE001 — 503 while degraded
+                return -1
+
+        _wait_until(lambda: _healthy() == 1, 180.0,
+                    "the prewarmed worker healthy")
+        (worker_url,) = _worker_urls(url)
+        before = _find_sig(
+            _get_json(worker_url + "/debug/compiles"), top)
+        # the whole point: compiled at startup, before ANY request
+        if before is None or before["compiles"] < 1:
+            raise RuntimeError(
+                "prewarmed worker does not hold the top signature "
+                f"before traffic: {before} (want "
+                f"{top['family']}/{top['signature']} compiled)")
+        client = ServeClient(url, timeout_s=120.0, retries=2,
+                             retry_cap_s=2.0)
+        # replay the exact traffic shape that minted the signature
+        for w in (200, 201, 202):
+            r = client.depth(bam, fai=fai, window=w)
+            if not r.get("depth_bed"):
+                raise RuntimeError(
+                    "prewarmed depth request returned no bed")
+        after = _find_sig(
+            _get_json(worker_url + "/debug/compiles"), top)
+        if after["compiles"] != before["compiles"]:
+            raise RuntimeError(
+                "prewarmed worker COLD-MISSED the top signature: "
+                f"compiles {before['compiles']} -> "
+                f"{after['compiles']}")
+        if after["hits"] <= before["hits"]:
+            raise RuntimeError(
+                "replayed traffic never hit the prewarmed "
+                f"signature (hits {before['hits']} -> "
+                f"{after['hits']}) — the no-cold-miss assertion "
+                "would be vacuous")
+        if verbose:
+            print("profile-smoke: --warmup worker held "
+                  f"{top['family']}/{top['signature']} compiled "
+                  "before any request and served "
+                  f"{after['hits'] - before['hits']} warm hit(s) "
+                  "with zero new compiles — the leg-4 cold miss, "
+                  "eliminated")
+    finally:
+        if router.poll() is None:
+            router.send_signal(signal.SIGTERM)
+            try:
+                router.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                router.kill()
+                router.wait(timeout=10)
+        if router.stdout is not None:
+            router.stdout.close()
+
+
 def run_smoke(timeout_s: float = 600.0, verbose: bool = True) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     env = dict(os.environ,
@@ -277,6 +371,11 @@ def run_smoke(timeout_s: float = 600.0, verbose: bool = True) -> int:
                     router.wait(timeout=10)
             if router.stdout is not None:
                 router.stdout.close()
+        # leg 5 runs on its own fleet (started WITH --warmup), after
+        # the control fleet is fully torn down
+        _leg_prewarm_no_cold_miss(
+            os.path.join(d, "warmup-manifest.json"), top, bams[0],
+            fai, env, verbose)
         if time.monotonic() - t0 > timeout_s:
             raise RuntimeError(
                 f"profile-smoke exceeded its {timeout_s:g}s budget")

@@ -391,7 +391,8 @@ def forward_pairs_partial(reads, quals, haps, *,
                               r_pad, h_pad, dtype)
         key = ("pairhmm", r_pad, h_pad, len(idxs))
 
-        def thunk(packed=packed, r_pad=r_pad, h_pad=h_pad):
+        def thunk(packed=packed, r_pad=r_pad, h_pad=h_pad,
+                  b=len(idxs)):
             from ..obs.compiles import TRACKER
 
             # exact per-bucket compile attribution: the jit object's
@@ -399,7 +400,7 @@ def forward_pairs_partial(reads, quals, haps, *,
             with TRACKER.observe(
                     "pairhmm",
                     signature={"r_pad": r_pad, "h_pad": h_pad,
-                               "rescale": rescale,
+                               "b": b, "rescale": rescale,
                                "dtype": dtype.name},
                     cache_size_fn=lambda: getattr(
                         _FORWARD_JIT, "_cache_size", lambda: 0)()

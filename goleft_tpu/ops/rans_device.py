@@ -602,9 +602,17 @@ def plan_signatures(p: ParsedNx16) -> list[tuple]:
 
 
 def _decode_flat(plans: list[ParsedNx16], *, backend: str,
-                 interpret: bool, stage) -> list[bytes]:
-    """The bucketed + vmapped dispatch over non-stripe plans."""
-    results: list[bytes | None] = [None] * len(plans)
+                 interpret: bool, stage,
+                 device_idx: set[int] | None = None) -> list:
+    """The bucketed + vmapped dispatch over non-stripe plans.
+
+    ``device_idx`` marks plan indices whose decoded output should stay
+    device-resident: those entries come back as the bucket's (out_cap,)
+    uint8 device row instead of host bytes (valid through the plan's
+    ``final_len``; trailing lanes are whatever the kernel left there).
+    STRIPE reassembly uses this so lane bytes feed the interleave
+    gather without a device→host→device round-trip."""
+    results: list = [None] * len(plans)
     groups: dict[tuple, list[int]] = {}
     for i, p in enumerate(plans):
         groups.setdefault(_signature(p), []).append(i)
@@ -705,8 +713,12 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                     pack=pack, order1=order1, shift=shift,
                     n_ctx_cap=n_ctx_cap, lit_cap=lit_cap,
                     mid_cap=mid_cap, out_cap=out_cap)
-        out = np.asarray(out)
         diag = np.asarray(diag)
+        keep = device_idx or ()
+        # bulk host fetch only when no row of this bucket stays on
+        # device; mixed buckets fetch their host rows individually
+        host_out = np.asarray(out) \
+            if not any(i in keep for i in idxs) else None
         for j, (i, p) in enumerate(zip(idxs, grp)):
             if order1 and int(diag[j, 3]):
                 raise ValueError(
@@ -723,7 +735,12 @@ def _decode_flat(plans: list[ParsedNx16], *, backend: str,
                     and int(diag[j, 2]) >= p.pack_nsym:
                 raise ValueError(
                     "rans-nx16: pack index out of range")
-            results[i] = bytes(out[j, :p.final_len])
+            if i in keep:
+                results[i] = out[j]
+            elif host_out is not None:
+                results[i] = bytes(host_out[j, :p.final_len])
+            else:
+                results[i] = bytes(np.asarray(out[j, :p.final_len]))
     return results
 
 
@@ -736,6 +753,9 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
     STRIPE containers flatten into their lane sub-streams (decoded
     through the same buckets as standalone blocks), then reassemble
     via one batched transpose-interleave gather per stripe shape.
+    Lane outputs stay device-resident between the decode buckets and
+    the interleave dispatch — only the final interleaved block is
+    fetched to the host (plain rows fetch as before).
 
     ``backend``: "scan" (the XLA product path) or "pallas" (the
     experimental kernel for the ORDER0 rANS stage; ORDER1 and the
@@ -747,18 +767,21 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
     """
     flat: list[ParsedNx16] = []
     spec: list[tuple] = []
+    lane_idx: set[int] = set()
     for p in plans:
         if p.stripe:
             idxs = []
             for ch in p.children or []:
                 idxs.append(len(flat))
+                lane_idx.add(len(flat))
                 flat.append(ch)
             spec.append(("stripe", idxs, p))
         else:
             spec.append(("plain", len(flat), p))
             flat.append(p)
     decoded = _decode_flat(flat, backend=backend,
-                           interpret=interpret, stage=stage)
+                           interpret=interpret, stage=stage,
+                           device_idx=lane_idx)
 
     results: list[bytes | None] = [None] * len(plans)
     stripe_groups: dict[tuple, list[int]] = {}
@@ -768,18 +791,30 @@ def decode_parsed(plans: list[ParsedNx16], *, backend: str = "scan",
         else:
             stripe_groups.setdefault(_stripe_shape(entry[2]),
                                      []).append(i)
+    if stripe_groups:
+        import jax.numpy as jnp
     for shape in sorted(stripe_groups):
         n_lanes, lane_cap, out_cap = shape
         members = stripe_groups[shape]
         B = len(members)
-        lanes_arr = np.zeros((B, n_lanes, lane_cap), np.uint8)
+        rows = []
         flens = np.zeros(B, np.int32)
         for b, i in enumerate(members):
             _, idxs, p = spec[i]
             flens[b] = p.final_len
-            for j, k in enumerate(idxs):
-                lane = np.frombuffer(decoded[k], np.uint8)
-                lanes_arr[b, j, :lane.shape[0]] = lane
+            for k in idxs:
+                # device row from the lane's decode bucket: valid
+                # through the lane's final_len, and the interleave
+                # gather never reads past it for output positions
+                # < final_len (lane j holds exactly ceil((flen-j)/N)
+                # bytes), so pad/trim to lane_cap without re-zeroing
+                r = decoded[k]
+                if r.shape[0] >= lane_cap:
+                    r = r[:lane_cap]
+                else:
+                    r = jnp.pad(r, (0, lane_cap - r.shape[0]))
+                rows.append(r)
+        lanes_arr = jnp.stack(rows).reshape(B, n_lanes, lane_cap)
         out = np.asarray(_jitted_interleave()(
             lanes_arr, flens, n_lanes=n_lanes, out_cap=out_cap))
         for b, i in enumerate(members):

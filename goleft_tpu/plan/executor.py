@@ -289,7 +289,8 @@ def execute_task(key, thunk, cache=None,
 def run_device_step(name: str, fn, *, key=None, metrics=None,
                     policy: RetryPolicy | None = None,
                     retry: bool = True, dedup: bool = False,
-                    count_passes: bool = False, **attrs):
+                    count_passes: bool = False, signature=None,
+                    **attrs):
     """One coalesced serve device dispatch as a Step.
 
     The serve executors' dispatch boundary: the shared ``compute``
@@ -322,9 +323,12 @@ def run_device_step(name: str, fn, *, key=None, metrics=None,
             cm = metrics.timer.stage("compute")
         # the compile observation runs INSIDE the device span the
         # Executor opens around this fn, so a jit miss surfaced here
-        # lands as a nested xla.compile.<family> span in flight trees
+        # lands as a nested xla.compile.<family> span in flight trees.
+        # ``signature`` (program geometry) makes the observation
+        # warmstart-actionable: the warmup manifest records it and
+        # serve --warmup can recreate the compile before admission.
         with cm, TRACKER.observe(family_of_dispatch(name),
-                                 trigger=name):
+                                 signature=signature, trigger=name):
             return fn()
 
     ex = Executor(policy=policy if policy is not None

@@ -34,6 +34,11 @@ Sites are plain strings; the instrumented ones are
             — identity probes and ranged reads against an object
             store, each one a retried plan Step; a transient fault
             here is a dropped HTTP response, a permanent one a 404)
+    map     the read mapper's per-bucket device dispatches — both the
+            minimizer seed/chain stage and the Smith-Waterman
+            extension stage (mapping/pipeline.py; CLI and serve
+            route through the same plan Steps, retried under the
+            RetryPolicy with per-bucket quarantine on exhaustion)
 
 Example: ``shard:after=3:kill`` SIGKILLs the process at the 3rd shard
 execution — the chaos smoke's mid-flight death; ``bgzf:every=100:p=0``

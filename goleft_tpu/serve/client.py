@@ -257,3 +257,12 @@ class ServeClient:
         windows document (+ optional candidates/gap params)."""
         return self._request("/v1/pairhmm",
                              {"input": input_path, **params})
+
+    def map(self, fastq: str, reference: str, **params) -> dict:
+        """→ {tuples_tsv, reads, mapped, unmapped, failed
+        [, depth_bed][, cached]} — the tuple stream the one-shot
+        `goleft-tpu map` CLI writes for the same FASTQ/reference
+        (pass ``window=`` for the fused depth bed too)."""
+        return self._request("/v1/map",
+                             {"fastq": fastq,
+                              "reference": reference, **params})

@@ -62,7 +62,7 @@ def _stage(metrics, name: str):
 
 
 def _dispatch(metrics, name: str, fn, retry: bool = True, key=None,
-              count_passes: bool = False, **attrs):
+              count_passes: bool = False, signature=None, **attrs):
     """The executors' dispatch boundary, lowered through the plan
     layer (plan/executor.py run_device_step): the shared ``compute``
     stage wall-clock PLUS a device-event span carrying backend/
@@ -94,7 +94,7 @@ def _dispatch(metrics, name: str, fn, retry: bool = True, key=None,
 
     return run_device_step(name, fn, metrics=metrics, retry=retry,
                            key=key, count_passes=count_passes,
-                           **attrs)
+                           signature=signature, **attrs)
 
 
 def _require(req: dict, field: str):
@@ -209,10 +209,23 @@ class DepthExecutor:
 
                     with _stage(self.metrics, "decode"):
                         segs = list(ex.map(_dec, opened))
+                    from ..ops.coverage import bucket_size
+
                     starts, ends, sums, cls = _dispatch(
                         self.metrics, "serve.depth.dispatch",
                         lambda: engine.run_segments_batch(segs, s, e),
                         key=base_key + (c, s, e), count_passes=True,
+                        # the compiled program's full geometry — what
+                        # serve --warmup needs to recreate this
+                        # compile from a manifest entry
+                        signature={
+                            "b": len(segs),
+                            "bucket": bucket_size(max(
+                                max((len(ss) for ss, _ in segs),
+                                    default=0), 1)),
+                            "length": engine.length,
+                            "window": engine.w_eff,
+                        },
                         batch=len(segs), region=f"{c}:{s}-{e}")
                     with _stage(self.metrics, "format"):
                         for i, (dout, cout) in enumerate(outs):
@@ -719,4 +732,120 @@ class CohortscanExecutor:
             finally:
                 if ck_dir is None:  # throwaway scan: no resume value
                     shutil.rmtree(out_dir, ignore_errors=True)
+        return out
+
+
+class MapExecutor:
+    """`/v1/map`: FASTQ path/URL + reference → the mapped read-tuple
+    stream, byte-identical to the ``goleft-tpu map`` CLI.
+
+    Coalescing: requests sharing (reference identity, mapping
+    parameters) share the minimizer index (one build + one device
+    upload per reference, process-cached) and their reads run through
+    the same per-process seed/extend compile caches; each request's
+    reads are seeded and extended independently, so a response's
+    bytes cannot depend on what else shared the batch — the pipeline's
+    padding invariance is pinned by the swalign bucket tests."""
+
+    kind = "map"
+
+    def __init__(self, processes: int = 4, metrics=None):
+        self.processes = processes
+        self.metrics = metrics
+
+    def validate(self, req: dict) -> None:
+        fastq = _require(req, "fastq")
+        if not _exists(fastq):
+            raise BadRequest(f"no such file: {fastq}")
+        ref = _require(req, "reference")
+        if not _exists(ref):
+            raise BadRequest(f"no such file: {ref}")
+        for field in ("k", "w", "max_occ", "min_support", "band",
+                      "window"):
+            v = req.get(field)
+            if v is not None and (not isinstance(v, int) or v <= 0):
+                raise BadRequest(f"{field} must be a positive int")
+
+    def _params(self, req: dict):
+        from ..mapping import MapParams
+        from ..mapping.index import (
+            DEFAULT_K, DEFAULT_MAX_OCC, DEFAULT_W,
+        )
+        from ..mapping.pipeline import (
+            DEFAULT_BAND, DEFAULT_MIN_SUPPORT,
+        )
+
+        return MapParams(
+            k=int(req.get("k", DEFAULT_K)),
+            w=int(req.get("w", DEFAULT_W)),
+            max_occ=int(req.get("max_occ", DEFAULT_MAX_OCC)),
+            band=int(req.get("band", DEFAULT_BAND)),
+            min_support=int(req.get("min_support",
+                                    DEFAULT_MIN_SUPPORT)))
+
+    def group_key(self, req: dict) -> tuple:
+        from ..parallel.scheduler import file_key
+
+        try:
+            ref_id = tuple(file_key(req["reference"]))
+        except OSError:
+            ref_id = (req["reference"],)
+        return (self.kind, ref_id) + self._params(req).key()
+
+    def cache_files(self, req: dict) -> list[str]:
+        return [req["fastq"], req["reference"]]
+
+    def run(self, reqs: Sequence[dict]) -> list[dict]:
+        from ..io.fastq import FastqError, read_fastq
+        from ..mapping import get_index, map_reads
+        from ..mapping.pipeline import (
+            depth_bed_from_tuples, format_tuples,
+        )
+        from ..parallel.scheduler import file_key
+
+        p0 = reqs[0]
+        params = self._params(p0)
+        index = get_index(p0["reference"], k=params.k, w=params.w,
+                          max_occ=params.max_occ)
+        with _stage(self.metrics, "decode"):
+            per_req = []
+            for r in reqs:
+                try:
+                    per_req.append(read_fastq(r["fastq"]))
+                except FastqError as e:
+                    # a corrupt FASTQ is this request's 400, never a
+                    # 500 poisoning everyone who shared its batch
+                    raise BadRequest(str(e)) from None
+        out = []
+        for r, records in zip(reqs, per_req):
+            try:
+                fq_id = tuple(file_key(r["fastq"]))
+            except OSError:
+                fq_id = (r["fastq"],)
+            # the whole per-request pipeline (its seed + extend plan
+            # Steps ride the 'map' fault site internally) under one
+            # compute-stage step keyed by (fastq, reference, params)
+            res = _dispatch(
+                self.metrics, "serve.map.dispatch",
+                lambda idx=index, recs=records: map_reads(
+                    idx, recs, params),
+                retry=False, count_passes=True,
+                key=("serve.map", fq_id) + tuple(self.group_key(r)),
+                reads=len(records))
+            resp = {
+                "tuples_tsv": format_tuples(res.tuples).decode(),
+                "reads": res.stats["reads"],
+                "mapped": res.stats["mapped"],
+                "unmapped": res.stats["unmapped"],
+                "failed": res.stats["failed"],
+            }
+            if r.get("window"):
+                lengths = {
+                    n: int(index.chrom_starts[i + 1]
+                           - index.chrom_starts[i])
+                    for i, n in enumerate(index.chrom_names)}
+                resp["depth_bed"] = depth_bed_from_tuples(
+                    [t for t in res.tuples if t is not None],
+                    lengths, int(r["window"])).decode()
+            out.append(resp)
         return out

@@ -36,6 +36,8 @@ Routes:
                          extranormalize?, chunk_samples?, checkpoint?}
   POST /v1/pairhmm      {input, candidates?, gap_open?, gap_ext?,
                          f64?}
+  POST /v1/map          {fastq, reference, k?, w?, max_occ?,
+                         min_support?, band?, window?}
   GET  /healthz         GET /metrics        GET /debug/flight
   GET  /debug/compiles  GET /debug/profile?seconds=N
   GET  /debug/memory
@@ -57,7 +59,7 @@ from .batcher import (
 )
 from .executors import (
     BadRequest, CohortdepthExecutor, CohortscanExecutor, DepthExecutor,
-    IndexcovExecutor, PairhmmExecutor,
+    IndexcovExecutor, MapExecutor, PairhmmExecutor,
 )
 from .flight import FlightRecorder
 from .metrics import ServeMetrics
@@ -145,6 +147,7 @@ class ServeApp:
                 CohortscanExecutor(max(processes, 8), self.metrics,
                                    checkpoint_root=checkpoint_root),
                 PairhmmExecutor(processes, self.metrics),
+                MapExecutor(processes, self.metrics),
             )
         }
         # per-endpoint circuit breakers: repeated systemic (500-class)

@@ -1,0 +1,286 @@
+"""Read mapping: index invariants, device/host seeding parity, the
+end-to-end accuracy contract, tuple/depth fusion byte-identity, the
+compile-signature cap's host fallback, and the serve executor.
+"""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.io.fastq import FastqRecord
+from goleft_tpu.mapping import (
+    MapParams, build_index, depth_bed_from_tuples, format_tuples,
+    map_reads, parse_tuples,
+)
+from goleft_tpu.mapping import pipeline
+from goleft_tpu.mapping.index import fmix32, kmer_codes, minimizer_mask
+from goleft_tpu.ops.pairhmm import encode_seq
+
+_BASES = b"ACGT"
+
+
+def _rand_seq(rng, n):
+    return bytes(rng.choice(list(_BASES), size=n).tolist())
+
+
+def _write_fasta(tmp_path, chroms, name="ref.fa"):
+    p = tmp_path / name
+    out = []
+    for cname, seq in chroms:
+        out.append(f">{cname}\n".encode())
+        for i in range(0, len(seq), 60):
+            out.append(seq[i:i + 60] + b"\n")
+    p.write_bytes(b"".join(out))
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def ref(tmp_path_factory):
+    rng = np.random.default_rng(42)
+    chroms = [("chr1", _rand_seq(rng, 1600)),
+              ("chr2", _rand_seq(rng, 900))]
+    path = _write_fasta(tmp_path_factory.mktemp("ref"), chroms)
+    return path, dict(chroms)
+
+
+@pytest.fixture(scope="module")
+def index(ref):
+    return build_index(ref[0])
+
+
+def _sim_reads(rng, chroms, n, rlen, subs=2, rc_rate=0.3):
+    """(records, truth) — truth[i] = (chrom, start, rev)."""
+    names = sorted(chroms)
+    recs, truth = [], []
+    for i in range(n):
+        cname = names[int(rng.integers(0, len(names)))]
+        seq = chroms[cname]
+        s = int(rng.integers(0, len(seq) - rlen))
+        frag = bytearray(seq[s:s + rlen])
+        for _ in range(subs):
+            j = int(rng.integers(0, rlen))
+            frag[j] = _BASES[int(rng.integers(0, 4))]
+        rev = rng.random() < rc_rate
+        if rev:
+            comp = bytes(frag).translate(
+                bytes.maketrans(b"ACGT", b"TGCA"))[::-1]
+            frag = bytearray(comp)
+        recs.append(FastqRecord(f"r{i}", bytes(frag),
+                                b"I" * rlen))
+        truth.append((cname, s, rev))
+    return recs, truth
+
+
+# ---------------- index ----------------
+
+
+def test_index_build_invariants(index):
+    assert index.n_minimizers > 0
+    # open addressing: every stored key retrievable within PROBE_MAX
+    filled = np.nonzero(index.ht_code != -1)[0]
+    assert len(filled) > 0
+    size = index.table_size
+    for j in filled[:50]:
+        code = np.uint32(index.ht_code[j])
+        s = int(fmix32(np.asarray([code]))[0]) & (size - 1)
+        assert (j - s) % size < pipeline.PROBE_MAX
+    # positions point at occurrences of their own k-mer
+    j = int(filled[0])
+    st, ct = int(index.ht_start[j]), int(index.ht_cnt[j])
+    kc, _ = kmer_codes(index.ref_codes, index.k)
+    for p in index.pos[st:st + ct]:
+        # cross-chromosome windows never produce minimizers, so each
+        # position decodes back to the stored code
+        assert int(kc[int(p)]) == int(index.ht_code[j])
+
+
+def test_minimizer_mask_matches_the_windowed_min_rule():
+    rng = np.random.default_rng(1)
+    w, k = 8, 13
+    codes = np.frombuffer(_rand_seq(rng, 2000), np.uint8) % 4
+    codes[100:105] = 4  # an N run invalidates its k windows
+    kc, valid = kmer_codes(codes, k)
+    h = fmix32(kc)
+    sel = minimizer_mask(h, valid, w)
+    INF = np.uint32(0xFFFFFFFF)
+    hh = np.where(valid, h, INF)
+    n = len(hh)
+    for p in range(n):
+        lo, hi = max(0, p - w + 1), min(n, p + w)
+        want = valid[p] and hh[p] == hh[lo:hi].min()
+        assert bool(sel[p]) == bool(want), p
+    assert not sel[100 - k + 1:105].any()
+    dens = sel.sum() / max(valid.sum(), 1)
+    assert 0.03 < dens < 0.35  # ~1/(2w-1) with slack
+
+
+def test_chrom_lookup(index):
+    name0, local0 = index.chrom_of(0)
+    assert (name0, local0) == ("chr1", 0)
+    gstart2 = int(index.chrom_starts[1])
+    assert index.chrom_of(gstart2) == ("chr2", 0)
+    assert index.chrom_bounds(gstart2 + 5) == (
+        gstart2, int(index.chrom_starts[2]))
+
+
+# ---------------- device seeding == host oracle ----------------
+
+
+def test_device_seeding_matches_host_oracle(ref, index):
+    rng = np.random.default_rng(5)
+    recs, _ = _sim_reads(rng, ref[1], 24, 60, subs=3)
+    codes_list = [encode_seq(r.seq) for r in recs]
+    r_pad = pipeline._pad_up(60, pipeline.BUCKET)
+    smax = pipeline._smax(r_pad, index.k, index.w)
+    pk, nm, rl = pipeline._pack_reads_2bit(
+        list(range(len(recs))), codes_list, r_pad)
+    fn = pipeline._seed_jit(r_pad, index.k, index.w, index.max_occ,
+                            pipeline.DEFAULT_BAND, smax)
+    s, d, rv = (np.asarray(a) for a in
+                fn(pk, nm, rl, *index.device_tables()))
+    for i, c in enumerate(codes_list):
+        hs, hd, hrv = pipeline.seed_reads_host(
+            index, c, pipeline.DEFAULT_BAND, smax)
+        assert (int(s[i]), int(d[i]), bool(rv[i])) == (hs, hd, hrv), i
+
+
+# ---------------- end-to-end ----------------
+
+
+def test_map_reads_accuracy_and_strands(ref, index):
+    rng = np.random.default_rng(9)
+    recs, truth = _sim_reads(rng, ref[1], 120, 100)
+    res = map_reads(index, recs)
+    assert not res.failed
+    ok = 0
+    for i, t in enumerate(res.tuples):
+        if t is None:
+            continue
+        chrom, start, end, name, score, strand = t
+        tc, ts, trev = truth[i]
+        if (chrom == tc and abs(start - ts) <= 5
+                and strand == ("-" if trev else "+")):
+            ok += 1
+        assert name == recs[i].name and score > 0
+    assert ok >= 0.95 * len(recs)
+    assert res.stats["mapped"] == sum(
+        1 for t in res.tuples if t is not None)
+
+
+def test_short_and_empty_reads_are_unmapped_not_errors(index):
+    recs = [FastqRecord("tiny", b"ACGT", b"IIII")]
+    res = map_reads(index, recs)
+    assert res.tuples == [None] and not res.failed
+    assert res.stats["unmapped"] == 1
+    empty = map_reads(index, [])
+    assert empty.stats["reads"] == 0
+
+
+def test_map_fault_site_retries_then_quarantines(index, ref):
+    from goleft_tpu.resilience import faults
+
+    rng = np.random.default_rng(13)
+    recs, _ = _sim_reads(rng, ref[1], 8, 100)
+    want = map_reads(index, recs).tuples
+    try:
+        faults.install("map:after=1:transient")
+        got = map_reads(index, recs)
+        assert got.tuples == want and not got.failed
+        faults.install("map:every=1:permanent")
+        dead = map_reads(index, recs)
+        assert dead.tuples == [None] * len(recs)
+        assert set(dead.failed) == set(range(len(recs)))
+        assert dead.stats["failed"] == len(recs)
+    finally:
+        faults.install(None)
+
+
+# ---------------- tuples + fused depth ----------------
+
+
+def test_tuple_stream_round_trip(ref, index):
+    rng = np.random.default_rng(21)
+    recs, _ = _sim_reads(rng, ref[1], 20, 80)
+    tuples = map_reads(index, recs).tuples
+    data = format_tuples(tuples)
+    back = parse_tuples(data)
+    assert back == [t for t in tuples if t is not None]
+    with pytest.raises(ValueError, match="6 fields"):
+        parse_tuples(b"chr1\t0\t5\n")
+
+
+def test_fused_depth_equals_from_tuples_rerun(ref, index):
+    rng = np.random.default_rng(22)
+    recs, _ = _sim_reads(rng, ref[1], 40, 100)
+    tuples = map_reads(index, recs).tuples
+    lengths = {c: len(s) for c, s in ref[1].items()}
+    fused = depth_bed_from_tuples(tuples, lengths, 250)
+    rerun = depth_bed_from_tuples(
+        parse_tuples(format_tuples(tuples)), lengths, 250)
+    assert fused == rerun and fused
+    # windows tile each covered chromosome completely
+    rows = [ln.split(b"\t") for ln in fused.splitlines()]
+    for chrom in {r[0] for r in rows}:
+        spans = [(int(r[1]), int(r[2])) for r in rows
+                 if r[0] == chrom]
+        assert spans[0][0] == 0
+        assert spans[-1][1] == lengths[chrom.decode()]
+        for (s0, e0), (s1, e1) in zip(spans, spans[1:]):
+            assert s1 == e0
+
+
+# ---------------- signature-cap host fallback ----------------
+
+
+def test_over_cap_buckets_fall_back_to_host_bit_identically(
+        ref, index, monkeypatch):
+    rng = np.random.default_rng(31)
+    recs, _ = _sim_reads(rng, ref[1], 16, 100)
+    want = map_reads(index, recs).tuples
+    from goleft_tpu.obs import get_registry
+
+    c = get_registry().counter("mapping.host_fallback_total")
+    before = c.value
+    monkeypatch.setattr(pipeline, "MAX_BUCKET_SIGNATURES", 0)
+    pipeline.reset_signature_registry()
+    try:
+        got = map_reads(index, recs)
+    finally:
+        monkeypatch.undo()
+        pipeline.reset_signature_registry()
+    assert got.tuples == want and not got.failed
+    assert c.value > before
+
+
+# ---------------- serve executor ----------------
+
+
+def test_map_executor_matches_the_pipeline(ref, index, tmp_path):
+    from goleft_tpu.serve.executors import BadRequest, MapExecutor
+
+    fq = tmp_path / "reads.fastq"
+    rng = np.random.default_rng(41)
+    recs, _ = _sim_reads(rng, ref[1], 12, 100)
+    fq.write_bytes(b"".join(
+        b"@%s\n%s\n+\n%s\n" % (r.name.encode(), r.seq, r.qual)
+        for r in recs))
+    ex = MapExecutor()
+    req = {"fastq": str(fq), "reference": ref[0], "window": 250}
+    ex.validate(req)
+    with pytest.raises(BadRequest, match="no such file"):
+        ex.validate({"fastq": str(fq), "reference": "/nope.fa"})
+    with pytest.raises(BadRequest, match="positive int"):
+        ex.validate({"fastq": str(fq), "reference": ref[0], "k": -1})
+    assert ex.group_key(req) == ex.group_key(dict(req))
+    (resp,) = ex.run([req])
+    res = map_reads(index, recs, MapParams())
+    assert resp["tuples_tsv"].encode() == format_tuples(res.tuples)
+    assert (resp["reads"], resp["mapped"]) == (
+        len(recs), res.stats["mapped"])
+    lengths = {c: len(s) for c, s in ref[1].items()}
+    assert resp["depth_bed"].encode() == depth_bed_from_tuples(
+        res.tuples, lengths, 250)
+
+    bad = tmp_path / "bad.fastq"
+    bad.write_bytes(b"@r\nACGT\n+\nIII\n")
+    with pytest.raises(BadRequest, match="quality length"):
+        ex.run([{"fastq": str(bad), "reference": ref[0]}])

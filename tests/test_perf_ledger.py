@@ -587,6 +587,36 @@ def test_wire_decode_entry_ingests(tmp_path):
         == pytest.approx(34.2)
 
 
+def test_read_mapping_entry_ingests(tmp_path):
+    """The read_mapping bench entry (minimizer seed+chain only vs the
+    full seed-chain-extend pipeline, reads/s, oracle-byte-verified
+    before timing) lands in the ledger so `perf check` trends both
+    mapper lanes and the mapped fraction."""
+    entry = {
+        "reads": 2000, "read_len": 100, "ref_bp": 250_000,
+        "minimizers": 16681, "index_build_s": 0.116,
+        "mapped_frac": 0.998,
+        "seed_only_reads_s": 1037.5, "seed_extend_reads_s": 701.0,
+        "platform": "cpu", "device": "TFRT_CPU_0",
+        "device_kind": "cpu",
+        "note": "tuples byte-verified vs the host oracle",
+    }
+    recs = ledger.live_run_records({"read_mapping": entry}, None)
+    rec = {r["entry"]: r for r in recs}["read_mapping"]
+    assert rec["provenance"] == "host" and rec["stale"] is False
+    for key in ("seed_only_reads_s", "seed_extend_reads_s",
+                "mapped_frac", "index_build_s", "reads"):
+        assert key in rec["metrics"], key
+    assert rec["metrics"]["seed_extend_reads_s"] \
+        == pytest.approx(701.0)
+    lp = str(tmp_path / "ledger.jsonl")
+    ledger.append_records(lp, recs)
+    back = [r for r in ledger.read_ledger(lp)
+            if r["entry"] == "read_mapping"]
+    assert len(back) == 1
+    assert back[0]["metrics"]["mapped_frac"] == pytest.approx(0.998)
+
+
 def test_fleet_failover_recovery_entry_ingests(tmp_path):
     """The federation bench entry (fleet_failover_recovery_s: SIGKILL
     a fleet router -> failover via the survivor, restart -> half-open

@@ -1,0 +1,177 @@
+"""Banded Smith-Waterman wavefront vs the NumPy oracle.
+
+The mapper's correctness contract: device scores, best cells and
+direction-bit planes are bitwise equal to :func:`sw_oracle` on every
+bucket shape, and a pair's alignment is independent of its padding
+and batch neighbors (the property that lets serve coalesce map
+requests byte-identically).
+"""
+
+import numpy as np
+import pytest
+
+from goleft_tpu.ops import swalign
+from goleft_tpu.ops.pairhmm import encode_seq
+from goleft_tpu.ops.swalign import (
+    BUCKET, WBUCKET, Alignment, Scores, align_bucket, align_pairs,
+    bucket_shape, oracle_align, sw_oracle, traceback,
+)
+
+_BASES = b"ACGT"
+
+
+def _rand_seq(rng, n, n_rate=0.0):
+    s = bytearray(rng.choice(list(_BASES), size=n).tolist())
+    if n_rate:
+        for i in range(n):
+            if rng.random() < n_rate:
+                s[i] = ord("N")
+    return bytes(s)
+
+
+def _mutate(rng, seq, subs=2, ins=1, dels=1):
+    s = bytearray(seq)
+    for _ in range(subs):
+        i = rng.integers(0, len(s))
+        s[i] = _BASES[rng.integers(0, 4)]
+    for _ in range(ins):
+        i = rng.integers(0, len(s))
+        s[i:i] = bytes([_BASES[rng.integers(0, 4)]])
+    for _ in range(dels):
+        i = rng.integers(0, len(s) - 1)
+        del s[i]
+    return bytes(s)
+
+
+@pytest.mark.parametrize("rlen,wlen", [
+    (20, 40),    # below both buckets
+    (32, 64),    # exactly one bucket each
+    (33, 64),    # read spills into the second bucket
+    (40, 100),   # window spills
+])
+def test_device_matches_oracle_per_bucket_shape(rlen, wlen):
+    rng = np.random.default_rng(rlen * 1000 + wlen)
+    for trial in range(4):
+        win = _rand_seq(rng, wlen)
+        read = _mutate(rng, win[5:5 + rlen])[:rlen]
+        got, = align_pairs([encode_seq(read)], [encode_seq(win)])
+        want = oracle_align(read, win)
+        assert got == want, (rlen, wlen, trial)
+
+
+def test_n_bases_never_match():
+    # N in either sequence scores as mismatch, even against N
+    got, = align_pairs([encode_seq(b"ACGNNACG")],
+                       [encode_seq(b"ACGNNACG")])
+    want = oracle_align(b"ACGNNACG", b"ACGNNACG")
+    assert got == want
+    assert "M" in got.cigar  # the flanks still align
+
+
+def test_batch_and_padding_independence():
+    # one pair alone == the same pair packed with batch neighbors
+    # AND padded into a bigger bucket than its own shape needs
+    rng = np.random.default_rng(7)
+    win = _rand_seq(rng, 50)
+    read = _mutate(rng, win[3:33])
+    r, w = encode_seq(read), encode_seq(win)
+    alone, = align_pairs([r], [w])
+    others = [encode_seq(_rand_seq(rng, 30)) for _ in range(3)]
+    owins = [encode_seq(_rand_seq(rng, 50)) for _ in range(3)]
+    batched = align_pairs([r] + others, [w] + owins)
+    assert batched[0] == alone
+    # oversized bucket: r_pad/w_pad two buckets up
+    packed = swalign._pack_bucket([0], [r], [w], 2 * BUCKET,
+                                  2 * WBUCKET)
+    padded, = align_bucket(*packed)
+    assert padded == alone
+
+
+def test_exact_match_scores_full_length():
+    win = b"TTTT" + b"ACGTACGTAC" * 3 + b"GGGG"
+    read = b"ACGTACGTAC" * 3
+    a, = align_pairs([encode_seq(read)], [encode_seq(win)])
+    assert a.score == 2 * len(read)
+    assert (a.read_start, a.read_end) == (0, len(read))
+    assert a.win_start == 4 and a.win_end == 4 + len(read)
+    assert a.cigar == f"{len(read)}M"
+
+
+def test_traceback_cigar_consumes_the_spans():
+    rng = np.random.default_rng(11)
+    for _ in range(8):
+        win = _rand_seq(rng, 80)
+        read = _mutate(rng, win[10:60], subs=3, ins=2, dels=2)
+        a, = align_pairs([encode_seq(read)], [encode_seq(win)])
+        if a.score <= 0:
+            continue
+        n_m = sum(int(n) for n, op in _cig_ops(a.cigar) if op == "M")
+        n_i = sum(int(n) for n, op in _cig_ops(a.cigar) if op == "I")
+        n_d = sum(int(n) for n, op in _cig_ops(a.cigar) if op == "D")
+        assert n_m + n_i == a.read_end - a.read_start
+        assert n_m + n_d == a.win_end - a.win_start
+
+
+def _cig_ops(cigar):
+    out, num = [], ""
+    for ch in cigar:
+        if ch.isdigit():
+            num += ch
+        else:
+            out.append((num, ch))
+            num = ""
+    return out
+
+
+def test_no_alignment_scores_zero():
+    a, = align_pairs([encode_seq(b"AAAAAAAAAA")],
+                     [encode_seq(b"CCCCCCCCCC")])
+    assert a == Alignment(0, 0, 0, 0, 0, "")
+
+
+def test_align_pairs_dispatch_hook_sees_bucket_shapes():
+    rng = np.random.default_rng(3)
+    reads = [encode_seq(_rand_seq(rng, n)) for n in (20, 30, 40)]
+    wins = [encode_seq(_rand_seq(rng, n)) for n in (60, 60, 90)]
+    seen = []
+
+    def dispatch(sig, thunk):
+        seen.append(sig)
+        return thunk()
+
+    hooked = align_pairs(reads, wins, dispatch=dispatch)
+    assert hooked == align_pairs(reads, wins)
+    assert sorted(seen) == [(BUCKET, WBUCKET, 2),
+                            (2 * BUCKET, 2 * WBUCKET, 1)]
+
+
+def test_bucket_shape_rounds_up():
+    assert bucket_shape(1, 1) == (BUCKET, WBUCKET)
+    assert bucket_shape(BUCKET, WBUCKET) == (BUCKET, WBUCKET)
+    assert bucket_shape(BUCKET + 1, WBUCKET + 1) == (2 * BUCKET,
+                                                     2 * WBUCKET)
+
+
+def test_custom_scores_thread_through_both_sides():
+    sc = Scores(match=1, mismatch=-1, gap_open=-2, gap_ext=-1)
+    win = b"ACGTACGTACGTACGT"
+    read = b"ACGTACCGTACGT"  # one insertion
+    got, = align_pairs([encode_seq(read)], [encode_seq(win)],
+                       scores=sc)
+    assert got == oracle_align(read, win, sc)
+
+
+def test_oracle_best_cell_tie_rule_is_first_wavefront_cell():
+    # two disjoint maximal hits: the earlier (i+j) one must win on
+    # both sides — this is the rule that keeps device/host identical
+    read = b"ACGT"
+    win = b"ACGTTTTTACGT"
+    best, bi, bj, _ = sw_oracle(encode_seq(read), encode_seq(win))
+    assert best == 8 and (bi, bj) == (4, 4)
+    a, = align_pairs([encode_seq(read)], [encode_seq(win)])
+    assert (a.win_start, a.win_end) == (0, 4)
+
+
+def test_traceback_of_empty_best_cell():
+    dirs = np.zeros((4, 4), np.uint8)
+    assert traceback(dirs, 0, 0) == (0, 0, 0, 0, "")

@@ -1,0 +1,89 @@
+"""serve --warmup consumer: manifest-driven pre-compilation.
+
+Small geometries keep the real jit entries cheap on the CPU test
+platform; the fleet-scale story (restarted worker holds the top
+signature before traffic) is profile-smoke's prewarm leg.
+"""
+
+import json
+
+import pytest
+
+from goleft_tpu.obs.compiles import (
+    WARMUP_SCHEMA, save_warmup_manifest,
+)
+from goleft_tpu.serve.warmstart import warm_start
+
+
+def _manifest(entries):
+    return {"schema": WARMUP_SCHEMA, "generated_unix": 1.0,
+            "signatures": [
+                {"rank": i + 1, "family": fam,
+                 "signature": json.dumps(sig) if sig else "",
+                 "backend": "cpu", "hits": 10, "compiles": 1,
+                 "compile_seconds": 0.5}
+                for i, (fam, sig) in enumerate(entries)]}
+
+
+def _write(tmp_path, doc, name="warm.json"):
+    p = str(tmp_path / name)
+    save_warmup_manifest(p, doc)
+    return p
+
+
+def test_warm_start_precompiles_known_families(tmp_path):
+    doc = _manifest([
+        ("depth", {"b": 1, "bucket": 16, "length": 512,
+                   "window": 256}),
+        ("pairhmm", {"b": 1, "r_pad": 8, "h_pad": 16,
+                     "rescale": False, "dtype": "float32"}),
+        ("swalign", {"stage": "extend", "r_pad": 32, "w_pad": 64,
+                     "b": 1}),
+    ])
+    counts = warm_start(_write(tmp_path, doc))
+    assert counts["warmed"] == 3
+    assert counts["skipped"] == 0 and counts["failed"] == 0
+    assert counts["seconds"] > 0
+
+
+def test_warm_start_skips_unreplayable_entries(tmp_path):
+    doc = _manifest([
+        ("rans", {"whatever": 1}),         # no precompiler family
+        ("depth", None),                   # geometry-less signature
+        ("swalign", {"stage": "seed", "r_pad": 32, "table": 4096,
+                     "b": 1}),             # reference-bound
+    ])
+    counts = warm_start(_write(tmp_path, doc))
+    assert counts == {"warmed": 0, "skipped": 3, "failed": 0,
+                      "seconds": counts["seconds"]}
+
+
+def test_warm_start_stale_entries_fail_soft(tmp_path):
+    doc = _manifest([
+        ("depth", {"b": 1}),  # missing geometry keys → replay fails
+        ("depth", {"b": 1, "bucket": 16, "length": 512,
+                   "window": 256}),
+    ])
+    counts = warm_start(_write(tmp_path, doc))
+    assert counts["failed"] == 1
+    assert counts["warmed"] == 1  # later entries still run
+
+
+def test_warm_start_honors_top_k(tmp_path):
+    doc = _manifest([
+        ("depth", {"b": 1, "bucket": 16, "length": 512,
+                   "window": 256}),
+        ("depth", {"b": 1, "bucket": 16, "length": 1024,
+                   "window": 256}),
+    ])
+    counts = warm_start(_write(tmp_path, doc), top_k=1)
+    assert counts["warmed"] == 1 and counts["failed"] == 0
+
+
+def test_warm_start_rejects_bad_manifest(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{\"schema\": \"nope\"}")
+    with pytest.raises(ValueError):
+        warm_start(str(p))
+    with pytest.raises(OSError):
+        warm_start(str(tmp_path / "missing.json"))
